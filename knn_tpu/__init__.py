@@ -1,0 +1,34 @@
+"""knn_tpu — a TPU-native k-nearest-neighbor framework.
+
+A ground-up re-design of the capabilities of the reference C++ project
+``srna99/KNN-using-p_threads-and-MPI`` (serial / pthread / MPI KNN over ARFF
+datasets) as a JAX / XLA / shard_map / Pallas framework:
+
+- ``knn_tpu.data``      — ARFF ingest emitting dense ``float32 [N, D]`` arrays
+  (replaces the reference's ``libarff`` AoS object graph; a native C++
+  scanner/lexer/parser lives in ``knn_tpu/native/arff``).
+- ``knn_tpu.ops``       — the algorithm kernels: pairwise squared-Euclidean
+  distance, index-stable running top-k, majority vote (replaces the KNN inner
+  loops duplicated across main.cpp:25-85 / multi-thread.cpp:37-104 /
+  mpi.cpp:26-90).
+- ``knn_tpu.backends``  — execution strategies over the one algorithm:
+  ``oracle`` (NumPy, bit-exact reference semantics), ``native`` (C++ serial +
+  thread-pool), ``tpu`` (single-device jit, tiled).
+- ``knn_tpu.parallel``  — multi-device strategies over a ``jax.sharding.Mesh``:
+  query-sharded (the MPI analogue), train-sharded with all-gather top-k merge,
+  and a ring schedule (ring-attention structure with top-k accumulation).
+- ``knn_tpu.models``    — the high-level ``KNNClassifier`` API.
+- ``knn_tpu.utils``     — timing, padding, evaluation, output formatting.
+
+The behavioral contract (SURVEY.md §3.5) is preserved exactly: squared
+Euclidean over the first D-1 attributes, first-seen train index wins distance
+ties, lowest class id wins vote ties, ``num_classes = max(label)+1``.
+"""
+
+__version__ = "0.1.0"
+
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.data.arff import load_arff
+from knn_tpu.models.knn import KNNClassifier
+
+__all__ = ["Dataset", "load_arff", "KNNClassifier", "__version__"]

@@ -1,0 +1,3 @@
+from knn_tpu.cli import main
+
+main()

@@ -1,0 +1,70 @@
+"""Execution backends: one algorithm, pluggable execution strategies.
+
+The reference duplicates the whole algorithm per backend binary
+(main.cpp / multi-thread.cpp / mpi.cpp, ~70% copy-paste — SURVEY.md §0);
+here each backend is a thin strategy over the shared ops layer. Registry keys
+follow the reference's Makefile-target convention (Makefile:1-9):
+
+- ``oracle``       — NumPy, bit-exact reference kernel semantics (the parity
+                     oracle; replaces serial main.cpp as the golden path).
+- ``native``       — C++ serial kernel (knn_tpu/native/runtime), the true
+                     `make main` analogue.
+- ``native-mt``    — C++ pthread-pool kernel, the `make multi-thread` analogue.
+- ``tpu``          — single-device jit (tiled); replaces all pthread threads
+                     with one batched kernel.
+- ``tpu-sharded``  — shard_map over the test-query axis (the MPI analogue).
+- ``tpu-train-sharded`` — train rows sharded + all-gather top-k merge.
+- ``tpu-ring``     — ring schedule over train shards (ring-attention shape).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_backend(name: str) -> Callable:
+    _ensure_loaded()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown backend '{name}'; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def available_backends():
+    _ensure_loaded()
+    return sorted(_REGISTRY)
+
+
+_LOADED = False
+
+
+def _ensure_loaded():
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    # Import for registration side effects.
+    from knn_tpu.backends import oracle as _oracle  # noqa: F401
+    from knn_tpu.backends import tpu as _tpu  # noqa: F401
+
+    try:
+        from knn_tpu.backends import native as _native  # noqa: F401
+    except (ImportError, OSError):
+        pass  # native runtime not built
+    try:
+        from knn_tpu.parallel import query_sharded as _qs  # noqa: F401
+        from knn_tpu.parallel import train_sharded as _ts  # noqa: F401
+        from knn_tpu.parallel import ring as _ring  # noqa: F401
+    except ImportError:
+        pass

@@ -1,0 +1,60 @@
+"""NumPy oracle backend — the bit-exact reimplementation of the reference's
+serial KNN kernel (main.cpp:25-85), used as the golden-prediction source for
+every other backend.
+
+Contract reproduced (SURVEY.md §3.5):
+1. squared Euclidean over feature columns only (class excluded);
+2. among equal distances the lowest train index wins (the reference's strict
+   ``<`` insertion keeps the first-scanned candidate, main.cpp:46-61) —
+   realized here with a stable lexicographic (distance, index) sort;
+3. vote ties break to the lowest class id (strict ``>`` argmax from -1,
+   main.cpp:69-76) — realized with np.argmax's first-max rule;
+4. ``num_classes`` comes from the *train* set (main.cpp:27).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from knn_tpu.backends import register
+from knn_tpu.data.dataset import Dataset
+
+
+def knn_oracle(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+) -> np.ndarray:
+    """Pure-array oracle: float32 [N,D] train, int32 [N] labels, float32 [Q,D]
+    queries -> int32 [Q] predictions."""
+    train_x = np.asarray(train_x, np.float32)
+    test_x = np.asarray(test_x, np.float32)
+    train_y = np.asarray(train_y, np.int32)
+    n = train_x.shape[0]
+    q = test_x.shape[0]
+    preds = np.empty(q, np.int32)
+    arange_n = np.arange(n)
+    # Process queries in chunks so the [chunk, N] distance block stays cache-friendly.
+    d_feat = max(train_x.shape[1], 1)
+    chunk = max(1, min(q, int(4e7) // max(n * d_feat, 1)))
+    for s in range(0, q, chunk):
+        e = min(q, s + chunk)
+        diff = test_x[s:e, None, :] - train_x[None, :, :]
+        dists = np.einsum("qnd,qnd->qn", diff, diff, dtype=np.float32)
+        for row in range(e - s):
+            d = dists[row]
+            # Stable (distance, index) ordering == first-seen-wins insertion.
+            order = np.lexsort((arange_n, d))[:k]
+            counts = np.bincount(train_y[order], minlength=num_classes)
+            preds[s + row] = np.argmax(counts)
+    return preds
+
+
+@register("oracle")
+def predict(train: Dataset, test: Dataset, k: int, **_unused) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    return knn_oracle(
+        train.features, train.labels, test.features, k, train.num_classes
+    )

@@ -1,0 +1,170 @@
+"""Single-device TPU backend.
+
+Replaces the pthread backend wholesale: where multi-thread.cpp:170-192 forks T
+workers over contiguous query ranges, here ONE jit-compiled batched kernel
+covers the whole query set — the MXU/VPU is the "thread pool".
+
+Two compiled paths:
+
+- ``knn_forward``       — full [Q, N] distance matrix + top_k + vote, for
+  datasets whose distance matrix fits comfortably in HBM/host memory.
+- ``knn_forward_tiled`` — ``lax.scan`` over query tiles × train tiles with an
+  index-stable running top-k carry (the blockwise/"long-context" formulation:
+  the train set plays the role sequence length plays in attention —
+  SURVEY.md §5.7). Static tile shapes keep XLA happy; ragged edges are
+  padded + masked to +inf (utils/padding.py).
+
+``precision``: "exact" uses the subtraction-form distance for prediction
+parity with the reference; "fast" uses the MXU matmul expansion
+(ops/distance.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from knn_tpu.backends import register
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.ops.distance import pairwise_sq_dists, pairwise_sq_dists_dot
+from knn_tpu.ops.topk import topk_smallest, merge_topk
+from knn_tpu.ops.vote import vote
+from knn_tpu.utils.padding import pad_axis_to_multiple
+
+_DIST_FNS = {"exact": pairwise_sq_dists, "fast": pairwise_sq_dists_dot}
+
+
+@functools.partial(jax.jit, static_argnames=("k", "num_classes", "precision"))
+def knn_forward(
+    train_x: jnp.ndarray,
+    train_y: jnp.ndarray,
+    test_x: jnp.ndarray,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+) -> jnp.ndarray:
+    """Full-matrix KNN classify: [N,D] train, [N] labels, [Q,D] queries ->
+    [Q] int32 predictions."""
+    d = _DIST_FNS[precision](test_x, train_x)
+    _, idx = topk_smallest(d, k)
+    return vote(train_y[idx], num_classes)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "num_classes", "precision", "query_tile", "train_tile"),
+)
+def knn_forward_tiled(
+    train_x: jnp.ndarray,
+    train_y: jnp.ndarray,
+    test_x: jnp.ndarray,
+    n_train_valid: jnp.ndarray,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+    query_tile: int = 256,
+    train_tile: int = 2048,
+) -> jnp.ndarray:
+    """Tiled KNN classify with running top-k.
+
+    Both axes must already be padded to tile multiples (train rows beyond
+    ``n_train_valid`` are masked to +inf distance). Scans query tiles in an
+    outer ``lax.map`` and train tiles in an inner ``lax.scan``; the carry is
+    the per-query (dists, global-index) candidate set, merged per tile with an
+    index-stable lexicographic top-k (ops/topk.py) so first-seen-wins tie
+    semantics survive tiling (SURVEY.md §7 hard part (b))."""
+    n_pad = train_x.shape[0]
+    q_pad = test_x.shape[0]
+    assert n_pad % train_tile == 0 and q_pad % query_tile == 0
+    n_tiles = n_pad // train_tile
+    kk = min(k, train_tile)
+    dist_fn = _DIST_FNS[precision]
+
+    train_tiles_x = train_x.reshape(n_tiles, train_tile, -1)
+
+    def per_query_tile(q_block: jnp.ndarray) -> jnp.ndarray:
+        def scan_tile(carry, inp):
+            run_d, run_i = carry
+            t_idx, t_x = inp
+            d = dist_fn(q_block, t_x)  # [query_tile, train_tile]
+            col_gidx = t_idx * train_tile + jnp.arange(train_tile)
+            d = jnp.where(col_gidx[None, :] < n_train_valid, d, jnp.inf)
+            tile_d, tile_i = topk_smallest(d, kk, index_base=t_idx * train_tile)
+            run_d, run_i = merge_topk(run_d, run_i, tile_d, tile_i, k)
+            return (run_d, run_i), None
+
+        init = (
+            jnp.full((query_tile, k), jnp.inf, train_x.dtype),
+            jnp.full((query_tile, k), jnp.iinfo(jnp.int32).max, jnp.int32),
+        )
+        (run_d, run_i), _ = lax.scan(
+            scan_tile, init, (jnp.arange(n_tiles), train_tiles_x)
+        )
+        safe_i = jnp.minimum(run_i, train_y.shape[0] - 1)
+        return vote(train_y[safe_i], num_classes)
+
+    q_blocks = test_x.reshape(q_pad // query_tile, query_tile, -1)
+    preds = lax.map(per_query_tile, q_blocks)
+    return preds.reshape(q_pad)
+
+
+# [Q, N] float32 distance-matrix cells above which the tiled path is used.
+_FULL_MATRIX_CELL_LIMIT = 16 * 1024 * 1024
+
+
+def predict_arrays(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+    query_tile: int = 256,
+    train_tile: int = 2048,
+    force_tiled: bool = False,
+) -> np.ndarray:
+    """Host-side entry: pads, dispatches to the right compiled path, unpads."""
+    q = test_x.shape[0]
+    n = train_x.shape[0]
+    if not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT:
+        out = knn_forward(
+            jnp.asarray(train_x), jnp.asarray(train_y), jnp.asarray(test_x),
+            k=k, num_classes=num_classes, precision=precision,
+        )
+        return np.asarray(out)
+
+    train_tile = max(train_tile, k)  # per-tile top-k needs k <= tile width
+    tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
+    ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
+    qx, _ = pad_axis_to_multiple(test_x, query_tile, axis=0)
+    out = knn_forward_tiled(
+        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+        jnp.asarray(n, jnp.int32),
+        k=k, num_classes=num_classes, precision=precision,
+        query_tile=query_tile, train_tile=train_tile,
+    )
+    return np.asarray(out)[:q]
+
+
+@register("tpu")
+def predict(
+    train: Dataset,
+    test: Dataset,
+    k: int,
+    precision: str = "exact",
+    query_tile: int = 256,
+    train_tile: int = 2048,
+    force_tiled: bool = False,
+    **_unused,
+) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    return predict_arrays(
+        train.features, train.labels, test.features, k, train.num_classes,
+        precision=precision, query_tile=query_tile, train_tile=train_tile,
+        force_tiled=force_tiled,
+    )

@@ -1,0 +1,139 @@
+"""CLI driver (reference L5).
+
+The reference selects a backend by which binary you built (`make main |
+multi-thread | mpi`, Makefile:1-9) and uses fixed positional argv
+(main.cpp:118 ``./main train.arff test.arff k``; multi-thread.cpp:137 adds a
+thread count; mpi.cpp:123 gets its parallelism from ``mpiexec -np``).
+
+We preserve that convention with *personas*: the repo Makefile emits wrapper
+scripts ``./main``, ``./multi-thread``, ``./mpi``, ``./tpu`` that invoke this
+module with ``--persona``, keeping the reference's 3/4-positional-arg contract
+intact while optional trailing flags expose TPU knobs (mesh shape, precision,
+tiles — SURVEY.md §5.6). Timing wraps the classify region only, parsing
+excluded, and the result line is byte-compatible with main.cpp:146.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from knn_tpu.data.arff import load_arff
+from knn_tpu.utils.cli_format import result_line, result_json
+from knn_tpu.utils.evaluate import confusion_matrix, accuracy
+from knn_tpu.utils.timing import RegionTimer, maybe_profile
+
+# persona -> (default backend, usage string modeled on the reference's)
+_PERSONAS = {
+    "main": ("oracle", "Usage: ./main datasets/train.arff datasets/test.arff k"),
+    "multi-thread": (
+        "native-mt",
+        "Usage: ./multi-thread datasets/train.arff datasets/test.arff k numThreads",
+    ),
+    "mpi": ("tpu-sharded", "Usage: ./mpi datasets/train.arff datasets/test.arff k"),
+    "tpu": ("tpu", "Usage: ./tpu datasets/train.arff datasets/test.arff k"),
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu",
+        description="TPU-native KNN classifier (reference-parity CLI)",
+    )
+    p.add_argument("train", help="train ARFF file")
+    p.add_argument("test", help="test ARFF file")
+    p.add_argument("k", type=int, help="number of neighbors")
+    p.add_argument(
+        "threads",
+        nargs="?",
+        type=int,
+        default=None,
+        help="worker count (multi-thread persona's 4th positional arg)",
+    )
+    p.add_argument("--persona", choices=sorted(_PERSONAS), default="tpu")
+    p.add_argument("--backend", default=None, help="override the persona's backend")
+    p.add_argument("--precision", choices=["exact", "fast"], default="exact")
+    p.add_argument("--query-tile", type=int, default=256)
+    p.add_argument("--train-tile", type=int, default=2048)
+    p.add_argument("--devices", type=int, default=None,
+                   help="device count for sharded backends (default: all)")
+    p.add_argument("--json", action="store_true", help="emit structured JSON metrics")
+    p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
+    p.add_argument("--warmup", action="store_true",
+                   help="run once before timing (excludes compile time)")
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
+    stdout = stdout or sys.stdout
+    parser = build_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return e.code if isinstance(e.code, int) else 2
+
+    backend_name = args.backend or _PERSONAS[args.persona][0]
+    # Graceful degradation when the native runtime isn't built.
+    from knn_tpu.backends import available_backends, get_backend
+
+    if backend_name not in available_backends():
+        fallback = {
+            "native": "oracle",
+            "native-mt": "tpu",
+            "tpu-sharded": "tpu",
+            "tpu-train-sharded": "tpu",
+            "tpu-ring": "tpu",
+        }.get(backend_name)
+        if fallback is None:
+            print(f"error: backend '{backend_name}' unavailable", file=sys.stderr)
+            return 1
+        backend_name = fallback
+
+    try:
+        train = load_arff(args.train)
+        test = load_arff(args.test)
+        train.validate_for_knn(args.k, test)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    opts = dict(
+        precision=args.precision,
+        query_tile=args.query_tile,
+        train_tile=args.train_tile,
+    )
+    if args.threads is not None:
+        opts["num_threads"] = args.threads
+    if args.devices is not None:
+        opts["num_devices"] = args.devices
+
+    fn = get_backend(backend_name)
+    if args.warmup:
+        fn(train, test, args.k, **opts)
+    with maybe_profile(args.trace_dir):
+        with RegionTimer() as t:
+            predictions = fn(train, test, args.k, **opts)
+
+    cm = confusion_matrix(predictions, test.labels, test.num_classes)
+    acc = accuracy(cm)
+    print(
+        result_line(args.k, test.num_instances, train.num_instances, t.ms, acc),
+        file=stdout,
+    )
+    if args.json:
+        print(
+            result_json(args.k, test.num_instances, train.num_instances, t.ms, acc,
+                        backend_name),
+            file=stdout,
+        )
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

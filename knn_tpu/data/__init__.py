@@ -1,0 +1,4 @@
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.data.arff import load_arff
+
+__all__ = ["Dataset", "load_arff"]

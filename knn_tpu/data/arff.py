@@ -1,0 +1,87 @@
+"""ARFF loading front-end.
+
+Dispatch order:
+1. the native C++ parser (``knn_tpu/native/arff`` via ctypes) when its shared
+   library has been built — the production path, mirroring the reference's
+   native libarff (libarff/arff_parser.h:18);
+2. the pure-Python dialect implementation (``knn_tpu.data.pyarff``).
+
+Both emit identical dense arrays. An optional ``.npz`` cache keyed on the ARFF
+file's size+mtime+hash skips re-parsing (the reference re-parses on every run,
+and under MPI on every *rank* — mpi.cpp:136-139; the cache is our replacement
+for that replicated-IO cost).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from knn_tpu.data.dataset import Attribute, Dataset
+from knn_tpu.data import pyarff
+
+_CACHE_ENV = "KNN_TPU_ARFF_CACHE"
+
+
+def _cache_path(path: str) -> Optional[Path]:
+    cache_dir = os.environ.get(_CACHE_ENV, "")
+    if not cache_dir:
+        return None
+    st = os.stat(path)
+    key = f"{os.path.abspath(path)}:{st.st_size}:{st.st_mtime_ns}"
+    digest = hashlib.sha256(key.encode()).hexdigest()[:24]
+    return Path(cache_dir) / f"{Path(path).stem}-{digest}.npz"
+
+
+def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
+    """Parse an ARFF file into a dense :class:`Dataset`.
+
+    ``use_native``: force the C++ parser (True), force pure Python (False), or
+    auto-detect (None, default).
+    """
+    cache = _cache_path(path)
+    if cache is not None and cache.exists():
+        with np.load(cache, allow_pickle=False) as z:
+            attrs = [
+                Attribute(a["name"], a["type"], a.get("nominal_values"))
+                for a in json.loads(str(z["attributes"]))
+            ]
+            return Dataset(
+                features=z["features"],
+                labels=z["labels"],
+                relation=str(z["relation"]),
+                attributes=attrs,
+            )
+
+    ds: Optional[Dataset] = None
+    if use_native is not False:
+        try:
+            from knn_tpu.native import arff_native
+
+            ds = arff_native.parse(path)
+        except (ImportError, OSError):
+            if use_native is True:
+                raise
+    if ds is None:
+        ds = pyarff.parse_arff_file(path)
+
+    if cache is not None:
+        cache.parent.mkdir(parents=True, exist_ok=True)
+        np.savez(
+            cache,
+            features=ds.features,
+            labels=ds.labels,
+            relation=ds.relation,
+            attributes=json.dumps(
+                [
+                    {"name": a.name, "type": a.type, "nominal_values": a.nominal_values}
+                    for a in ds.attributes
+                ]
+            ),
+        )
+    return ds

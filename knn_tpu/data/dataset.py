@@ -1,0 +1,90 @@
+"""Dense dataset container.
+
+Replaces the reference's pointer-per-scalar AoS object graph
+(libarff/arff_data.h:27, arff_instance.h:18, arff_value.h:45) with a flat
+SoA representation that maps directly onto device arrays: ``float32 [N, D-1]``
+features + ``int32 [N]`` labels. The class is the *last* declared attribute,
+read as float and cast to int, exactly as the reference does
+(main.cpp:57,66,93).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Attribute:
+    """Attribute metadata (name + type), the analogue of libarff's ArffAttr
+    (arff_attr.h:17-49). ``nominal_values`` is set only for ``{a,b,c}`` attrs."""
+
+    name: str
+    type: str  # "numeric" | "string" | "date" | "nominal"
+    nominal_values: Optional[list] = None
+
+
+@dataclasses.dataclass
+class Dataset:
+    """A parsed ARFF dataset in dense form.
+
+    ``features``: float32 [N, D-1] — all attributes except the last.
+    ``labels``:   int32 [N] — the last attribute cast to int.
+    ``num_classes``: max(label)+1, the reference's lazily-cached definition
+    (libarff/arff_data.cpp:41-58).
+    Missing values (``?``) are stored as NaN in ``features``.
+    """
+
+    features: np.ndarray
+    labels: np.ndarray
+    relation: str = ""
+    attributes: Sequence[Attribute] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.features = np.ascontiguousarray(self.features, dtype=np.float32)
+        self.labels = np.ascontiguousarray(self.labels, dtype=np.int32)
+        if self.features.ndim != 2:
+            raise ValueError(f"features must be [N, D-1], got {self.features.shape}")
+        if self.labels.shape != (self.features.shape[0],):
+            raise ValueError(
+                f"labels shape {self.labels.shape} does not match N={self.features.shape[0]}"
+            )
+
+    @property
+    def num_instances(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.features.shape[1]
+
+    @property
+    def num_attributes(self) -> int:
+        """Declared attribute count including the class column."""
+        return self.features.shape[1] + 1
+
+    @property
+    def num_classes(self) -> int:
+        """max(label) + 1 over *this* dataset — the reference computes this per
+        ArffData instance (arff_data.cpp:41-58); the KNN vote uses the train
+        set's value and the confusion matrix the test set's."""
+        if self.labels.size == 0:
+            return 0
+        return int(self.labels.max()) + 1
+
+    def validate_for_knn(self, k: int, other: Optional["Dataset"] = None) -> None:
+        """Checks the reference leaves as UB (SURVEY.md §3.5.5)."""
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if k > self.num_instances:
+            raise ValueError(
+                f"k={k} exceeds the number of train instances ({self.num_instances})"
+            )
+        if (self.labels < 0).any():
+            raise ValueError("labels must be non-negative integers")
+        if other is not None and other.num_features != self.num_features:
+            raise ValueError(
+                f"train has {self.num_features} features but test has {other.num_features}"
+            )

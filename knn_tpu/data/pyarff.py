@@ -1,0 +1,202 @@
+"""Pure-Python ARFF parser implementing the reference libarff dialect.
+
+Dialect (SURVEY.md §3.4, libarff/arff_parser.cpp:23-153, arff_lexer.cpp:60-203):
+
+- ``@relation <name>``, then ``@attribute <name> <type>`` lines, then ``@data``
+  followed by one comma-separated row per line. Keywords are case-insensitive
+  (arff_utils.cpp:29-43).
+- Attribute types: NUMERIC | REAL | STRING | DATE | nominal ``{v1,v2,...}``
+  (arff_parser.cpp:69-119). INTEGER is additionally accepted as numeric.
+- ``%``-comment lines (arff_lexer.cpp:60-78).
+- Single- or double-quoted values, which may contain spaces/commas
+  (arff_lexer.cpp:159-188).
+- ``?`` denotes a missing value (arff_parser.cpp:139-141) → NaN.
+- A partial row at EOF is discarded (arff_parser.cpp:130-133,149-151).
+- Sparse ARFF (``{index value, ...}`` rows) is NOT supported, matching the
+  reference.
+
+Errors carry ``file:line`` context like libarff's THROW (arff_utils.cpp:8-20).
+
+This is the fallback/oracle implementation; the production path is the native
+C++ parser in ``knn_tpu/native/arff`` (bound via ctypes in
+``knn_tpu.data.arff``), which emits identical arrays.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional
+
+import numpy as np
+
+from knn_tpu.data.dataset import Attribute, Dataset
+
+_NUMERIC_TYPES = {"numeric", "real", "integer"}
+
+
+class ArffError(ValueError):
+    """Parse error with file:line context, mirroring libarff's THROW style."""
+
+    def __init__(self, path: str, line: int, msg: str):
+        super().__init__(f"{path}:{line}: {msg}")
+        self.path = path
+        self.line = line
+
+
+def _split_csv(line: str, path: str, lineno: int) -> list:
+    """Split a data row on commas, honoring single/double quotes."""
+    out, buf, quote = [], [], None
+    for ch in line:
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            else:
+                buf.append(ch)
+        elif ch in ("'", '"'):
+            quote = ch
+        elif ch == ",":
+            out.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(ch)
+    if quote is not None:
+        raise ArffError(path, lineno, "unterminated quoted value")
+    out.append("".join(buf).strip())
+    return out
+
+
+def _parse_attribute(rest: str, path: str, lineno: int) -> Attribute:
+    rest = rest.strip()
+    if not rest:
+        raise ArffError(path, lineno, "@attribute needs a name and a type")
+    # Name may be quoted.
+    if rest[0] in ("'", '"'):
+        q = rest[0]
+        end = rest.find(q, 1)
+        if end < 0:
+            raise ArffError(path, lineno, "unterminated quoted attribute name")
+        name, rest = rest[1:end], rest[end + 1 :].strip()
+    else:
+        parts = rest.split(None, 1)
+        if len(parts) < 2:
+            raise ArffError(path, lineno, f"@attribute '{parts[0]}' is missing a type")
+        name, rest = parts[0], parts[1].strip()
+    if not rest:
+        raise ArffError(path, lineno, f"@attribute '{name}' is missing a type")
+    if rest.startswith("{"):
+        if not rest.endswith("}"):
+            raise ArffError(path, lineno, "unterminated nominal value list")
+        values = _split_csv(rest[1:-1], path, lineno)
+        return Attribute(name, "nominal", values)
+    type_word = rest.split()[0].lower()
+    if type_word in _NUMERIC_TYPES:
+        return Attribute(name, "numeric")
+    if type_word == "string":
+        return Attribute(name, "string")
+    if type_word == "date":
+        return Attribute(name, "date")
+    raise ArffError(path, lineno, f"unsupported attribute type '{rest}'")
+
+
+def _cell_to_float(
+    tok: str, attr: Attribute, path: str, lineno: int
+) -> float:
+    if tok == "?":
+        return math.nan
+    if attr.type == "nominal":
+        try:
+            return float(attr.nominal_values.index(tok))
+        except ValueError:
+            raise ArffError(
+                path, lineno, f"value '{tok}' not in nominal set for '{attr.name}'"
+            ) from None
+    if attr.type in ("string", "date"):
+        # The reference stores these as strings; they cannot participate in the
+        # numeric distance. We reject them in feature columns at load time.
+        raise ArffError(
+            path, lineno, f"attribute '{attr.name}' of type {attr.type} is not numeric"
+        )
+    try:
+        return float(tok)
+    except ValueError:
+        raise ArffError(
+            path, lineno, f"cannot parse '{tok}' as a number for '{attr.name}'"
+        ) from None
+
+
+def parse_arff_lines(
+    lines: Iterable[str], path: str = "<memory>"
+) -> Dataset:
+    relation = ""
+    attributes: list = []
+    rows: list = []
+    in_data = False
+    pending: list = []  # cells carried across physical lines (multi-line rows)
+    pending_line = 0
+
+    for lineno, raw in enumerate(lines, start=1):
+        line = raw.strip()
+        if not line or line.startswith("%"):
+            continue
+        if not in_data and line.startswith("@"):
+            parts = line.split(None, 1)  # any whitespace separates the keyword
+            word = parts[0]
+            rest = parts[1] if len(parts) > 1 else ""
+            key = word.lower()
+            if key == "@relation":
+                relation = rest.strip().strip("'\"")
+            elif key == "@attribute":
+                attributes.append(_parse_attribute(rest, path, lineno))
+            elif key == "@data":
+                if not attributes:
+                    raise ArffError(path, lineno, "@data before any @attribute")
+                in_data = True
+            else:
+                raise ArffError(path, lineno, f"unknown keyword '{word}'")
+            continue
+        if not in_data:
+            raise ArffError(path, lineno, f"unexpected content before @data: '{line}'")
+        if line.startswith("{"):
+            raise ArffError(path, lineno, "sparse ARFF rows are not supported")
+        cells = _split_csv(line, path, lineno)
+        if pending:
+            cells = pending + cells
+            pending = []
+        # The reference's token-stream reader consumes exactly num_attributes
+        # tokens per instance regardless of line breaks (arff_parser.cpp:121-153);
+        # carry short rows forward rather than erroring immediately.
+        if len(cells) < len(attributes):
+            pending = cells
+            pending_line = lineno
+            continue
+        if len(cells) > len(attributes):
+            raise ArffError(
+                path,
+                lineno,
+                f"row has {len(cells)} values but {len(attributes)} attributes declared",
+            )
+        rows.append(
+            [_cell_to_float(tok, attr, path, lineno) for tok, attr in zip(cells, attributes)]
+        )
+    # A partial row at EOF is discarded, matching arff_parser.cpp:130-133.
+
+    if not attributes:
+        raise ArffError(path, 0, "no @attribute declarations found")
+
+    d = len(attributes)
+    if rows:
+        mat = np.asarray(rows, dtype=np.float32)
+    else:
+        mat = np.zeros((0, d), dtype=np.float32)
+    features = mat[:, : d - 1]
+    raw_labels = mat[:, d - 1]
+    if np.isnan(raw_labels).any():
+        bad = int(np.isnan(raw_labels).argmax())
+        raise ArffError(path, 0, f"instance {bad} has a missing class label")
+    labels = raw_labels.astype(np.int32)
+    return Dataset(features=features, labels=labels, relation=relation, attributes=attributes)
+
+
+def parse_arff_file(path: str) -> Dataset:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return parse_arff_lines(f, path=str(path))

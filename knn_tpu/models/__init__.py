@@ -1,0 +1,3 @@
+from knn_tpu.models.knn import KNNClassifier
+
+__all__ = ["KNNClassifier"]

@@ -1,0 +1,58 @@
+"""High-level model API.
+
+The reference exposes no reusable API — each backend's ``main()`` is the whole
+surface (main.cpp:114). ``KNNClassifier`` is the framework's model-layer
+equivalent: fit/predict/score with a pluggable execution backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from knn_tpu.backends import get_backend
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.utils.evaluate import confusion_matrix, accuracy
+
+
+class KNNClassifier:
+    """k-nearest-neighbor classifier with reference-exact tie semantics
+    (SURVEY.md §3.5) and a pluggable execution strategy.
+
+    >>> model = KNNClassifier(k=5, backend="tpu")
+    >>> model.fit(train_ds)
+    >>> preds = model.predict(test_ds)
+    >>> model.score(test_ds)
+    """
+
+    def __init__(self, k: int, backend: str = "tpu", **backend_opts):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.k = k
+        self.backend_name = backend
+        self.backend_opts = backend_opts
+        self._train: Optional[Dataset] = None
+
+    def fit(self, train: Dataset) -> "KNNClassifier":
+        train.validate_for_knn(self.k)
+        self._train = train
+        return self
+
+    @property
+    def train_(self) -> Dataset:
+        if self._train is None:
+            raise RuntimeError("call fit() before predict()/score()")
+        return self._train
+
+    def predict(self, test: Dataset) -> np.ndarray:
+        fn = get_backend(self.backend_name)
+        return fn(self.train_, test, self.k, **self.backend_opts)
+
+    def confusion_matrix(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> np.ndarray:
+        if predictions is None:
+            predictions = self.predict(test)
+        return confusion_matrix(predictions, test.labels, test.num_classes)
+
+    def score(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> float:
+        return accuracy(self.confusion_matrix(test, predictions))

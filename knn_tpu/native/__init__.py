@@ -1,0 +1,3 @@
+"""Native (C++) components: the ARFF ingest library (``native/arff``) and the
+serial/threaded runtime kernels (``native/runtime``), bound via ctypes.
+Build with ``make native`` at the repo root."""

@@ -1,0 +1,11 @@
+from knn_tpu.ops.distance import pairwise_sq_dists, pairwise_sq_dists_dot
+from knn_tpu.ops.topk import topk_smallest, merge_topk
+from knn_tpu.ops.vote import vote
+
+__all__ = [
+    "pairwise_sq_dists",
+    "pairwise_sq_dists_dot",
+    "topk_smallest",
+    "merge_topk",
+    "vote",
+]

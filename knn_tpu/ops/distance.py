@@ -1,0 +1,34 @@
+"""Pairwise squared-Euclidean distance.
+
+The reference computes ``sum_i (a_i - b_i)^2`` over the feature columns in
+float32, one scalar pair at a time (main.cpp:14-23). Two formulations:
+
+- :func:`pairwise_sq_dists` — the subtraction form ``((q - t)**2).sum(-1)``.
+  Per-pair summation over the feature axis in float32, the float-faithful form
+  used for exact prediction parity with the reference (SURVEY.md §7 hard part
+  (a)): identical rows give *exactly* 0, so the dist==0 ties the large dataset
+  exercises behave identically.
+- :func:`pairwise_sq_dists_dot` — the ``|q|^2 + |t|^2 - 2 q·t`` form, which
+  maps the dominant cost onto the MXU as a matmul. Much faster for wide
+  features (e.g. MNIST-784) but numerically fuzzier around 0; used by the
+  ``fast`` precision mode and the Pallas kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pairwise_sq_dists(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
+    """[Q, D], [N, D] -> [Q, N] squared Euclidean distances (subtraction form)."""
+    diff = queries[:, None, :] - train[None, :, :]
+    return jnp.sum(diff * diff, axis=-1)
+
+
+def pairwise_sq_dists_dot(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
+    """[Q, D], [N, D] -> [Q, N] squared distances via the MXU-friendly
+    ``|q|^2 - 2 q·t + |t|^2`` expansion, clamped at 0."""
+    q2 = jnp.sum(queries * queries, axis=-1, keepdims=True)  # [Q, 1]
+    t2 = jnp.sum(train * train, axis=-1)[None, :]  # [1, N]
+    cross = queries @ train.T  # [Q, N] — MXU
+    return jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)

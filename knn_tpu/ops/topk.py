@@ -1,0 +1,49 @@
+"""Index-stable k-smallest selection and candidate-set merging.
+
+The reference keeps a sorted k-candidate array with strict ``<`` insertion
+(main.cpp:46-61): among equal distances the earliest-scanned train index wins.
+The equivalents here:
+
+- :func:`topk_smallest` — ``lax.top_k`` on negated distances; top_k breaks
+  value ties by lowest position, which equals lowest train index when the
+  distance row is laid out in train order. Matches first-seen-wins.
+- :func:`merge_topk` — merge two candidate sets (e.g. running state + a new
+  train tile, or candidate sets gathered from shards) with an explicit
+  lexicographic ``(distance, global_index)`` sort via ``lax.sort`` with
+  ``num_keys=2``. This keeps tie-breaking correct even when candidates arrive
+  out of global-index order (the ring schedule rotates shards, so positional
+  tie-breaking would be wrong there — SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_smallest(
+    dists: jnp.ndarray, k: int, index_base: int | jnp.ndarray = 0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., N] distances -> ([..., k] dists, [..., k] int32 global indices),
+    sorted ascending by (distance, index). ``index_base`` offsets local column
+    positions into global train-row indices (for tiles/shards)."""
+    neg, idx = lax.top_k(-dists, k)
+    return -neg, (idx + index_base).astype(jnp.int32)
+
+
+def merge_topk(
+    dists_a: jnp.ndarray,
+    idx_a: jnp.ndarray,
+    dists_b: jnp.ndarray,
+    idx_b: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge two candidate sets along the last axis and keep the k best by
+    (distance, global index) — stable under any arrival order."""
+    d = jnp.concatenate([dists_a, dists_b], axis=-1)
+    i = jnp.concatenate([idx_a, idx_b], axis=-1)
+    d_sorted, i_sorted = lax.sort((d, i), dimension=-1, num_keys=2)
+    return d_sorted[..., :k], i_sorted[..., :k]

@@ -1,0 +1,21 @@
+"""Majority vote over neighbor labels.
+
+Replaces the reference's bincount + strict-``>`` argmax (main.cpp:64-78):
+ties in the vote break to the *lowest* class id, which ``jnp.argmax`` (first
+occurrence of the max) reproduces exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def vote(neighbor_labels: jnp.ndarray, num_classes: int) -> jnp.ndarray:
+    """[..., k] int labels -> [...] int32 predicted class.
+
+    One-hot segment-sum bincount over the class axis, then argmax (first max
+    wins → lowest class id on ties, matching main.cpp:69-76).
+    """
+    one_hot = (neighbor_labels[..., None] == jnp.arange(num_classes)).astype(jnp.int32)
+    counts = one_hot.sum(axis=-2)  # [..., num_classes]
+    return jnp.argmax(counts, axis=-1).astype(jnp.int32)

@@ -1,0 +1,11 @@
+"""Multi-device execution strategies over a jax.sharding.Mesh.
+
+- ``query_sharded``  — data parallelism over test queries (the MPI analogue:
+  MPI_Scatter of ranges + MPI_Gatherv of predictions, mpi.cpp:151-186, becomes
+  a sharding annotation + output sharding).
+- ``train_sharded``  — train rows sharded across the mesh with an all-gather
+  top-k candidate merge (the tensor-parallel analogue for KNN).
+- ``ring``           — ring schedule rotating train shards over ICI with a
+  running top-k (ring attention's structure with top-k accumulation).
+- ``mesh``           — mesh construction/multi-host init helpers.
+"""

@@ -1,0 +1,33 @@
+"""Canonical output line — byte-compatible with the reference's printf
+(main.cpp:146, multi-thread.cpp:203, mpi.cpp:198):
+
+  "The %i-NN classifier for %lu test instances on %lu train instances
+   required %llu ms CPU time. Accuracy was %.4f\\n"
+
+plus an opt-in structured JSON form (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def result_line(k: int, num_test: int, num_train: int, ms: int, acc: float) -> str:
+    return (
+        f"The {k}-NN classifier for {num_test} test instances on {num_train} "
+        f"train instances required {ms} ms CPU time. Accuracy was {acc:.4f}"
+    )
+
+
+def result_json(k: int, num_test: int, num_train: int, ms: int, acc: float,
+                backend: str) -> str:
+    return json.dumps(
+        {
+            "k": k,
+            "num_test": num_test,
+            "num_train": num_train,
+            "ms": ms,
+            "accuracy": round(acc, 6),
+            "backend": backend,
+        }
+    )

@@ -1,0 +1,42 @@
+"""Region timing, mirroring the reference's CLOCK_MONOTONIC_RAW pair around the
+KNN region only — parsing excluded (main.cpp:133-137). Also exposes an opt-in
+``jax.profiler`` trace for TPU runs (SURVEY.md §5.1)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+class RegionTimer:
+    """``with RegionTimer() as t: ...`` then ``t.ms`` (integer ms, matching the
+    reference's ns→ms integer division, main.cpp:144)."""
+
+    def __enter__(self):
+        self._start = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._end = time.monotonic_ns()
+        return False
+
+    @property
+    def ns(self) -> int:
+        return self._end - self._start
+
+    @property
+    def ms(self) -> int:
+        return self.ns // 1_000_000
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: Optional[str]):
+    """Wrap a region in a jax.profiler trace when ``trace_dir`` is set."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

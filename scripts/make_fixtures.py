@@ -1,0 +1,65 @@
+"""Generate synthetic ARFF fixtures with the same shape characteristics as the
+reference's dataset ladder (SURVEY.md §2.4): numeric attrs with the class as
+the last column, sentinel rows labeled 0..9 pinning num_classes=10, and test
+rows duplicated from train so dist==0 tie-breaking is exercised."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+SIZES = {
+    # name: (n_train, n_test, n_features)
+    "small": (592, 80, 7),
+    "medium": (7354, 370, 11),
+    "large": (30803, 1718, 11),
+}
+
+
+def write_arff(path: Path, x: np.ndarray, y: np.ndarray, relation: str) -> None:
+    d = x.shape[1]
+    with open(path, "w") as f:
+        f.write(f"@relation {relation}\n\n")
+        for i in range(d):
+            f.write(f"@attribute attr{i} NUMERIC\n")
+        f.write("@attribute class NUMERIC\n\n@data\n")
+        for row, label in zip(x, y):
+            f.write(",".join(f"{v:.6g}" for v in row) + f",{int(label)}\n")
+
+
+def make(size: str, out_dir: Path, seed: int = 0) -> None:
+    n_train, n_test, d = SIZES[size]
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, size=(10, d))
+    labels = rng.integers(0, 10, size=n_train)
+    x = centers[labels] + rng.normal(0, 1.5, size=(n_train, d))
+    # Sentinel rows 0..9 at the top (mirrors the reference datasets).
+    labels[:10] = np.arange(10)
+    x[:10] = centers[np.arange(10)] + rng.normal(0, 1.5, size=(10, d))
+    x = x.astype(np.float32)
+
+    # Half the test set duplicates train rows (dist==0 ties), half is fresh.
+    n_dup = n_test // 2
+    dup_idx = rng.choice(n_train, size=n_dup, replace=False)
+    tl = rng.integers(0, 10, size=n_test - n_dup)
+    tx = np.concatenate(
+        [x[dup_idx], (centers[tl] + rng.normal(0, 1.5, size=(n_test - n_dup, d))).astype(np.float32)]
+    )
+    ty = np.concatenate([labels[dup_idx], tl])
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    write_arff(out_dir / f"{size}-train.arff", x, labels, f"{size}-train")
+    write_arff(out_dir / f"{size}-test.arff", tx, ty, f"{size}-test")
+
+
+def main():
+    out = Path(sys.argv[1]) if len(sys.argv) > 1 else Path("build/fixtures")
+    for size in SIZES:
+        make(size, out)
+    print(f"fixtures written to {out}")
+
+
+if __name__ == "__main__":
+    main()

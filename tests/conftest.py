@@ -1,0 +1,44 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices
+(``--xla_force_host_platform_device_count=8``) — the JAX-world fake backend
+for shard_map/mesh tests without TPU hardware (SURVEY.md §4). Must be set
+before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+from tests import fixtures
+
+
+@pytest.fixture(scope="session")
+def small():
+    return fixtures.load_pair("small")
+
+
+@pytest.fixture(scope="session")
+def medium():
+    return fixtures.load_pair("medium")
+
+
+@pytest.fixture(scope="session")
+def large():
+    return fixtures.load_pair("large")
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

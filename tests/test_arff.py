@@ -1,0 +1,157 @@
+"""ARFF ingest tests: dialect coverage (SURVEY.md §3.4) + fixture golden shapes."""
+
+import math
+
+import numpy as np
+import pytest
+
+from knn_tpu.data import pyarff
+from knn_tpu.data.arff import load_arff
+from tests import fixtures
+
+
+def parse(text: str):
+    return pyarff.parse_arff_lines(text.splitlines(), path="<test>")
+
+
+class TestDialect:
+    def test_basic_numeric(self):
+        ds = parse(
+            """@relation rel
+@attribute a NUMERIC
+@attribute b REAL
+@attribute class NUMERIC
+@data
+1.5,2,0
+3,4.25,1
+"""
+        )
+        assert ds.relation == "rel"
+        np.testing.assert_array_equal(
+            ds.features, np.array([[1.5, 2], [3, 4.25]], np.float32)
+        )
+        np.testing.assert_array_equal(ds.labels, [0, 1])
+        assert ds.num_classes == 2
+
+    def test_case_insensitive_keywords(self):
+        # Keyword matching is case-insensitive (arff_utils.cpp:29-43).
+        ds = parse(
+            "@RELATION r\n@ATTRIBUTE x numeric\n@Attribute class Numeric\n@DATA\n1,0\n"
+        )
+        assert ds.num_instances == 1
+
+    def test_comments_and_blank_lines(self):
+        # % comments at line start are skipped (arff_lexer.cpp:60-78).
+        ds = parse(
+            "% header comment\n@relation r\n\n@attribute x NUMERIC\n"
+            "@attribute class NUMERIC\n% mid comment\n@data\n% data comment\n1,0\n"
+        )
+        assert ds.num_instances == 1
+
+    def test_missing_value_is_nan(self):
+        # '?' -> missing (arff_parser.cpp:139-141).
+        ds = parse(
+            "@relation r\n@attribute x NUMERIC\n@attribute y NUMERIC\n"
+            "@attribute class NUMERIC\n@data\n?,2,0\n"
+        )
+        assert math.isnan(ds.features[0, 0])
+        assert ds.features[0, 1] == 2
+
+    def test_nominal_attribute(self):
+        # Nominal {a,b,c} attrs (arff_parser.cpp:69-119) -> category index.
+        ds = parse(
+            "@relation r\n@attribute color {red, green, blue}\n"
+            "@attribute class NUMERIC\n@data\ngreen,0\nred,1\n"
+        )
+        np.testing.assert_array_equal(ds.features[:, 0], [1.0, 0.0])
+        assert ds.attributes[0].nominal_values == ["red", "green", "blue"]
+
+    def test_quoted_values(self):
+        # Quoted strings incl. spaces (arff_lexer.cpp:159-188).
+        ds = parse(
+            "@relation r\n@attribute c {'light red', 'dark blue'}\n"
+            "@attribute class NUMERIC\n@data\n'dark blue',0\n"
+        )
+        assert ds.features[0, 0] == 1.0
+
+    def test_quoted_attribute_name(self):
+        ds = parse(
+            "@relation r\n@attribute 'my attr' NUMERIC\n"
+            "@attribute class NUMERIC\n@data\n1,0\n"
+        )
+        assert ds.attributes[0].name == "my attr"
+
+    def test_partial_row_at_eof_discarded(self):
+        # arff_parser.cpp:130-133,149-151.
+        ds = parse(
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,0\n2\n"
+        )
+        assert ds.num_instances == 1
+
+    def test_sparse_rejected(self):
+        with pytest.raises(pyarff.ArffError, match="sparse"):
+            parse(
+                "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+                "@data\n{0 1, 1 0}\n"
+            )
+
+    def test_bad_number_has_location(self):
+        with pytest.raises(pyarff.ArffError, match="<test>:5"):
+            parse(
+                "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+                "@data\nabc,0\n"
+            )
+
+    def test_too_many_values(self):
+        with pytest.raises(pyarff.ArffError, match="3 values"):
+            parse(
+                "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+                "@data\n1,2,3\n"
+            )
+
+    def test_unknown_nominal_value(self):
+        with pytest.raises(pyarff.ArffError, match="not in nominal set"):
+            parse(
+                "@relation r\n@attribute c {a,b}\n@attribute class NUMERIC\n"
+                "@data\nz,0\n"
+            )
+
+    def test_missing_class_rejected(self):
+        with pytest.raises(pyarff.ArffError, match="missing class"):
+            parse(
+                "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+                "@data\n1,?\n"
+            )
+
+
+class TestFixtures:
+    def test_shapes(self, small, medium, large):
+        expect = {
+            "small": (592, 80, 7),
+            "medium": (7354, 370, 11),
+            "large": (30803, 1718, 11),
+        }
+        for name, (train, test) in zip(
+            ["small", "medium", "large"], [small, medium, large]
+        ):
+            n, q, d = expect[name]
+            assert train.features.shape == (n, d)
+            assert test.features.shape == (q, d)
+            assert train.num_classes == 10
+            assert train.features.dtype == np.float32
+            assert train.labels.dtype == np.int32
+
+    def test_sentinel_rows_pin_num_classes(self, large):
+        train, test = large
+        # First rows carry sentinel labels (SURVEY.md §2.4).
+        assert train.num_classes == 10
+        assert test.num_classes == 10
+
+    def test_large_test_subset_of_train(self, large):
+        # dist==0 ties are real in the headline config (SURVEY.md §2.4).
+        if not fixtures.using_reference_datasets():
+            pytest.skip("synthetic fixtures: only half the test set duplicates train")
+        train, test = large
+        train_rows = {r.tobytes() for r in train.features}
+        assert all(r.tobytes() in train_rows for r in test.features)

@@ -1,0 +1,87 @@
+"""CLI contract tests: positional argv, personas, byte-compatible output line
+(main.cpp:146), error handling (SURVEY.md §5.5-5.6)."""
+
+import io
+import re
+
+import pytest
+
+from knn_tpu.cli import run
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def paths():
+    d = fixtures.datasets_dir()
+    return str(d / "small-train.arff"), str(d / "small-test.arff")
+
+
+# The reference's printf contract (main.cpp:146).
+LINE_RE = re.compile(
+    r"^The (\d+)-NN classifier for (\d+) test instances on (\d+) train instances "
+    r"required (\d+) ms CPU time\. Accuracy was (\d\.\d{4})$"
+)
+
+
+class TestCli:
+    def test_output_line_contract(self, paths):
+        out = io.StringIO()
+        assert run([paths[0], paths[1], "3", "--backend", "oracle"], stdout=out) == 0
+        m = LINE_RE.match(out.getvalue().strip())
+        assert m, f"output line does not match reference contract: {out.getvalue()!r}"
+        assert m.group(1) == "3"
+        assert m.group(2) == "80"
+        assert m.group(3) == "592"
+
+    @pytest.mark.skipif(
+        not fixtures.using_reference_datasets(), reason="reference datasets required"
+    )
+    def test_small_k1_accuracy_field(self, paths):
+        out = io.StringIO()
+        assert run([paths[0], paths[1], "1", "--backend", "tpu"], stdout=out) == 0
+        assert out.getvalue().strip().endswith("Accuracy was 0.8500")
+
+    def test_personas_share_one_algorithm(self, paths):
+        accs = []
+        for persona_args in (
+            ["--persona", "main"],
+            ["--persona", "tpu"],
+        ):
+            out = io.StringIO()
+            assert run([paths[0], paths[1], "5"] + persona_args, stdout=out) == 0
+            accs.append(out.getvalue().strip().rsplit(" ", 1)[-1])
+        assert len(set(accs)) == 1
+
+    def test_multithread_persona_accepts_thread_count(self, paths):
+        # ./multi-thread train test k numThreads (multi-thread.cpp:137).
+        out = io.StringIO()
+        assert (
+            run([paths[0], paths[1], "5", "4", "--persona", "multi-thread"], stdout=out)
+            == 0
+        )
+        assert LINE_RE.match(out.getvalue().splitlines()[0].strip())
+
+    def test_json_flag(self, paths):
+        out = io.StringIO()
+        assert run([paths[0], paths[1], "1", "--backend", "oracle", "--json"], stdout=out) == 0
+        import json
+
+        lines = out.getvalue().strip().splitlines()
+        rec = json.loads(lines[-1])
+        assert rec["k"] == 1 and rec["num_test"] == 80
+
+    def test_missing_file_clean_error(self, capsys):
+        assert run(["/nope/train.arff", "/nope/test.arff", "1"]) == 1
+        assert "error:" in capsys.readouterr().err
+
+    def test_bad_k_clean_error(self, paths, capsys):
+        assert run([paths[0], paths[1], "999999"]) == 1
+        assert "exceeds" in capsys.readouterr().err
+
+    def test_malformed_arff_clean_error(self, tmp_path, capsys):
+        bad = tmp_path / "bad.arff"
+        bad.write_text(
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\nabc,0\n"
+        )
+        assert run([str(bad), str(bad), "1"]) == 1
+        assert "error:" in capsys.readouterr().err

@@ -1,0 +1,114 @@
+"""Oracle backend: golden accuracies + kernel-contract property tests
+(SURVEY.md §3.5)."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.backends.oracle import knn_oracle, predict as oracle_predict
+from knn_tpu.models.knn import KNNClassifier
+from tests import fixtures
+
+
+needs_reference = pytest.mark.skipif(
+    not fixtures.using_reference_datasets(),
+    reason="golden accuracies only valid for the reference datasets",
+)
+
+
+class TestGoldenAccuracy:
+    """Measured from the reference binaries; serial ≡ pthread (BASELINE.md)."""
+
+    @needs_reference
+    @pytest.mark.parametrize(
+        "size,k",
+        [("small", 1), ("small", 5), ("medium", 5), ("large", 1), ("large", 5), ("large", 10)],
+    )
+    def test_golden(self, size, k, request):
+        train, test = request.getfixturevalue(size)
+        model = KNNClassifier(k=k, backend="oracle").fit(train)
+        acc = model.score(test)
+        assert round(acc, 4) == fixtures.GOLDEN_ACCURACY[(size, k)]
+
+
+class TestKernelContract:
+    def test_distance_excludes_class_column(self):
+        # Class is the last attribute and never enters the distance (main.cpp:17).
+        train_x = np.array([[0.0, 0.0], [10.0, 10.0]], np.float32)
+        train_y = np.array([7, 3], np.int32)
+        test_x = np.array([[0.1, 0.1]], np.float32)
+        assert knn_oracle(train_x, train_y, test_x, 1, 10)[0] == 7
+
+    def test_distance_tie_first_train_index_wins(self):
+        # Equal distances: earliest-scanned train index wins (main.cpp:46-61).
+        train_x = np.array([[1.0], [1.0], [1.0]], np.float32)
+        train_y = np.array([5, 2, 9], np.int32)
+        test_x = np.array([[1.0]], np.float32)
+        assert knn_oracle(train_x, train_y, test_x, 1, 10)[0] == 5
+        # k=2 keeps indices 0 and 1 -> vote tie 5 vs 2 -> lowest class id (2).
+        assert knn_oracle(train_x, train_y, test_x, 2, 10)[0] == 2
+
+    def test_vote_tie_lowest_class_wins(self):
+        # Strict > argmax from -1 (main.cpp:69-76).
+        train_x = np.array([[0.0], [1.0], [2.0], [3.0]], np.float32)
+        train_y = np.array([8, 1, 8, 1], np.int32)
+        test_x = np.array([[1.5]], np.float32)
+        # k=4: two votes each for 1 and 8 -> predict 1.
+        assert knn_oracle(train_x, train_y, test_x, 4, 10)[0] == 1
+
+    def test_k_equals_n(self):
+        train_x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        train_y = np.array([0, 1, 1], np.int32)
+        test_x = np.array([[0.0, 0.0]], np.float32)
+        assert knn_oracle(train_x, train_y, test_x, 3, 2)[0] == 1
+
+    def test_k_greater_than_n_rejected(self, small):
+        # The reference makes this UB (SURVEY.md §3.5.5); we validate.
+        train, test = small
+        with pytest.raises(ValueError, match="exceeds"):
+            oracle_predict(train, test, train.num_instances + 1)
+
+    def test_k_zero_rejected(self, small):
+        train, test = small
+        with pytest.raises(ValueError, match="k must be"):
+            KNNClassifier(k=0)
+
+    def test_feature_dim_mismatch_rejected(self, small, medium):
+        with pytest.raises(ValueError, match="features"):
+            oracle_predict(small[0], medium[1], 1)
+
+    def test_against_bruteforce(self, rng):
+        """Property test vs a literal transcription of the insertion-sort kernel."""
+        for _ in range(10):
+            n, q, d, k, c = 40, 12, 3, 5, 4
+            train_x = rng.integers(0, 4, (n, d)).astype(np.float32)  # many ties
+            train_y = rng.integers(0, c, n).astype(np.int32)
+            test_x = rng.integers(0, 4, (q, d)).astype(np.float32)
+            got = knn_oracle(train_x, train_y, test_x, k, c)
+            want = _bruteforce(train_x, train_y, test_x, k, c)
+            np.testing.assert_array_equal(got, want)
+
+
+def _bruteforce(train_x, train_y, test_x, k, num_classes):
+    """Direct transcription of the reference candidate-insertion loop
+    (main.cpp:40-82) in Python, as an independent contract witness."""
+    out = []
+    for qx in test_x:
+        cand = [(np.float32(np.finfo(np.float32).max), -1)] * k
+        for i, tx in enumerate(train_x):
+            dist = np.float32(0)
+            for a, b in zip(qx, tx):
+                dist += np.float32((a - b) * (a - b))
+            for c in range(k):
+                if dist < cand[c][0]:  # strict < : first-seen wins ties
+                    cand = cand[:c] + [(dist, int(train_y[i]))] + cand[c:-1]
+                    break
+        counts = [0] * num_classes
+        for _, lbl in cand:
+            if lbl >= 0:
+                counts[lbl] += 1
+        best, best_c = -1, 0
+        for ci, cnt in enumerate(counts):
+            if cnt > best:  # strict > : lowest class wins ties
+                best, best_c = cnt, ci
+        out.append(best_c)
+    return np.array(out, np.int32)

@@ -1,0 +1,89 @@
+"""Single-device jit backend: exact prediction parity with the oracle
+(predictions, not just accuracy — SURVEY.md §4), full vs tiled equivalence,
+golden accuracies."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.backends.oracle import knn_oracle
+from knn_tpu.backends.tpu import predict_arrays
+from knn_tpu.models.knn import KNNClassifier
+from tests import fixtures
+
+
+class TestParityWithOracle:
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_small_exact_prediction_parity(self, small, k):
+        train, test = small
+        want = knn_oracle(train.features, train.labels, test.features, k, train.num_classes)
+        got = predict_arrays(
+            train.features, train.labels, test.features, k, train.num_classes
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_medium_exact_prediction_parity(self, medium):
+        train, test = medium
+        want = knn_oracle(train.features, train.labels, test.features, 5, train.num_classes)
+        got = predict_arrays(
+            train.features, train.labels, test.features, 5, train.num_classes
+        )
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_tiled_matches_full(self, medium, k):
+        train, test = medium
+        full = predict_arrays(
+            train.features, train.labels, test.features, k, train.num_classes
+        )
+        tiled = predict_arrays(
+            train.features, train.labels, test.features, k, train.num_classes,
+            force_tiled=True, query_tile=128, train_tile=512,
+        )
+        np.testing.assert_array_equal(tiled, full)
+
+    def test_tiled_ragged_edges(self, rng):
+        # Shapes deliberately not multiples of the tile sizes.
+        n, q, d, k, c = 1037, 101, 5, 7, 6
+        train_x = rng.normal(size=(n, d)).astype(np.float32)
+        train_y = rng.integers(0, c, n).astype(np.int32)
+        test_x = rng.normal(size=(q, d)).astype(np.float32)
+        want = knn_oracle(train_x, train_y, test_x, k, c)
+        got = predict_arrays(
+            train_x, train_y, test_x, k, c,
+            force_tiled=True, query_tile=64, train_tile=256,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_duplicate_rows_tie_stability(self, rng):
+        # Many exact-duplicate train rows across tile boundaries: the winning
+        # candidate must be the lowest global train index (SURVEY.md §7 (b)).
+        base = rng.integers(0, 3, (64, 4)).astype(np.float32)
+        train_x = np.tile(base, (8, 1))  # 512 rows, every row repeated 8x
+        train_y = rng.integers(0, 5, 512).astype(np.int32)
+        test_x = base[:16]
+        want = knn_oracle(train_x, train_y, test_x, 9, 5)
+        got = predict_arrays(
+            train_x, train_y, test_x, 9, 5,
+            force_tiled=True, query_tile=8, train_tile=128,
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+class TestGolden:
+    @pytest.mark.skipif(
+        not fixtures.using_reference_datasets(), reason="reference datasets required"
+    )
+    @pytest.mark.parametrize("size,k", [("small", 1), ("small", 5), ("medium", 5)])
+    def test_golden_accuracy(self, size, k, request):
+        train, test = request.getfixturevalue(size)
+        model = KNNClassifier(k=k, backend="tpu").fit(train)
+        assert round(model.score(test), 4) == fixtures.GOLDEN_ACCURACY[(size, k)]
+
+    @pytest.mark.slow
+    @pytest.mark.skipif(
+        not fixtures.using_reference_datasets(), reason="reference datasets required"
+    )
+    def test_golden_accuracy_large(self, large):
+        train, test = large
+        model = KNNClassifier(k=5, backend="tpu", force_tiled=True).fit(train)
+        assert round(model.score(test), 4) == 0.9948
