@@ -1,0 +1,116 @@
+"""Benchmark: the BASELINE.json headline metric.
+
+Classifies large-test.arff (1,718 queries) against large-train.arff (30,803
+rows, 11 features) at k=5 on the available accelerator and reports steady-state
+query throughput vs the measured reference baseline (serial C++ at -O0:
+138.6 q/s, 12,398 ms — BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "queries/sec", "vs_baseline": N}
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+BASELINE_QPS = 138.6  # reference serial, large k=5 (BASELINE.md)
+GOLDEN_ACC = 0.9948
+K = 5
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def load_large():
+    from knn_tpu.data.arff import load_arff
+
+    ref = Path("/root/reference/datasets")
+    if ref.exists():
+        return (
+            load_arff(str(ref / "large-train.arff")),
+            load_arff(str(ref / "large-test.arff")),
+            True,
+        )
+    # Synthetic fallback with the same shapes.
+    import subprocess
+
+    out = Path(__file__).parent / "build" / "fixtures"
+    if not (out / "large-train.arff").exists():
+        subprocess.run(
+            [sys.executable, str(Path(__file__).parent / "scripts" / "make_fixtures.py"), str(out)],
+            check=True,
+        )
+    return (
+        load_arff(str(out / "large-train.arff")),
+        load_arff(str(out / "large-test.arff")),
+        False,
+    )
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from knn_tpu.backends.tpu import knn_forward
+    from knn_tpu.utils.evaluate import confusion_matrix, accuracy
+
+    t0 = time.monotonic()
+    train, test, is_reference = load_large()
+    log(f"loaded datasets in {time.monotonic() - t0:.1f}s "
+        f"(train {train.features.shape}, test {test.features.shape}, "
+        f"reference={is_reference})")
+    dev = jax.devices()[0]
+    log(f"device: {dev.device_kind} ({dev.platform})")
+
+    train_x = jax.device_put(jnp.asarray(train.features), dev)
+    train_y = jax.device_put(jnp.asarray(train.labels), dev)
+    test_x = jax.device_put(jnp.asarray(test.features), dev)
+    nc = train.num_classes
+
+    def step():
+        return knn_forward(train_x, train_y, test_x, k=K, num_classes=nc)
+
+    # Warmup / compile.
+    t0 = time.monotonic()
+    preds = np.asarray(step())
+    log(f"compile+first run: {time.monotonic() - t0:.2f}s")
+
+    acc = accuracy(confusion_matrix(preds, test.labels, test.num_classes))
+    log(f"accuracy: {acc:.4f} (golden {GOLDEN_ACC})")
+    if is_reference and round(acc, 4) != GOLDEN_ACC:
+        log("WARNING: accuracy does not match the reference golden value")
+
+    # Steady state: device-side timing, blocking per iteration.
+    times = []
+    for _ in range(20):
+        t0 = time.monotonic()
+        step().block_until_ready()
+        times.append(time.monotonic() - t0)
+    med = float(np.median(times))
+    qps = test.num_instances / med
+    log(f"median step: {med * 1e3:.2f} ms over {len(times)} iters "
+        f"(min {min(times)*1e3:.2f}, max {max(times)*1e3:.2f})")
+
+    print(
+        json.dumps(
+            {
+                "metric": "large_k5_query_throughput",
+                "value": round(qps, 1),
+                "unit": "queries/sec",
+                "vs_baseline": round(qps / BASELINE_QPS, 1),
+                "accuracy": round(acc, 4),
+                "median_ms": round(med * 1e3, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,7 @@ from jax import lax
 from knn_tpu.backends import register
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.ops.distance import pairwise_sq_dists, pairwise_sq_dists_dot
-from knn_tpu.ops.topk import topk_smallest, merge_topk
+from knn_tpu.ops.topk import topk_smallest, merge_topk, merge_topk_labeled
 from knn_tpu.ops.vote import vote
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
@@ -55,11 +55,7 @@ def knn_forward(
     return vote(train_y[idx], num_classes)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "num_classes", "precision", "query_tile", "train_tile"),
-)
-def knn_forward_tiled(
+def forward_tiled_core(
     train_x: jnp.ndarray,
     train_y: jnp.ndarray,
     test_x: jnp.ndarray,
@@ -111,6 +107,80 @@ def knn_forward_tiled(
     q_blocks = test_x.reshape(q_pad // query_tile, query_tile, -1)
     preds = lax.map(per_query_tile, q_blocks)
     return preds.reshape(q_pad)
+
+
+knn_forward_tiled = jax.jit(
+    forward_tiled_core,
+    static_argnames=("k", "num_classes", "precision", "query_tile", "train_tile"),
+)
+
+
+def forward_candidates_core(
+    train_x: jnp.ndarray,
+    train_y: jnp.ndarray,
+    test_x: jnp.ndarray,
+    n_train_valid: jnp.ndarray,
+    k: int,
+    precision: str = "exact",
+    query_tile: int = 128,
+    train_tile: int = 2048,
+    index_base: int | jnp.ndarray = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Like :func:`forward_tiled_core` but stops before the vote, returning the
+    per-query candidate triple ``(dists [Q,k], global_idx [Q,k], labels [Q,k])``
+    sorted by (distance, index). This is the building block the distributed
+    paths share: per-shard candidates are produced here, merged across the mesh
+    (all-gather or ring), and only then voted on — the KNN equivalent of the
+    reference's per-rank sub-predictions before MPI_Gatherv (mpi.cpp:175-186),
+    except candidates (not final votes) cross the wire so train sharding stays
+    exact.
+
+    ``index_base`` positions this shard's rows in the global train order (e.g.
+    ``axis_index * shard_rows``); local column indices beyond ``n_train_valid``
+    are masked to +inf.
+    """
+    n_pad = train_x.shape[0]
+    q_pad = test_x.shape[0]
+    assert n_pad % train_tile == 0 and q_pad % query_tile == 0
+    n_tiles = n_pad // train_tile
+    kk = min(k, train_tile)
+    dist_fn = _DIST_FNS[precision]
+    train_tiles_x = train_x.reshape(n_tiles, train_tile, -1)
+    train_tiles_y = train_y.reshape(n_tiles, train_tile)
+
+    def per_query_tile(q_block):
+        def scan_tile(carry, inp):
+            run_d, run_i, run_l = carry
+            t_idx, t_x, t_y = inp
+            d = dist_fn(q_block, t_x)
+            col = t_idx * train_tile + jnp.arange(train_tile)
+            d = jnp.where(col[None, :] < n_train_valid, d, jnp.inf)
+            tile_d, local_i = lax.top_k(-d, kk)
+            tile_d = -tile_d
+            tile_l = t_y[local_i]
+            tile_i = (local_i + t_idx * train_tile + index_base).astype(jnp.int32)
+            merged = merge_topk_labeled(
+                run_d, run_i, run_l, tile_d, tile_i, tile_l, k
+            )
+            return merged, None
+
+        init = (
+            jnp.full((query_tile, k), jnp.inf, train_x.dtype),
+            jnp.full((query_tile, k), jnp.iinfo(jnp.int32).max, jnp.int32),
+            jnp.zeros((query_tile, k), train_y.dtype),
+        )
+        (run_d, run_i, run_l), _ = lax.scan(
+            scan_tile, init, (jnp.arange(n_tiles), train_tiles_x, train_tiles_y)
+        )
+        return run_d, run_i, run_l
+
+    q_blocks = test_x.reshape(q_pad // query_tile, query_tile, -1)
+    d, i, l = lax.map(per_query_tile, q_blocks)
+    return (
+        d.reshape(q_pad, k),
+        i.reshape(q_pad, k),
+        l.reshape(q_pad, k),
+    )
 
 
 # [Q, N] float32 distance-matrix cells above which the tiled path is used.

@@ -59,6 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--train-tile", type=int, default=2048)
     p.add_argument("--devices", type=int, default=None,
                    help="device count for sharded backends (default: all)")
+    p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
+                   help="force a JAX platform (e.g. cpu, tpu) before backend init")
     p.add_argument("--json", action="store_true", help="emit structured JSON metrics")
     p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--warmup", action="store_true",
@@ -73,6 +75,17 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         args = parser.parse_args(argv)
     except SystemExit as e:
         return e.code if isinstance(e.code, int) else 2
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    # Multi-host init (the MPI_Init analogue) — no-op unless a cluster
+    # launcher set coordinator env vars.
+    from knn_tpu.parallel.mesh import maybe_init_distributed
+
+    maybe_init_distributed()
 
     backend_name = args.backend or _PERSONAS[args.persona][0]
     # Graceful degradation when the native runtime isn't built.

@@ -1,5 +1,10 @@
 from knn_tpu.ops.distance import pairwise_sq_dists, pairwise_sq_dists_dot
-from knn_tpu.ops.topk import topk_smallest, merge_topk
+from knn_tpu.ops.topk import (
+    topk_smallest,
+    merge_topk,
+    merge_topk_labeled,
+    sort_candidates_labeled,
+)
 from knn_tpu.ops.vote import vote
 
 __all__ = [
@@ -7,5 +12,7 @@ __all__ = [
     "pairwise_sq_dists_dot",
     "topk_smallest",
     "merge_topk",
+    "merge_topk_labeled",
+    "sort_candidates_labeled",
     "vote",
 ]

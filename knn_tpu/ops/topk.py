@@ -34,6 +34,34 @@ def topk_smallest(
     return -neg, (idx + index_base).astype(jnp.int32)
 
 
+def sort_candidates_labeled(
+    dists: jnp.ndarray, idx: jnp.ndarray, labels: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort (distance, global-index, label) triples lexicographically by
+    (distance, index) along the last axis — the single definition of the
+    tie-break rule every merging path shares."""
+    return lax.sort((dists, idx, labels), dimension=-1, num_keys=2)
+
+
+def merge_topk_labeled(
+    dists_a: jnp.ndarray,
+    idx_a: jnp.ndarray,
+    labels_a: jnp.ndarray,
+    dists_b: jnp.ndarray,
+    idx_b: jnp.ndarray,
+    labels_b: jnp.ndarray,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Merge two label-carrying candidate sets and keep the k best by
+    (distance, global index) — stable under any arrival order (tiles, shards,
+    ring rotations)."""
+    d = jnp.concatenate([dists_a, dists_b], axis=-1)
+    i = jnp.concatenate([idx_a, idx_b], axis=-1)
+    l = jnp.concatenate([labels_a, labels_b], axis=-1)
+    s_d, s_i, s_l = sort_candidates_labeled(d, i, l)
+    return s_d[..., :k], s_i[..., :k], s_l[..., :k]
+
+
 def merge_topk(
     dists_a: jnp.ndarray,
     idx_a: jnp.ndarray,

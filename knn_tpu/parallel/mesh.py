@@ -1,0 +1,73 @@
+"""Mesh construction and multi-host initialization.
+
+The reference's communicator setup is ``MPI_Init / Comm_rank / Comm_size``
+(mpi.cpp:130-132); the TPU-native equivalent is a ``jax.sharding.Mesh`` over
+the device grid, with ``jax.distributed.initialize`` for multi-host (DCN)
+deployments (SURVEY.md §5.8). Collectives then ride ICI within a slice and DCN
+across slices — chosen by XLA from the sharding layout, not hand-written.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def maybe_init_distributed() -> None:
+    """Initialize multi-host JAX when launched under a cluster runtime.
+
+    The single-controller analogue of MPI_Init (mpi.cpp:130). No-ops unless
+    cluster environment variables are present (set by the launcher), so
+    single-host runs need no configuration — matching ``mpiexec -np`` being
+    the only knob the reference exposes.
+    """
+    # Check env FIRST: jax.process_count() would initialize the local backend,
+    # and jax.distributed.initialize() must run before any backend init.
+    if not (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+    ):
+        return
+    try:
+        jax.distributed.initialize()
+    except RuntimeError:
+        pass  # already initialized (e.g. by the launcher)
+
+
+def make_mesh(
+    num_devices: Optional[int] = None, axis_names: Sequence[str] = ("q",)
+) -> Mesh:
+    """1-D mesh over the first ``num_devices`` devices (default: all)."""
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices but only {len(devices)} available")
+    return Mesh(np.array(devices[:n]), axis_names=tuple(axis_names))
+
+
+def make_mesh_2d(
+    q_devices: int, t_devices: int, axis_names: Tuple[str, str] = ("q", "t")
+) -> Mesh:
+    """2-D (query × train) mesh: data parallelism over queries on one axis,
+    train-set sharding (the tensor-parallel analogue) on the other."""
+    devices = jax.devices()
+    need = q_devices * t_devices
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {q_devices}x{t_devices} needs {need} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(q_devices, t_devices)
+    return Mesh(grid, axis_names=axis_names)
+
+
+def default_mesh_shape(n: int) -> Tuple[int, int]:
+    """Factor ``n`` into (q, t) as close to square as possible, favoring the
+    query (pure-DP) axis for any remainder."""
+    t = int(np.floor(np.sqrt(n)))
+    while t > 1 and n % t:
+        t -= 1
+    return n // t, t

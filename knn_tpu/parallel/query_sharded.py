@@ -1,0 +1,120 @@
+"""Query-sharded data parallelism — the MPI backend's TPU-native replacement.
+
+The reference scatters contiguous ``[start, end)`` query ranges to P ranks
+(``MPI_Scatter``, mpi.cpp:173), each rank classifies its slice, and rank 0
+reassembles with ``MPI_Gatherv`` (mpi.cpp:186). Here the same structure is a
+``shard_map`` over the mesh's query axis: the in_spec IS the scatter, the
+out_spec IS the gather, and XLA emits the collectives over ICI/DCN. Ragged
+query counts (Gatherv's variable per-rank lengths) become pad + slice
+(SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from knn_tpu.backends import register
+from knn_tpu.backends.tpu import forward_tiled_core
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.parallel.mesh import make_mesh
+from knn_tpu.utils.padding import pad_axis_to_multiple
+
+
+def build_query_sharded_fn(
+    mesh: Mesh,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+    query_tile: int = 128,
+    train_tile: int = 2048,
+    axis: str = "q",
+):
+    """Returns a jitted fn(train_x, train_y, test_x, n_train_valid) -> preds.
+
+    test_x must be padded to ``mesh.shape[axis] * query_tile`` multiples and
+    train to ``train_tile`` multiples. Train data is replicated to every
+    device, exactly as every MPI rank loads both files (mpi.cpp:136-139).
+    """
+
+    def per_shard(train_x, train_y, test_block, n_valid):
+        return forward_tiled_core(
+            train_x, train_y, test_block, n_valid,
+            k=k, num_classes=num_classes, precision=precision,
+            query_tile=query_tile, train_tile=train_tile,
+        )
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_fn(n_dev, k, num_classes, precision, query_tile, train_tile):
+    # Cache the jitted shard_map closure so repeat predicts (and --warmup)
+    # reuse XLA's compile cache instead of retracing a fresh closure.
+    mesh = make_mesh(n_dev, axis_names=("q",))
+    return build_query_sharded_fn(
+        mesh, k, num_classes, precision, query_tile, train_tile
+    )
+
+
+def predict_query_sharded(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    num_devices: Optional[int] = None,
+    precision: str = "exact",
+    query_tile: int = 128,
+    train_tile: int = 2048,
+    mesh: Optional[Mesh] = None,
+) -> np.ndarray:
+    q = test_x.shape[0]
+    train_tile = max(min(train_tile, train_x.shape[0]), k)
+    if mesh is not None:
+        n_dev = mesh.shape["q"]
+        fn = build_query_sharded_fn(
+            mesh, k, num_classes, precision, query_tile, train_tile
+        )
+    else:
+        n_dev = num_devices or len(jax.devices())
+        fn = _cached_fn(n_dev, k, num_classes, precision, query_tile, train_tile)
+    qx, _ = pad_axis_to_multiple(test_x, n_dev * query_tile, axis=0)
+    tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
+    ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
+    out = fn(
+        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+        jnp.asarray(train_x.shape[0], jnp.int32),
+    )
+    return np.asarray(out)[:q]
+
+
+@register("tpu-sharded")
+def predict(
+    train: Dataset,
+    test: Dataset,
+    k: int,
+    num_devices: Optional[int] = None,
+    precision: str = "exact",
+    query_tile: int = 128,
+    train_tile: int = 2048,
+    **_unused,
+) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    return predict_query_sharded(
+        train.features, train.labels, test.features, k, train.num_classes,
+        num_devices=num_devices, precision=precision,
+        query_tile=query_tile, train_tile=train_tile,
+    )

@@ -1,0 +1,151 @@
+"""Ring-scheduled KNN — ring attention's structure with a top-k accumulator
+(SURVEY.md §5.7).
+
+Both queries and train rows are sharded over one mesh axis. Each step, every
+device scores its resident query block against the train shard it currently
+holds, folds the results into a running top-k candidate set, and passes the
+shard to its ring neighbor via ``lax.ppermute`` over ICI. After P steps every
+query block has seen every train row while no device ever held more than
+1/P-th of the train set — the same memory/comm trade ring attention makes with
+KV blocks, with the (associative, commutative) lexicographic top-k merge in
+place of softmax accumulation. Because the merge keys on (distance,
+global-index), tie semantics are preserved even though shards arrive in
+rotated (non-index) order — the case positional tie-breaking would get wrong
+(SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from knn_tpu.backends import register
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.ops.distance import pairwise_sq_dists, pairwise_sq_dists_dot
+from knn_tpu.ops.topk import merge_topk_labeled
+from knn_tpu.ops.vote import vote
+from knn_tpu.parallel.mesh import make_mesh
+from knn_tpu.utils.padding import pad_axis_to_multiple
+
+_DIST_FNS = {"exact": pairwise_sq_dists, "fast": pairwise_sq_dists_dot}
+
+
+def build_ring_fn(
+    mesh: Mesh,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+    axis: str = "r",
+):
+    """fn(train_x, train_y, test_x, n_train_valid) -> preds; train and test
+    both sharded over ``axis``."""
+    n_dev = mesh.shape[axis]
+    dist_fn = _DIST_FNS[precision]
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def per_shard(train_x, train_y, test_block, n_valid):
+        shard_rows = train_x.shape[0]
+        kk = min(k, shard_rows)
+        my = lax.axis_index(axis)
+
+        def score_and_merge(run, cur_x, cur_y, owner):
+            """Fold the currently-held shard into the running candidates."""
+            run_d, run_i, run_l = run
+            base = (owner * shard_rows).astype(jnp.int32)
+            d = dist_fn(test_block, cur_x)  # [q_local, shard_rows]
+            local_valid = jnp.clip(n_valid - owner * shard_rows, 0, shard_rows)
+            d = jnp.where(jnp.arange(shard_rows)[None, :] < local_valid, d, jnp.inf)
+            neg, li = lax.top_k(-d, kk)
+            return merge_topk_labeled(
+                run_d, run_i, run_l,
+                -neg, (li + base).astype(jnp.int32), cur_y[li],
+                k,
+            )
+
+        q_local = test_block.shape[0]
+        run = (
+            jnp.full((q_local, k), jnp.inf, train_x.dtype),
+            jnp.full((q_local, k), jnp.iinfo(jnp.int32).max, jnp.int32),
+            jnp.zeros((q_local, k), train_y.dtype),
+        )
+        # Step 0: score the resident shard; steps 1..P-1: rotate, then score —
+        # so only P-1 ppermute rounds cross the wire.
+        run = score_and_merge(run, train_x, train_y, my)
+
+        def step(carry, s):
+            cur_x, cur_y, run_d, run_i, run_l = carry
+            cur_x = lax.ppermute(cur_x, axis, perm)
+            cur_y = lax.ppermute(cur_y, axis, perm)
+            # After s hops we hold the shard that started at device my - s.
+            owner = (my - s) % n_dev
+            run = score_and_merge((run_d, run_i, run_l), cur_x, cur_y, owner)
+            return (cur_x, cur_y) + run, None
+
+        if n_dev > 1:
+            (_, _, _, _, run_l), _ = lax.scan(
+                step, (train_x, train_y) + run, jnp.arange(1, n_dev)
+            )
+        else:
+            run_l = run[2]
+        return vote(run_l, num_classes)
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_fn(n_dev, k, num_classes, precision):
+    # Cache the jitted shard_map closure so repeat predicts (and --warmup)
+    # reuse XLA's compile cache instead of retracing a fresh closure.
+    mesh = make_mesh(n_dev, axis_names=("r",))
+    return build_ring_fn(mesh, k, num_classes, precision)
+
+
+def predict_ring(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    num_devices: Optional[int] = None,
+    precision: str = "exact",
+) -> np.ndarray:
+    n_dev = num_devices or len(jax.devices())
+    q = test_x.shape[0]
+    tx, _ = pad_axis_to_multiple(train_x, n_dev, axis=0)
+    ty, _ = pad_axis_to_multiple(train_y, n_dev, axis=0)
+    qx, _ = pad_axis_to_multiple(test_x, n_dev, axis=0)
+    fn = _cached_fn(n_dev, k, num_classes, precision)
+    out = fn(
+        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+        jnp.asarray(train_x.shape[0], jnp.int32),
+    )
+    return np.asarray(out)[:q]
+
+
+@register("tpu-ring")
+def predict(
+    train: Dataset,
+    test: Dataset,
+    k: int,
+    num_devices: Optional[int] = None,
+    precision: str = "exact",
+    **_unused,
+) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    return predict_ring(
+        train.features, train.labels, test.features, k, train.num_classes,
+        num_devices=num_devices, precision=precision,
+    )

@@ -1,0 +1,149 @@
+"""Train-set sharding with all-gather top-k merge — the capability the
+reference lacks entirely (SURVEY.md §2.3: the TP/"model-parallel" analogue for
+KNN; BASELINE.json config 4).
+
+Train rows are sharded across the mesh's ``t`` axis (optionally combined with
+query sharding on a ``q`` axis → 2-D mesh). Each device computes its shard's
+top-k candidates *with global train indices and labels attached*, the k·P
+candidates are all-gathered over ICI, merged with a lexicographic
+(distance, global-index) sort — preserving the reference's first-seen-wins tie
+rule regardless of shard boundaries — and only then voted on.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from knn_tpu.backends import register
+from knn_tpu.backends.tpu import forward_candidates_core
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.ops.vote import vote
+from knn_tpu.parallel.mesh import make_mesh, make_mesh_2d, default_mesh_shape
+from knn_tpu.utils.padding import pad_axis_to_multiple
+
+
+def merge_candidates_vote(
+    d: jnp.ndarray, i: jnp.ndarray, l: jnp.ndarray, k: int, num_classes: int
+) -> jnp.ndarray:
+    """[Q, C>=k] candidate triples -> [Q] predictions, tie-stable."""
+    s_d, s_i, s_l = lax.sort((d, i, l), dimension=-1, num_keys=2)
+    return vote(s_l[..., :k], num_classes)
+
+
+def build_train_sharded_fn(
+    mesh: Mesh,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+    query_tile: int = 128,
+    train_tile: int = 1024,
+    q_axis: Optional[str] = "q",
+    t_axis: str = "t",
+):
+    """fn(train_x, train_y, test_x, n_train_valid) -> preds.
+
+    train padded to ``n_t * train_tile`` multiples and sharded over ``t_axis``;
+    test padded to ``n_q * query_tile`` and sharded over ``q_axis`` (or
+    replicated when the mesh has no query axis).
+    """
+    n_t = mesh.shape[t_axis]
+    q_spec = P(q_axis) if q_axis else P()
+
+    def per_shard(train_x, train_y, test_block, n_valid):
+        # Global position of this shard's rows: shards are laid out in axis
+        # order, so axis_index * rows_per_shard is the reference scan order.
+        shard_rows = train_x.shape[0]
+        t_idx = lax.axis_index(t_axis)
+        base = (t_idx * shard_rows).astype(jnp.int32)
+        local_valid = jnp.clip(n_valid - t_idx * shard_rows, 0, shard_rows)
+        d, gi, lbl = forward_candidates_core(
+            train_x, train_y, test_block, local_valid,
+            k=k, precision=precision,
+            query_tile=query_tile, train_tile=min(train_tile, shard_rows),
+            index_base=base,
+        )
+        # k candidates/shard -> k*n_t per query, concatenated in shard order
+        # over ICI. tiled=True keeps the candidate axis flat.
+        all_d = lax.all_gather(d, t_axis, axis=1, tiled=True)
+        all_i = lax.all_gather(gi, t_axis, axis=1, tiled=True)
+        all_l = lax.all_gather(lbl, t_axis, axis=1, tiled=True)
+        return merge_candidates_vote(all_d, all_i, all_l, k, num_classes)
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(t_axis), P(t_axis), q_spec, P()),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_fn(n_q, n_t, k, num_classes, precision, query_tile, train_tile):
+    # Cache the jitted shard_map closure so repeat predicts (and --warmup)
+    # reuse XLA's compile cache instead of retracing a fresh closure.
+    mesh = make_mesh_2d(n_q, n_t)
+    return build_train_sharded_fn(
+        mesh, k, num_classes, precision, query_tile, train_tile
+    )
+
+
+def predict_train_sharded(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    num_devices: Optional[int] = None,
+    mesh_shape: Optional[Tuple[int, int]] = None,
+    precision: str = "exact",
+    query_tile: int = 128,
+    train_tile: int = 1024,
+) -> np.ndarray:
+    """2-D sharded KNN: queries over 'q', train rows over 't'."""
+    n = num_devices or len(jax.devices())
+    if mesh_shape is None:
+        mesh_shape = default_mesh_shape(n)
+    n_q, n_t = mesh_shape
+
+    q = test_x.shape[0]
+    shard_quota = -(-train_x.shape[0] // n_t)  # ceil rows per shard
+    train_tile = max(min(train_tile, shard_quota), k)
+    shard_rows = -(-shard_quota // train_tile) * train_tile
+    tx, _ = pad_axis_to_multiple(train_x, shard_rows * n_t, axis=0)
+    ty, _ = pad_axis_to_multiple(train_y, shard_rows * n_t, axis=0)
+    qx, _ = pad_axis_to_multiple(test_x, n_q * query_tile, axis=0)
+    fn = _cached_fn(n_q, n_t, k, num_classes, precision, query_tile, train_tile)
+    out = fn(
+        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+        jnp.asarray(train_x.shape[0], jnp.int32),
+    )
+    return np.asarray(out)[:q]
+
+
+@register("tpu-train-sharded")
+def predict(
+    train: Dataset,
+    test: Dataset,
+    k: int,
+    num_devices: Optional[int] = None,
+    mesh_shape: Optional[Tuple[int, int]] = None,
+    precision: str = "exact",
+    query_tile: int = 128,
+    train_tile: int = 1024,
+    **_unused,
+) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    return predict_train_sharded(
+        train.features, train.labels, test.features, k, train.num_classes,
+        num_devices=num_devices, mesh_shape=mesh_shape, precision=precision,
+        query_tile=query_tile, train_tile=train_tile,
+    )

@@ -18,6 +18,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax
+
+# The environment's sitecustomize registers the axon TPU platform
+# programmatically, overriding JAX_PLATFORMS from the env — force CPU back on
+# via the config so tests get the 8 virtual devices.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

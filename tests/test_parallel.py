@@ -1,0 +1,160 @@
+"""Multi-device strategy tests on the 8-virtual-device CPU mesh (conftest.py).
+
+Every distributed path must agree with the oracle on *predictions* — not just
+accuracy (SURVEY.md §4) — including under ragged shapes and duplicate-row ties.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from knn_tpu.backends.oracle import knn_oracle
+from knn_tpu.parallel.mesh import make_mesh, make_mesh_2d, default_mesh_shape
+from knn_tpu.parallel.query_sharded import predict_query_sharded
+from knn_tpu.parallel.train_sharded import predict_train_sharded
+from knn_tpu.parallel.ring import predict_ring
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def problem(rng=None):
+    rng = np.random.default_rng(7)
+    n, q, d, c = 1210, 133, 6, 5
+    train_x = rng.integers(0, 4, (n, d)).astype(np.float32)  # int grid → ties
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, 40, replace=False)],  # exact duplicates
+         rng.integers(0, 4, (q - 40, d)).astype(np.float32)]
+    )
+    return train_x, train_y, test_x, c
+
+
+def oracle_preds(problem, k):
+    train_x, train_y, test_x, c = problem
+    return knn_oracle(train_x, train_y, test_x, k, c)
+
+
+class TestMesh:
+    def test_eight_virtual_devices(self):
+        assert len(jax.devices()) == 8
+
+    def test_default_mesh_shape(self):
+        assert default_mesh_shape(8) == (4, 2)
+        assert default_mesh_shape(4) == (2, 2)
+        assert default_mesh_shape(7) == (7, 1)
+        assert default_mesh_shape(16) == (4, 4)
+
+    def test_make_mesh_too_many(self):
+        with pytest.raises(ValueError, match="devices"):
+            make_mesh(99)
+
+
+class TestQuerySharded:
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_matches_oracle(self, problem, k):
+        train_x, train_y, test_x, c = problem
+        got = predict_query_sharded(
+            train_x, train_y, test_x, k, c, query_tile=16, train_tile=256
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, k))
+
+    def test_subset_of_devices(self, problem):
+        train_x, train_y, test_x, c = problem
+        got = predict_query_sharded(
+            train_x, train_y, test_x, 3, c, num_devices=4, query_tile=8, train_tile=128
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, 3))
+
+    def test_single_device_mesh(self, problem):
+        train_x, train_y, test_x, c = problem
+        got = predict_query_sharded(
+            train_x, train_y, test_x, 5, c, num_devices=1, query_tile=32, train_tile=256
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, 5))
+
+
+class TestTrainSharded:
+    @pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (4, 2), (8, 1)])
+    def test_mesh_shapes_match_oracle(self, problem, mesh_shape):
+        train_x, train_y, test_x, c = problem
+        got = predict_train_sharded(
+            train_x, train_y, test_x, 5, c,
+            mesh_shape=mesh_shape, query_tile=16, train_tile=64,
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, 5))
+
+    def test_tie_stability_across_shards(self):
+        # All train rows identical: predictions must come from the k lowest
+        # *global* indices no matter the shard layout.
+        train_x = np.ones((64, 3), np.float32)
+        train_y = np.arange(64, dtype=np.int32) % 7
+        test_x = np.ones((8, 3), np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 5, 7)
+        got = predict_train_sharded(
+            train_x, train_y, test_x, 5, 7, mesh_shape=(1, 8), query_tile=8,
+            train_tile=8,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_k_larger_than_shard(self, problem):
+        # k=20 over 8 shards of ~151 rows — fine; also k > train_tile.
+        train_x, train_y, test_x, c = problem
+        got = predict_train_sharded(
+            train_x, train_y, test_x, 20, c, mesh_shape=(1, 8), query_tile=16,
+            train_tile=16,
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, 20))
+
+
+class TestRing:
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_matches_oracle(self, problem, k):
+        train_x, train_y, test_x, c = problem
+        got = predict_ring(train_x, train_y, test_x, k, c)
+        np.testing.assert_array_equal(got, oracle_preds(problem, k))
+
+    def test_tie_stability_rotated_order(self):
+        # The ring visits shards in rotated order per device; the
+        # (dist, global-index) merge must still pick lowest indices.
+        train_x = np.ones((40, 2), np.float32)
+        train_y = (np.arange(40, dtype=np.int32) * 3) % 9
+        test_x = np.ones((16, 2), np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 7, 9)
+        got = predict_ring(train_x, train_y, test_x, 7, 9)
+        np.testing.assert_array_equal(got, want)
+
+    def test_k_exceeds_shard_rows(self):
+        # 8 devices × 5 rows each; k=12 > shard size.
+        rng = np.random.default_rng(3)
+        train_x = rng.normal(size=(40, 4)).astype(np.float32)
+        train_y = rng.integers(0, 3, 40).astype(np.int32)
+        test_x = rng.normal(size=(24, 4)).astype(np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 12, 3)
+        got = predict_ring(train_x, train_y, test_x, 12, 3)
+        np.testing.assert_array_equal(got, want)
+
+
+class TestFixtureParity:
+    """Small reference fixture through every distributed path."""
+
+    @pytest.mark.parametrize("path", ["query", "train", "ring"])
+    def test_small_k5(self, small, path):
+        train, test = small
+        want = knn_oracle(
+            train.features, train.labels, test.features, 5, train.num_classes
+        )
+        if path == "query":
+            got = predict_query_sharded(
+                train.features, train.labels, test.features, 5, train.num_classes,
+                query_tile=8, train_tile=128,
+            )
+        elif path == "train":
+            got = predict_train_sharded(
+                train.features, train.labels, test.features, 5, train.num_classes,
+                mesh_shape=(2, 4), query_tile=8, train_tile=64,
+            )
+        else:
+            got = predict_ring(
+                train.features, train.labels, test.features, 5, train.num_classes
+            )
+        np.testing.assert_array_equal(got, want)
