@@ -1,0 +1,51 @@
+# Build + backend-selection convention, preserving the reference's
+# target-per-backend interface (reference Makefile:1-9: main | multi-thread |
+# mpi | clean) and adding the native libs and the tpu target. Each backend
+# target emits a wrapper script with the reference's positional CLI.
+
+CXX      ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
+LIB_DIR  := knn_tpu/native/lib
+
+.PHONY: all native main multi-thread mpi tpu test bench clean
+
+all: native main multi-thread mpi tpu
+
+native: $(LIB_DIR)/libknn_arff.so $(LIB_DIR)/libknn_runtime.so
+
+$(LIB_DIR)/libknn_arff.so: knn_tpu/native/arff/arff_c.cc
+	@mkdir -p $(LIB_DIR)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+$(LIB_DIR)/libknn_runtime.so: knn_tpu/native/runtime/knn_runtime.cc
+	@mkdir -p $(LIB_DIR)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $< -lpthread
+
+# Wrapper scripts: ./main train test k | ./multi-thread train test k T |
+# ./mpi train test k | ./tpu train test k
+define WRAPPER
+	@printf '#!/bin/sh\nexec python3 -m knn_tpu.cli --persona $(1) "$$@"\n' > $(2)
+	@chmod +x $(2)
+	@echo "wrote ./$(2)"
+endef
+
+main: native
+	$(call WRAPPER,main,main)
+
+multi-thread: native
+	$(call WRAPPER,multi-thread,multi-thread)
+
+mpi:
+	$(call WRAPPER,mpi,mpi)
+
+tpu:
+	$(call WRAPPER,tpu,tpu)
+
+test:
+	python3 -m pytest tests/ -q
+
+bench:
+	python3 bench.py
+
+clean:
+	rm -rf $(LIB_DIR) main multi-thread mpi tpu build/fixtures

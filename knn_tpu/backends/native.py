@@ -1,0 +1,89 @@
+"""Native C++ runtime backends: ``native`` (serial — the `make main` analogue)
+and ``native-mt`` (thread pool — the `make multi-thread` analogue), both over
+the single kernel in native/runtime/knn_runtime.cc with reference-exact
+semantics. Importing this module raises OSError when the shared library hasn't
+been built (``make native``); the registry treats that as "not available".
+"""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+
+import numpy as np
+
+from knn_tpu.backends import register
+from knn_tpu.data.dataset import Dataset
+
+_LIB_DIR = Path(__file__).parent.parent / "native" / "lib"
+
+
+def _load():
+    lib = ctypes.CDLL(str(_LIB_DIR / "libknn_runtime.so"))
+    lib.knn_native_predict.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.knn_native_predict.restype = ctypes.c_int
+    return lib
+
+
+_lib = _load()
+
+
+def knn_native(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    num_threads: int = 1,
+) -> np.ndarray:
+    train_x = np.ascontiguousarray(train_x, np.float32)
+    train_y = np.ascontiguousarray(train_y, np.int32)
+    test_x = np.ascontiguousarray(test_x, np.float32)
+    q = test_x.shape[0]
+    out = np.empty(q, np.int32)
+    rc = _lib.knn_native_predict(
+        train_x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        train_y.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        train_x.shape[0],
+        train_x.shape[1],
+        test_x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        q,
+        k,
+        num_classes,
+        num_threads,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise ValueError(f"knn_native_predict failed (rc={rc})")
+    return out
+
+
+@register("native")
+def predict_serial(train: Dataset, test: Dataset, k: int, **_unused) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    return knn_native(
+        train.features, train.labels, test.features, k, train.num_classes,
+        num_threads=1,
+    )
+
+
+@register("native-mt")
+def predict_mt(
+    train: Dataset, test: Dataset, k: int, num_threads: int = 0, **_unused
+) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    return knn_native(
+        train.features, train.labels, test.features, k, train.num_classes,
+        num_threads=num_threads,
+    )

@@ -43,6 +43,10 @@ def knn_oracle(
         e = min(q, s + chunk)
         diff = test_x[s:e, None, :] - train_x[None, :, :]
         dists = np.einsum("qnd,qnd->qn", diff, diff, dtype=np.float32)
+        # Framework-wide policy: NaN distances count as +inf (the reference is
+        # UB here — SURVEY.md §3.5.5); +inf candidates are admitted in
+        # (distance, index) order.
+        np.nan_to_num(dists, copy=False, nan=np.inf)
         for row in range(e - s):
             d = dists[row]
             # Stable (distance, index) ordering == first-seen-wins insertion.

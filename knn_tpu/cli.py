@@ -27,7 +27,7 @@ from knn_tpu.utils.timing import RegionTimer, maybe_profile
 
 # persona -> (default backend, usage string modeled on the reference's)
 _PERSONAS = {
-    "main": ("oracle", "Usage: ./main datasets/train.arff datasets/test.arff k"),
+    "main": ("native", "Usage: ./main datasets/train.arff datasets/test.arff k"),
     "multi-thread": (
         "native-mt",
         "Usage: ./multi-thread datasets/train.arff datasets/test.arff k numThreads",

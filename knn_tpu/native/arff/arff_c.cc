@@ -1,0 +1,381 @@
+// Native ARFF ingest library.
+//
+// Re-implements the role of the reference's libarff (arff_parser.h:18,
+// arff_lexer.h:20, arff_scanner.h:22) with a TPU-era design: instead of a
+// char-at-a-time fread scanner (arff_scanner.cpp:46) feeding a
+// pointer-per-scalar object graph (ArffValue, arff_value.h:45), the whole file
+// is read in one shot and parsed straight into dense float32 [N, D-1] features
+// + int32 labels — the exact layout the device wants, zero intermediate
+// objects.
+//
+// Dialect parity with the reference (SURVEY.md §3.4): '%' comment lines,
+// case-insensitive keywords, NUMERIC/REAL/INTEGER/STRING/DATE/{nominal}
+// attribute types, single/double-quoted values, '?' missing -> NaN, rows may
+// span physical lines (the token-stream reader consumes exactly
+// num_attributes values per instance, arff_parser.cpp:121-153), a partial row
+// at EOF is discarded, sparse rows are rejected. Errors carry file:line
+// context like libarff's THROW (arff_utils.cpp:8-20).
+//
+// C ABI only — bound from Python via ctypes (no pybind11 in this image).
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Attr {
+  std::string name;
+  std::string type;  // "numeric" | "string" | "date" | "nominal"
+  std::vector<std::string> nominal;
+};
+
+struct ParseState {
+  std::string path;
+  std::string relation;
+  std::vector<Attr> attrs;
+  std::vector<float> cells;  // row-major, attrs.size() per row
+  std::string error;
+  int line = 0;
+};
+
+bool ieq(const std::string& a, const char* b) {
+  if (a.size() != strlen(b)) return false;
+  for (size_t i = 0; i < a.size(); ++i)
+    if (tolower((unsigned char)a[i]) != tolower((unsigned char)b[i])) return false;
+  return true;
+}
+
+void fail(ParseState& st, const std::string& msg) {
+  if (st.error.empty())
+    st.error = st.path + ":" + std::to_string(st.line) + ": " + msg;
+}
+
+std::string strip(const std::string& s) {
+  size_t b = s.find_first_not_of(" \t\r\n");
+  if (b == std::string::npos) return "";
+  size_t e = s.find_last_not_of(" \t\r\n");
+  return s.substr(b, e - b + 1);
+}
+
+// Split a line on commas honoring single/double quotes.
+bool split_csv(const std::string& line, std::vector<std::string>& out,
+               ParseState& st) {
+  out.clear();
+  std::string buf;
+  char quote = 0;
+  for (char ch : line) {
+    if (quote) {
+      if (ch == quote)
+        quote = 0;
+      else
+        buf.push_back(ch);
+    } else if (ch == '\'' || ch == '"') {
+      quote = ch;
+    } else if (ch == ',') {
+      out.push_back(strip(buf));
+      buf.clear();
+    } else {
+      buf.push_back(ch);
+    }
+  }
+  if (quote) {
+    fail(st, "unterminated quoted value");
+    return false;
+  }
+  out.push_back(strip(buf));
+  return true;
+}
+
+bool parse_attribute(const std::string& rest_in, ParseState& st) {
+  std::string rest = strip(rest_in);
+  if (rest.empty()) {
+    fail(st, "@attribute needs a name and a type");
+    return false;
+  }
+  Attr attr;
+  if (rest[0] == '\'' || rest[0] == '"') {
+    char q = rest[0];
+    size_t end = rest.find(q, 1);
+    if (end == std::string::npos) {
+      fail(st, "unterminated quoted attribute name");
+      return false;
+    }
+    attr.name = rest.substr(1, end - 1);
+    rest = strip(rest.substr(end + 1));
+  } else {
+    size_t sp = rest.find_first_of(" \t");
+    if (sp == std::string::npos) {
+      fail(st, "@attribute '" + rest + "' is missing a type");
+      return false;
+    }
+    attr.name = rest.substr(0, sp);
+    rest = strip(rest.substr(sp));
+  }
+  if (rest.empty()) {
+    fail(st, "@attribute '" + attr.name + "' is missing a type");
+    return false;
+  }
+  if (rest[0] == '{') {
+    if (rest.back() != '}') {
+      fail(st, "unterminated nominal value list");
+      return false;
+    }
+    attr.type = "nominal";
+    std::vector<std::string> vals;
+    if (!split_csv(rest.substr(1, rest.size() - 2), vals, st)) return false;
+    attr.nominal = vals;
+  } else {
+    size_t sp = rest.find_first_of(" \t");
+    std::string word = sp == std::string::npos ? rest : rest.substr(0, sp);
+    if (ieq(word, "numeric") || ieq(word, "real") || ieq(word, "integer"))
+      attr.type = "numeric";
+    else if (ieq(word, "string"))
+      attr.type = "string";
+    else if (ieq(word, "date"))
+      attr.type = "date";
+    else {
+      fail(st, "unsupported attribute type '" + rest + "'");
+      return false;
+    }
+  }
+  st.attrs.push_back(std::move(attr));
+  return true;
+}
+
+bool cell_to_float(const std::string& tok, const Attr& attr, float* out,
+                   ParseState& st) {
+  if (tok == "?") {
+    *out = NAN;
+    return true;
+  }
+  if (attr.type == "nominal") {
+    for (size_t i = 0; i < attr.nominal.size(); ++i)
+      if (attr.nominal[i] == tok) {
+        *out = (float)i;
+        return true;
+      }
+    fail(st, "value '" + tok + "' not in nominal set for '" + attr.name + "'");
+    return false;
+  }
+  if (attr.type == "string" || attr.type == "date") {
+    fail(st, "attribute '" + attr.name + "' of type " + attr.type +
+                 " is not numeric");
+    return false;
+  }
+  char* endp = nullptr;
+  *out = strtof(tok.c_str(), &endp);
+  if (endp == tok.c_str() || *endp != '\0') {
+    fail(st, "cannot parse '" + tok + "' as a number for '" + attr.name + "'");
+    return false;
+  }
+  return true;
+}
+
+bool parse_buffer(const std::string& data, ParseState& st) {
+  size_t pos = 0;
+  bool in_data = false;
+  std::vector<std::string> pending;  // cells carried across physical lines
+  std::vector<std::string> cells;
+  while (pos <= data.size()) {
+    size_t nl = data.find('\n', pos);
+    std::string raw = nl == std::string::npos ? data.substr(pos)
+                                              : data.substr(pos, nl - pos);
+    pos = nl == std::string::npos ? data.size() + 1 : nl + 1;
+    st.line++;
+    std::string line = strip(raw);
+    if (line.empty() || line[0] == '%') continue;
+    if (!in_data && line[0] == '@') {
+      size_t sp = line.find_first_of(" \t");
+      std::string word = sp == std::string::npos ? line : line.substr(0, sp);
+      std::string rest = sp == std::string::npos ? "" : strip(line.substr(sp));
+      if (ieq(word, "@relation")) {
+        st.relation = rest;
+        if (st.relation.size() >= 2 &&
+            (st.relation.front() == '\'' || st.relation.front() == '"') &&
+            st.relation.back() == st.relation.front())
+          st.relation = st.relation.substr(1, st.relation.size() - 2);
+      } else if (ieq(word, "@attribute")) {
+        if (!parse_attribute(rest, st)) return false;
+      } else if (ieq(word, "@data")) {
+        if (st.attrs.empty()) {
+          fail(st, "@data before any @attribute");
+          return false;
+        }
+        in_data = true;
+      } else {
+        fail(st, "unknown keyword '" + word + "'");
+        return false;
+      }
+      continue;
+    }
+    if (!in_data) {
+      fail(st, "unexpected content before @data: '" + line + "'");
+      return false;
+    }
+    if (line[0] == '{') {
+      fail(st, "sparse ARFF rows are not supported");
+      return false;
+    }
+    if (!split_csv(line, cells, st)) return false;
+    if (!pending.empty()) {
+      pending.insert(pending.end(), cells.begin(), cells.end());
+      cells.swap(pending);
+      pending.clear();
+    }
+    size_t d = st.attrs.size();
+    if (cells.size() < d) {
+      pending = cells;  // short row: carry forward (token-stream semantics)
+      continue;
+    }
+    if (cells.size() > d) {
+      fail(st, "row has " + std::to_string(cells.size()) + " values but " +
+                   std::to_string(d) + " attributes declared");
+      return false;
+    }
+    for (size_t j = 0; j < d; ++j) {
+      float v;
+      if (!cell_to_float(cells[j], st.attrs[j], &v, st)) return false;
+      st.cells.push_back(v);
+    }
+  }
+  // A partial row at EOF is discarded (arff_parser.cpp:130-133).
+  if (st.attrs.empty()) {
+    st.line = 0;
+    fail(st, "no @attribute declarations found");
+    return false;
+  }
+  return true;
+}
+
+void json_escape(const std::string& s, std::string& out) {
+  char buf[8];
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(c);
+    } else if ((unsigned char)c < 0x20) {
+      snprintf(buf, sizeof(buf), "\\u%04x", (unsigned char)c);
+      out += buf;
+    } else {
+      out.push_back(c);
+    }
+  }
+}
+
+char* dup_string(const std::string& s) {
+  char* p = (char*)malloc(s.size() + 1);
+  memcpy(p, s.c_str(), s.size() + 1);
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Result of parsing: dense features [n, d_features] + labels [n] where the
+// class is the last declared attribute cast to int (main.cpp:57,66 contract).
+// attrs_json describes all attributes (name/type/nominal values).
+// On failure, `error` is set and all other fields are null/0.
+struct KnnArffResult {
+  float* features;
+  int32_t* labels;
+  int64_t n;
+  int64_t d_features;
+  int32_t num_classes;  // max(label)+1 (arff_data.cpp:41-58 semantics)
+  char* relation;
+  char* attrs_json;
+  char* error;
+};
+
+int knn_arff_parse(const char* path, KnnArffResult* out) {
+  memset(out, 0, sizeof(*out));
+  ParseState st;
+  st.path = path;
+
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    out->error = dup_string(std::string(path) + ": cannot open file");
+    return 1;
+  }
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string data(size > 0 ? (size_t)size : 0, '\0');
+  if (size > 0 && fread(&data[0], 1, (size_t)size, f) != (size_t)size) {
+    fclose(f);
+    out->error = dup_string(std::string(path) + ": short read");
+    return 1;
+  }
+  fclose(f);
+
+  if (!parse_buffer(data, st)) {
+    out->error = dup_string(st.error);
+    return 1;
+  }
+
+  size_t d = st.attrs.size();
+  size_t n = d ? st.cells.size() / d : 0;
+  size_t df = d - 1;
+  out->n = (int64_t)n;
+  out->d_features = (int64_t)df;
+  out->features = (float*)malloc(sizeof(float) * n * (df ? df : 1));
+  out->labels = (int32_t*)malloc(sizeof(int32_t) * (n ? n : 1));
+  int32_t max_label = -1;
+  for (size_t i = 0; i < n; ++i) {
+    const float* row = &st.cells[i * d];
+    memcpy(out->features + i * df, row, sizeof(float) * df);
+    float lab = row[d - 1];
+    if (std::isnan(lab)) {
+      free(out->features);
+      free(out->labels);
+      memset(out, 0, sizeof(*out));
+      out->error = dup_string(st.path + ": instance " + std::to_string(i) +
+                              " has a missing class label");
+      return 1;
+    }
+    out->labels[i] = (int32_t)lab;
+    if (out->labels[i] > max_label) max_label = out->labels[i];
+  }
+  out->num_classes = max_label + 1;
+  out->relation = dup_string(st.relation);
+
+  std::string j = "[";
+  for (size_t a = 0; a < st.attrs.size(); ++a) {
+    if (a) j += ",";
+    j += "{\"name\":\"";
+    json_escape(st.attrs[a].name, j);
+    j += "\",\"type\":\"" + st.attrs[a].type + "\"";
+    if (!st.attrs[a].nominal.empty()) {
+      j += ",\"nominal_values\":[";
+      for (size_t v = 0; v < st.attrs[a].nominal.size(); ++v) {
+        if (v) j += ",";
+        j += "\"";
+        json_escape(st.attrs[a].nominal[v], j);
+        j += "\"";
+      }
+      j += "]";
+    }
+    j += "}";
+  }
+  j += "]";
+  out->attrs_json = dup_string(j);
+  return 0;
+}
+
+void knn_arff_free(KnnArffResult* r) {
+  if (!r) return;
+  free(r->features);
+  free(r->labels);
+  free(r->relation);
+  free(r->attrs_json);
+  free(r->error);
+  memset(r, 0, sizeof(*r));
+}
+
+}  // extern "C"

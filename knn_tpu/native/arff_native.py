@@ -1,0 +1,69 @@
+"""ctypes binding for the native ARFF parser (native/arff/arff_c.cc).
+
+Emits the same :class:`Dataset` as the pure-Python parser; the golden-array
+tests assert bit-identical output between the two.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from pathlib import Path
+
+import numpy as np
+
+from knn_tpu.data.dataset import Attribute, Dataset
+
+_LIB_DIR = Path(__file__).parent / "lib"
+
+
+class _KnnArffResult(ctypes.Structure):
+    _fields_ = [
+        ("features", ctypes.POINTER(ctypes.c_float)),
+        ("labels", ctypes.POINTER(ctypes.c_int32)),
+        ("n", ctypes.c_int64),
+        ("d_features", ctypes.c_int64),
+        ("num_classes", ctypes.c_int32),
+        ("relation", ctypes.c_char_p),
+        ("attrs_json", ctypes.c_char_p),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+def _load():
+    path = _LIB_DIR / "libknn_arff.so"
+    lib = ctypes.CDLL(str(path))  # raises OSError if not built
+    lib.knn_arff_parse.argtypes = [ctypes.c_char_p, ctypes.POINTER(_KnnArffResult)]
+    lib.knn_arff_parse.restype = ctypes.c_int
+    lib.knn_arff_free.argtypes = [ctypes.POINTER(_KnnArffResult)]
+    lib.knn_arff_free.restype = None
+    return lib
+
+
+_lib = _load()
+
+
+def parse(path: str) -> Dataset:
+    res = _KnnArffResult()
+    rc = _lib.knn_arff_parse(str(path).encode(), ctypes.byref(res))
+    try:
+        if rc != 0:
+            msg = res.error.decode() if res.error else f"parse failed (rc={rc})"
+            raise ValueError(msg)
+        n, df = res.n, res.d_features
+        features = np.ctypeslib.as_array(res.features, shape=(n, df)).copy() \
+            if n and df else np.zeros((n, df), np.float32)
+        labels = np.ctypeslib.as_array(res.labels, shape=(n,)).copy() \
+            if n else np.zeros((n,), np.int32)
+        attrs = [
+            Attribute(a["name"], a["type"], a.get("nominal_values"))
+            for a in json.loads(res.attrs_json.decode() if res.attrs_json else "[]")
+        ]
+        return Dataset(
+            features=features,
+            labels=labels,
+            relation=res.relation.decode() if res.relation else "",
+            attributes=attrs,
+        )
+    finally:
+        _lib.knn_arff_free(ctypes.byref(res))

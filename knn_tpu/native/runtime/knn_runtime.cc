@@ -1,0 +1,123 @@
+// Native KNN runtime: the serial and thread-pool execution backends.
+//
+// One kernel, reference-exact semantics (SURVEY.md §3.5): squared Euclidean
+// accumulated in source order (main.cpp:14-23), sorted k-candidate insertion
+// with strict '<' so the earliest-scanned train index wins distance ties
+// (main.cpp:46-61), bincount vote with strict '>' so the lowest class id wins
+// vote ties (main.cpp:64-78). Unlike the reference's three copy-pasted
+// kernels, num_threads selects the execution strategy over this single
+// implementation: 1 = serial (main.cpp analogue), >1 = fork-join over
+// contiguous query ranges with the remainder going to the last worker
+// (multi-thread.cpp:154-161 partitioning), <=0 = hardware concurrency.
+//
+// C ABI only — bound from Python via ctypes.
+
+#include <algorithm>
+#include <cfloat>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void knn_range(const float* train, const int32_t* labels, int64_t n, int64_t d,
+               const float* test, int32_t k, int32_t num_classes,
+               int64_t q_start, int64_t q_end, int32_t* out) {
+  std::vector<float> cand_dist((size_t)k);
+  std::vector<int32_t> cand_label((size_t)k);
+  std::vector<int32_t> counts((size_t)num_classes);
+
+  for (int64_t q = q_start; q < q_end; ++q) {
+    const float* query = test + q * d;
+    std::fill(cand_dist.begin(), cand_dist.end(), FLT_MAX);
+    std::fill(cand_label.begin(), cand_label.end(), -1);
+    int32_t filled = 0;
+
+    for (int64_t i = 0; i < n; ++i) {
+      const float* row = train + i * d;
+      float dist = 0.0f;
+      for (int64_t j = 0; j < d; ++j) {
+        float diff = query[j] - row[j];
+        dist += diff * diff;
+      }
+      // Framework-wide policy (where the reference is UB, SURVEY.md §3.5.5):
+      // NaN distances count as +inf, and +inf candidates are admitted in
+      // (distance, index) order — every backend selects the k lexicographically
+      // smallest (dist, train_index) pairs.
+      if (std::isnan(dist)) dist = INFINITY;
+      // Sorted insertion, strict '<': first-seen wins among equal distances;
+      // an unfilled tail slot admits the row even at equal/inf distance.
+      int32_t pos = -1;
+      for (int32_t c = 0; c < filled; ++c) {
+        if (dist < cand_dist[c]) {
+          pos = c;
+          break;
+        }
+      }
+      if (pos < 0 && filled < k) pos = filled;
+      if (pos >= 0) {
+        for (int32_t x = k - 1; x > pos; --x) {
+          cand_dist[x] = cand_dist[x - 1];
+          cand_label[x] = cand_label[x - 1];
+        }
+        cand_dist[pos] = dist;
+        cand_label[pos] = labels[i];
+        if (filled < k) filled++;
+      }
+    }
+
+    std::fill(counts.begin(), counts.end(), 0);
+    for (int32_t c = 0; c < k; ++c)
+      if (cand_label[c] >= 0 && cand_label[c] < num_classes)
+        counts[cand_label[c]]++;
+    int32_t best = -1, best_class = 0;
+    for (int32_t cls = 0; cls < num_classes; ++cls) {
+      if (counts[cls] > best) {  // strict '>': lowest class id wins ties
+        best = counts[cls];
+        best_class = cls;
+      }
+    }
+    out[q] = best_class;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, nonzero on invalid arguments.
+int knn_native_predict(const float* train, const int32_t* labels, int64_t n,
+                       int64_t d, const float* test, int64_t q, int32_t k,
+                       int32_t num_classes, int32_t num_threads,
+                       int32_t* out_predictions) {
+  if (!train || !labels || !test || !out_predictions) return 1;
+  if (n <= 0 || d < 0 || q < 0 || k < 1 || k > n || num_classes < 1) return 2;
+
+  int32_t t = num_threads;
+  if (t <= 0) t = (int32_t)std::max(1u, std::thread::hardware_concurrency());
+  t = (int32_t)std::min<int64_t>(t, std::max<int64_t>(q, 1));
+
+  if (t == 1) {
+    knn_range(train, labels, n, d, test, k, num_classes, 0, q, out_predictions);
+    return 0;
+  }
+
+  // Contiguous ranges, remainder to the last worker — the reference's
+  // partition (multi-thread.cpp:154-161); disjoint output slices need no
+  // synchronization (multi-thread.cpp:15,94).
+  int64_t per = q / t;
+  std::vector<std::thread> workers;
+  workers.reserve((size_t)t);
+  for (int32_t w = 0; w < t; ++w) {
+    int64_t s = w * per;
+    int64_t e = (w == t - 1) ? q : s + per;
+    workers.emplace_back(knn_range, train, labels, n, d, test, k, num_classes,
+                         s, e, out_predictions);
+  }
+  for (auto& th : workers) th.join();
+  return 0;
+}
+
+}  // extern "C"

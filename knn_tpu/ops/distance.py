@@ -20,9 +20,13 @@ import jax.numpy as jnp
 
 
 def pairwise_sq_dists(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
-    """[Q, D], [N, D] -> [Q, N] squared Euclidean distances (subtraction form)."""
+    """[Q, D], [N, D] -> [Q, N] squared Euclidean distances (subtraction form).
+
+    NaN distances (from missing-value NaN features) map to +inf — the
+    framework-wide policy where the reference is UB (SURVEY.md §3.5.5)."""
     diff = queries[:, None, :] - train[None, :, :]
-    return jnp.sum(diff * diff, axis=-1)
+    d = jnp.sum(diff * diff, axis=-1)
+    return jnp.where(jnp.isnan(d), jnp.inf, d)
 
 
 def pairwise_sq_dists_dot(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
@@ -31,4 +35,5 @@ def pairwise_sq_dists_dot(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarr
     q2 = jnp.sum(queries * queries, axis=-1, keepdims=True)  # [Q, 1]
     t2 = jnp.sum(train * train, axis=-1)[None, :]  # [1, N]
     cross = queries @ train.T  # [Q, N] — MXU
-    return jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+    d = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+    return jnp.where(jnp.isnan(d), jnp.inf, d)

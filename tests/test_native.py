@@ -1,0 +1,129 @@
+"""Native C++ components: parser bit-parity with the Python implementation,
+runtime kernel parity with the oracle, thread-count invariance.
+
+Skipped wholesale when the shared libraries haven't been built (``make
+native``).
+"""
+
+import numpy as np
+import pytest
+
+from knn_tpu.data import pyarff
+from tests import fixtures
+
+@pytest.fixture(scope="module")
+def native_arff():
+    return pytest.importorskip(
+        "knn_tpu.native.arff_native",
+        reason="native arff lib not built (run `make native`)",
+    )
+
+
+def _native_runtime():
+    return pytest.importorskip(
+        "knn_tpu.backends.native",
+        reason="native runtime lib not built (run `make native`)",
+    )
+
+
+class TestNativeParser:
+    @pytest.mark.parametrize("size", ["small", "medium", "large"])
+    @pytest.mark.parametrize("split", ["train", "test"])
+    def test_bit_parity_with_python_parser(self, native_arff, size, split):
+        path = str(fixtures.datasets_dir() / f"{size}-{split}.arff")
+        nat = native_arff.parse(path)
+        py = pyarff.parse_arff_file(path)
+        np.testing.assert_array_equal(nat.features, py.features)
+        np.testing.assert_array_equal(nat.labels, py.labels)
+        assert nat.relation == py.relation
+        assert [a.name for a in nat.attributes] == [a.name for a in py.attributes]
+        assert [a.type for a in nat.attributes] == [a.type for a in py.attributes]
+
+    def test_dialect_nominal_quoted_missing(self, native_arff, tmp_path):
+        p = tmp_path / "t.arff"
+        p.write_text(
+            "% comment\n@RELATION 'my rel'\n"
+            "@attribute 'a b' NUMERIC\n"
+            "@attribute c {red, 'dark blue'}\n"
+            "@attribute class NUMERIC\n"
+            "@data\n"
+            "1.5,red,0\n"
+            "?,'dark blue',1\n"
+            "2,red\n"  # short row continued on next line
+            "2\n"
+        )
+        nat = native_arff.parse(str(p))
+        py = pyarff.parse_arff_file(str(p))
+        np.testing.assert_array_equal(nat.labels, py.labels)
+        assert nat.relation == "my rel"
+        assert np.isnan(nat.features[1, 0]) and np.isnan(py.features[1, 0])
+        assert nat.features[1, 1] == 1.0  # 'dark blue' -> index 1
+        assert nat.attributes[1].nominal_values == ["red", "dark blue"]
+        assert nat.num_instances == 3
+
+    def test_error_has_location(self, native_arff, tmp_path):
+        p = tmp_path / "bad.arff"
+        p.write_text("@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\nzz,0\n")
+        with pytest.raises(ValueError, match=r"bad\.arff:5"):
+            native_arff.parse(str(p))
+
+    def test_missing_file(self, native_arff):
+        with pytest.raises(ValueError, match="cannot open"):
+            native_arff.parse("/nonexistent/x.arff")
+
+    def test_sparse_rejected(self, native_arff, tmp_path):
+        p = tmp_path / "s.arff"
+        p.write_text("@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\n{0 1}\n")
+        with pytest.raises(ValueError, match="sparse"):
+            native_arff.parse(str(p))
+
+
+class TestNativeRuntime:
+    def test_matches_oracle(self, rng):
+        nb = _native_runtime()
+        from knn_tpu.backends.oracle import knn_oracle
+
+        n, q, d, k, c = 500, 64, 5, 7, 6
+        train_x = rng.integers(0, 4, (n, d)).astype(np.float32)
+        train_y = rng.integers(0, c, n).astype(np.int32)
+        test_x = np.concatenate(
+            [train_x[:20], rng.integers(0, 4, (q - 20, d)).astype(np.float32)]
+        )
+        want = knn_oracle(train_x, train_y, test_x, k, c)
+        got = nb.knn_native(train_x, train_y, test_x, k, c, num_threads=1)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("threads", [2, 3, 8])
+    def test_thread_count_invariance(self, rng, threads):
+        nb = _native_runtime()
+        n, q, d, k, c = 300, 50, 4, 5, 5
+        train_x = rng.normal(size=(n, d)).astype(np.float32)
+        train_y = rng.integers(0, c, n).astype(np.int32)
+        test_x = rng.normal(size=(q, d)).astype(np.float32)
+        serial = nb.knn_native(train_x, train_y, test_x, k, c, num_threads=1)
+        mt = nb.knn_native(train_x, train_y, test_x, k, c, num_threads=threads)
+        np.testing.assert_array_equal(serial, mt)
+
+    @pytest.mark.skipif(
+        not fixtures.using_reference_datasets(), reason="reference datasets required"
+    )
+    @pytest.mark.parametrize("size,k", [("small", 1), ("small", 5), ("medium", 5)])
+    def test_golden_accuracy(self, size, k, request):
+        nb = _native_runtime()
+        from knn_tpu.utils.evaluate import confusion_matrix, accuracy
+
+        train, test = request.getfixturevalue(size)
+        preds = nb.knn_native(
+            train.features, train.labels, test.features, k, train.num_classes,
+            num_threads=2,
+        )
+        acc = accuracy(confusion_matrix(preds, test.labels, test.num_classes))
+        assert round(acc, 4) == fixtures.GOLDEN_ACCURACY[(size, k)]
+
+    def test_invalid_args_rejected(self, rng):
+        nb = _native_runtime()
+        train_x = rng.normal(size=(10, 3)).astype(np.float32)
+        train_y = np.zeros(10, np.int32)
+        test_x = rng.normal(size=(4, 3)).astype(np.float32)
+        with pytest.raises(ValueError, match="rc=2"):
+            nb.knn_native(train_x, train_y, test_x, 11, 1)  # k > n

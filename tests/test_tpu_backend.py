@@ -69,6 +69,24 @@ class TestParityWithOracle:
         np.testing.assert_array_equal(got, want)
 
 
+class TestNanPolicy:
+    def test_nan_features_match_oracle(self):
+        # Framework-wide policy: NaN distances count as +inf and inf
+        # candidates are admitted in (dist, index) order (SURVEY.md §3.5.5
+        # is UB in the reference). All backends must agree.
+        train_x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        train_y = np.array([2, 2, 1], np.int32)
+        test_x = np.array([[np.nan], [2.0]], np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 2, 3)
+        got = predict_arrays(train_x, train_y, test_x, 2, 3)
+        np.testing.assert_array_equal(got, want)
+        got_tiled = predict_arrays(
+            train_x, train_y, test_x, 2, 3,
+            force_tiled=True, query_tile=2, train_tile=2,
+        )
+        np.testing.assert_array_equal(got_tiled, want)
+
+
 class TestGolden:
     @pytest.mark.skipif(
         not fixtures.using_reference_datasets(), reason="reference datasets required"
