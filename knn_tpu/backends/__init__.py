@@ -15,6 +15,8 @@ follow the reference's Makefile-target convention (Makefile:1-9):
 - ``tpu-sharded``  — shard_map over the test-query axis (the MPI analogue).
 - ``tpu-train-sharded`` — train rows sharded + all-gather top-k merge.
 - ``tpu-ring``     — ring schedule over train shards (ring-attention shape).
+- ``tpu-pallas``   — hand-tiled Pallas kernel, VMEM-resident running top-k
+                     (the wide-feature / BASELINE config-5 path).
 """
 
 from __future__ import annotations
@@ -57,6 +59,11 @@ def _ensure_loaded():
     # Import for registration side effects.
     from knn_tpu.backends import oracle as _oracle  # noqa: F401
     from knn_tpu.backends import tpu as _tpu  # noqa: F401
+
+    try:
+        from knn_tpu.backends import pallas as _pallas  # noqa: F401
+    except ImportError:
+        pass  # pallas unavailable on this jax build
 
     try:
         from knn_tpu.backends import native as _native  # noqa: F401

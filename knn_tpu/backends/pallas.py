@@ -1,0 +1,42 @@
+"""Pallas-kernel backend (``tpu-pallas``).
+
+The hand-tiled VMEM kernel path for wide-feature / large-N configurations
+(BASELINE.json config 5). Same strategy signature as every other backend;
+``precision`` selects the in-kernel distance form — "exact" (default) for
+reference-parity ties, "fast" for the MXU matmul on wide features
+(ops/pallas_knn.py docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from knn_tpu.backends import register
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.ops.pallas_knn import predict_pallas
+
+
+@register("tpu-pallas")
+def predict(
+    train: Dataset,
+    test: Dataset,
+    k: int,
+    block_q: int = 128,
+    block_n: int = 512,
+    interpret: Optional[bool] = None,
+    precision: str = "auto",
+    **_unused,
+) -> np.ndarray:
+    train.validate_for_knn(k, test)
+    if precision == "auto":
+        # The exact form unrolls the feature axis on the VPU — right for the
+        # narrow parity datasets, pathological for wide features where the
+        # single-matmul form is the point of this kernel.
+        precision = "exact" if train.features.shape[1] <= 128 else "fast"
+    return predict_pallas(
+        train.features, train.labels, test.features, k, train.num_classes,
+        block_q=block_q, block_n=block_n, interpret=interpret,
+        precision=precision,
+    )

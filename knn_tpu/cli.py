@@ -54,7 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--persona", choices=sorted(_PERSONAS), default="tpu")
     p.add_argument("--backend", default=None, help="override the persona's backend")
-    p.add_argument("--precision", choices=["exact", "fast"], default="exact")
+    p.add_argument(
+        "--precision", choices=["exact", "fast", "auto"], default="exact",
+        help="distance form: exact (reference parity), fast (MXU matmul), "
+        "auto (defer to the backend's default)",
+    )
     p.add_argument("--query-tile", type=int, default=256)
     p.add_argument("--train-tile", type=int, default=2048)
     p.add_argument("--devices", type=int, default=None,
@@ -98,6 +102,7 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
             "tpu-sharded": "tpu",
             "tpu-train-sharded": "tpu",
             "tpu-ring": "tpu",
+            "tpu-pallas": "tpu",
         }.get(backend_name)
         if fallback is None:
             print(f"error: backend '{backend_name}' unavailable", file=sys.stderr)
@@ -113,10 +118,11 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         return 1
 
     opts = dict(
-        precision=args.precision,
         query_tile=args.query_tile,
         train_tile=args.train_tile,
     )
+    if args.precision != "auto":
+        opts["precision"] = args.precision
     if args.threads is not None:
         opts["num_threads"] = args.threads
     if args.devices is not None:

@@ -1,0 +1,219 @@
+"""Pallas TPU kernel: tiled pairwise-distance + running top-k candidates.
+
+SURVEY.md §7 step 7 / BASELINE.json config 5 — the wide-feature configuration
+(MNIST-784-shaped) where the reference's scalar inner loop (main.cpp:14-23,
+D-1 float ops per train row per query) is hopeless. Here the distance block is
+one MXU matmul (``|q|^2 - 2 q·t + |t|^2``) and the k-candidate insertion sort
+the reference runs per train row (main.cpp:46-61) becomes a VMEM-resident
+running top-k that is folded once per train *tile*.
+
+Kernel structure (grid = query tiles × train tiles, train innermost):
+
+    for i in query_tiles:          # parallel
+      for j in train_tiles:        # arbitrary (sequential accumulation)
+        d  = dist(q_block[i], t_block[j])        # MXU, [BQ, BN]
+        out[i] = topk_merge(out[i], (d, gidx))   # VPU, k extraction rounds
+
+The running candidate set lives in the *output* block refs — their index map
+ignores ``j``, so the same VMEM buffer persists across the whole train-tile
+sweep and is only written back to HBM once per query tile. Train tiles stream
+HBM → VMEM via the automatic pallas pipeline (double-buffered by default),
+which is exactly the blockwise/"long-context" formulation of §5.7: the train
+set plays the role sequence length plays in ring/flash attention, with the
+(associative) lexicographic top-k merge in place of the softmax accumulator.
+
+Tie semantics: selection keys on (distance, global train index) — the same
+first-seen-wins rule as the reference's strict-``<`` insertion (main.cpp:47)
+— so tiling does not perturb which neighbors are kept (§7 hard part (b)).
+Two distance forms (mirroring ops/distance.py): ``precision="exact"`` unrolls
+the subtraction form over the true feature count — identical rows give
+exactly 0, preserving the large dataset's dist==0 ties and golden accuracy —
+while ``precision="fast"`` uses one MXU matmul per tile pair, the right mode
+for wide features (MNIST-784) where the VPU unroll would dominate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from knn_tpu.utils.padding import pad_axis_to_multiple
+
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def _merge_topk_rounds(
+    d_cat: jnp.ndarray, i_cat: jnp.ndarray, k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k rounds of lexicographic (distance, index) min-extraction over the
+    last axis. Pure VPU ops (min / compare / where) — no sort network needed
+    for the small k the reference supports (k ≪ tile width)."""
+    out_d, out_i = [], []
+    for _ in range(k):
+        m = jnp.min(d_cat, axis=1, keepdims=True)
+        is_min = d_cat == m
+        sel = jnp.min(jnp.where(is_min, i_cat, _INT_MAX), axis=1, keepdims=True)
+        out_d.append(m)
+        out_i.append(sel)
+        # Retire the selected entry on BOTH keys: +inf distance alone is a
+        # no-op for candidates that are already +inf (NaN-policy distances),
+        # which would re-select the same index every round.
+        taken = is_min & (i_cat == sel)
+        d_cat = jnp.where(taken, jnp.inf, d_cat)
+        i_cat = jnp.where(taken, _INT_MAX, i_cat)
+    return jnp.concatenate(out_d, axis=1), jnp.concatenate(out_i, axis=1)
+
+
+def _knn_kernel(
+    n_valid_ref, q_ref, t_ref, out_d_ref, out_i_ref,
+    *, k: int, block_n: int, d_true: int, precision: str,
+):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_d_ref[:] = jnp.full(out_d_ref.shape, jnp.inf, out_d_ref.dtype)
+        out_i_ref[:] = jnp.full(out_i_ref.shape, _INT_MAX, jnp.int32)
+
+    q = q_ref[:]  # [BQ, D]
+    t = t_ref[:]  # [BN, D]
+    if precision == "fast":
+        # MXU distance block: |q|^2 - 2 q·t + |t|^2, clamped at 0. One matmul,
+        # but catastrophic cancellation perturbs near-zero distances.
+        q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
+        t2 = jnp.sum(t * t, axis=1, keepdims=True).T  # [1, BN]
+        cross = jax.lax.dot_general(
+            q, t,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        d = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)  # [BQ, BN]
+    else:
+        # Exact subtraction form, unrolled over the true feature count (the
+        # lane padding is skipped): per-pair float accumulation like the
+        # reference's inner loop (main.cpp:17-19), so identical rows give
+        # exactly 0 and the large dataset's dist==0 ties survive (§7 (a)).
+        d = jnp.zeros((q.shape[0], t.shape[0]), jnp.float32)
+        for f in range(d_true):
+            diff = q[:, f : f + 1] - t[:, f : f + 1].T  # [BQ, BN]
+            d = d + diff * diff
+    # Framework-wide NaN policy: missing-value NaNs -> +inf distance
+    # (ops/distance.py; the reference is UB here, SURVEY.md §3.5.5).
+    d = jnp.where(jnp.isnan(d), jnp.inf, d)
+
+    # Global train-row indices for this tile; rows past n_valid (padding) are
+    # masked to (+inf, INT_MAX) so they can never win a selection round — the
+    # FLT_MAX-init trick of main.cpp:33 applied to padding instead of UB.
+    gcol = j * block_n + jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    valid = gcol < n_valid_ref[0]
+    d = jnp.where(valid, d, jnp.inf)
+    gidx = jnp.where(valid, gcol, _INT_MAX)
+
+    d_cat = jnp.concatenate([out_d_ref[:], d], axis=1)
+    i_cat = jnp.concatenate([out_i_ref[:], gidx], axis=1)
+    new_d, new_i = _merge_topk_rounds(d_cat, i_cat, k)
+    out_d_ref[:] = new_d
+    out_i_ref[:] = new_i
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "block_q", "block_n", "interpret", "d_true", "precision"),
+)
+def knn_pallas_candidates(
+    train_x: jnp.ndarray,
+    test_x: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    k: int,
+    block_q: int = 128,
+    block_n: int = 512,
+    interpret: bool = False,
+    d_true: Optional[int] = None,
+    precision: str = "exact",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[N,D] train, [Q,D] queries -> ([Q,k] dists, [Q,k] int32 global indices),
+    sorted ascending by (distance, index). N, Q, D must be pre-padded to
+    block_n / block_q / lane multiples (zero-pad D — it adds 0 to distances).
+    ``d_true`` is the unpadded feature count (the exact path loops over it);
+    ``precision`` picks the distance form (module docstring)."""
+    n_pad, d_feat = train_x.shape
+    q_pad = test_x.shape[0]
+    assert n_pad % block_n == 0 and q_pad % block_q == 0
+    grid = (q_pad // block_q, n_pad // block_n)
+
+    kernel = functools.partial(
+        _knn_kernel, k=k, block_n=block_n,
+        d_true=d_true if d_true is not None else d_feat, precision=precision,
+    )
+    flops = 2 * q_pad * n_pad * d_feat + 4 * grid[1] * q_pad * k * (block_n + k)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            # Index maps take (grid indices..., scalar-prefetch refs...).
+            in_specs=[
+                pl.BlockSpec((block_q, d_feat), lambda i, j, n_ref: (i, 0)),
+                pl.BlockSpec((block_n, d_feat), lambda i, j, n_ref: (j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_q, k), lambda i, j, n_ref: (i, 0)),
+                pl.BlockSpec((block_q, k), lambda i, j, n_ref: (i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, k), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, k), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=(q_pad + n_pad) * d_feat * 4 + q_pad * k * 8,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1), test_x, train_x)
+
+
+def predict_pallas(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    block_q: int = 128,
+    block_n: int = 512,
+    interpret: Optional[bool] = None,
+    precision: str = "exact",
+) -> np.ndarray:
+    """Host entry: pad (queries, train rows, feature lanes), run the kernel,
+    gather labels, vote. Interpret mode defaults on for non-TPU backends so the
+    same code path is testable on the CPU mesh (SURVEY.md §4)."""
+    from knn_tpu.ops.vote import vote
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, q = train_x.shape[0], test_x.shape[0]
+    d_true = train_x.shape[1]
+    block_n = max(block_n, k)  # streaming merge needs k candidates per tile
+    tx, _ = pad_axis_to_multiple(train_x.astype(np.float32), block_n, axis=0)
+    qx, _ = pad_axis_to_multiple(test_x.astype(np.float32), block_q, axis=0)
+    tx, _ = pad_axis_to_multiple(tx, 128, axis=1)  # lane-align features
+    qx, _ = pad_axis_to_multiple(qx, 128, axis=1)
+
+    _, idx = knn_pallas_candidates(
+        jnp.asarray(tx), jnp.asarray(qx), n, k,
+        block_q=block_q, block_n=block_n, interpret=interpret,
+        d_true=d_true, precision=precision,
+    )
+    idx = np.asarray(idx)[:q]
+    labels = train_y[np.minimum(idx, n - 1)]
+    return np.asarray(vote(jnp.asarray(labels), num_classes))

@@ -1,0 +1,129 @@
+"""Pallas kernel tests — interpret mode on the CPU mesh (SURVEY.md §4).
+
+Parity fixtures use integer-grid features so the matmul distance expansion
+(|q|^2 - 2 q·t + |t|^2) is exact in float32 and predictions must match the
+oracle bit-for-bit, including dist==0 duplicate-row ties.
+"""
+
+import numpy as np
+import pytest
+
+from knn_tpu.backends.oracle import knn_oracle
+from knn_tpu.ops.pallas_knn import knn_pallas_candidates, predict_pallas
+
+
+def _int_grid_problem(rng, n=700, q=90, d=9, c=10, hi=6):
+    train_x = rng.integers(0, hi, (n, d)).astype(np.float32)
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.integers(0, hi, (q - q // 2, d)).astype(np.float32)]
+    )
+    return train_x, train_y, test_x, c
+
+
+class TestPallasKernel:
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_parity_with_oracle(self, rng, k):
+        train_x, train_y, test_x, c = _int_grid_problem(rng)
+        want = knn_oracle(train_x, train_y, test_x, k, c)
+        got = predict_pallas(
+            train_x, train_y, test_x, k, c,
+            block_q=32, block_n=128, interpret=True,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_duplicate_rows_tie_stability(self, rng):
+        # Exact-duplicate rows straddling train-tile boundaries: kept
+        # candidate must be the lowest global index (SURVEY.md §7 (b)).
+        base = rng.integers(0, 3, (64, 4)).astype(np.float32)
+        train_x = np.tile(base, (8, 1))  # every row repeated 8x, 512 rows
+        train_y = rng.integers(0, 5, 512).astype(np.int32)
+        test_x = base[:16]
+        want = knn_oracle(train_x, train_y, test_x, 9, 5)
+        got = predict_pallas(
+            train_x, train_y, test_x, 9, 5,
+            block_q=8, block_n=128, interpret=True,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_candidates_sorted_and_padded_masked(self, rng):
+        # Raw kernel output: sorted by (dist, index), no padded-row indices.
+        train_x = rng.integers(0, 4, (130, 5)).astype(np.float32)  # pads to 256
+        test_x = rng.integers(0, 4, (17, 5)).astype(np.float32)  # pads to 32
+        k = 7
+        import jax.numpy as jnp
+
+        from knn_tpu.utils.padding import pad_axis_to_multiple
+
+        tx, _ = pad_axis_to_multiple(train_x, 128, axis=0)
+        qx, _ = pad_axis_to_multiple(test_x, 32, axis=0)
+        tx, _ = pad_axis_to_multiple(tx, 128, axis=1)
+        qx, _ = pad_axis_to_multiple(qx, 128, axis=1)
+        d, i = knn_pallas_candidates(
+            jnp.asarray(tx), jnp.asarray(qx), 130, k,
+            block_q=32, block_n=128, interpret=True,
+        )
+        d, i = np.asarray(d)[:17], np.asarray(i)[:17]
+        assert (i < 130).all(), "padded train rows leaked into candidates"
+        assert np.isfinite(d).all()
+        # Lexicographic (dist, index) ascending along k.
+        assert (d[:, :-1] <= d[:, 1:]).all()
+        same = d[:, :-1] == d[:, 1:]
+        assert (i[:, :-1][same] < i[:, 1:][same]).all()
+        # Distances match brute force.
+        bruteforce = ((test_x[:, None, :] - train_x[None, :130, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(d, np.sort(bruteforce, axis=1)[:, :k], rtol=1e-5)
+
+    def test_nan_features_match_oracle(self):
+        train_x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        train_y = np.array([2, 2, 1], np.int32)
+        test_x = np.array([[np.nan], [2.0]], np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 2, 3)
+        got = predict_pallas(
+            train_x, train_y, test_x, 2, 3,
+            block_q=8, block_n=8, interpret=True,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_all_inf_candidates_are_distinct(self):
+        # Regression: when every distance is +inf (NaN query), retiring a
+        # selected candidate only on the distance key re-selects the same
+        # train index k times. Labels are distinct so a duplicated index
+        # flips the vote: oracle admits inf candidates in index order
+        # (neighbors 0,1,2 -> labels 0,1,1 -> vote 1).
+        train_x = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+        train_y = np.array([0, 1, 1, 2], np.int32)
+        test_x = np.array([[np.nan]], np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 3, 3)
+        got = predict_pallas(
+            train_x, train_y, test_x, 3, 3,
+            block_q=8, block_n=8, interpret=True,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_backend_registered(self, small):
+        from knn_tpu.models.knn import KNNClassifier
+
+        train, test = small
+        want = knn_oracle(
+            train.features, train.labels, test.features, 1, train.num_classes
+        )
+        model = KNNClassifier(k=1, backend="tpu-pallas").fit(train)
+        got = model.predict(test)
+        np.testing.assert_array_equal(got, want)
+
+    def test_wide_features_mnist_shaped(self, rng):
+        # BASELINE config-5 shape class: D=784 (pads to 896 lanes), parity on
+        # an integer grid where the matmul expansion is exact.
+        train_x = rng.integers(0, 2, (600, 784)).astype(np.float32)
+        train_y = rng.integers(0, 10, 600).astype(np.int32)
+        test_x = np.concatenate(
+            [train_x[:20], rng.integers(0, 2, (12, 784)).astype(np.float32)]
+        )
+        want = knn_oracle(train_x, train_y, test_x, 5, 10)
+        got = predict_pallas(
+            train_x, train_y, test_x, 5, 10,
+            block_q=32, block_n=256, interpret=True,
+        )
+        np.testing.assert_array_equal(got, want)
