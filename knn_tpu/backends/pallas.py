@@ -23,8 +23,8 @@ def predict(
     train: Dataset,
     test: Dataset,
     k: int,
-    block_q: int = 128,
-    block_n: int = 512,
+    block_q: int = 256,
+    block_n: int = 1024,
     interpret: Optional[bool] = None,
     precision: str = "auto",
     **_unused,

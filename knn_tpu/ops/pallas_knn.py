@@ -131,8 +131,8 @@ def knn_pallas_candidates(
     test_x: jnp.ndarray,
     n_valid: jnp.ndarray,
     k: int,
-    block_q: int = 128,
-    block_n: int = 512,
+    block_q: int = 256,
+    block_n: int = 1024,
     interpret: bool = False,
     d_true: Optional[int] = None,
     precision: str = "exact",
@@ -189,8 +189,8 @@ def predict_pallas(
     test_x: np.ndarray,
     k: int,
     num_classes: int,
-    block_q: int = 128,
-    block_n: int = 512,
+    block_q: int = 256,
+    block_n: int = 1024,
     interpret: Optional[bool] = None,
     precision: str = "exact",
 ) -> np.ndarray:
