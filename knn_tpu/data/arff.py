@@ -85,3 +85,55 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
             ),
         )
     return ds
+
+
+def _quote_if_needed(name: str) -> str:
+    if name and not any(c.isspace() for c in name) and "," not in name:
+        return name
+    return "'" + name.replace("'", "\\'") + "'"
+
+
+def write_arff(ds: Dataset, path: str) -> None:
+    """Serialize a :class:`Dataset` back to ARFF.
+
+    The reference *declares* this capability (``ArffData::write_arff``,
+    libarff/arff_data.h:131) but never implements it (arff_data.cpp:167);
+    here it exists. The output round-trips through :func:`load_arff` to
+    identical arrays: features with NaN written as ``?``, labels as integers,
+    nominal cells mapped back to their declared value strings.
+    """
+    n, d = ds.features.shape
+    attrs = list(ds.attributes)
+    if not attrs:
+        attrs = [Attribute(f"attr{i}", "numeric") for i in range(d)] + [
+            Attribute("class", "numeric")
+        ]
+    if len(attrs) != d + 1:
+        raise ValueError(
+            f"dataset declares {len(attrs)} attributes but has {d} feature "
+            f"columns + 1 class column"
+        )
+
+    def attr_line(a: Attribute) -> str:
+        if a.type == "nominal":
+            vals = ",".join(a.nominal_values or [])
+            return f"@attribute {_quote_if_needed(a.name)} {{{vals}}}"
+        return f"@attribute {_quote_if_needed(a.name)} {a.type.upper()}"
+
+    def cell(value: float, a: Attribute) -> str:
+        if np.isnan(value):
+            return "?"
+        if a.type == "nominal" and a.nominal_values:
+            return str(a.nominal_values[int(value)])
+        f = float(value)
+        return str(int(f)) if f.is_integer() else repr(f)
+
+    with open(path, "w", encoding="utf-8") as out:
+        out.write(f"@relation {_quote_if_needed(ds.relation or 'dataset')}\n\n")
+        for a in attrs:
+            out.write(attr_line(a) + "\n")
+        out.write("\n@data\n")
+        for r in range(n):
+            row = [cell(ds.features[r, c], attrs[c]) for c in range(d)]
+            row.append(cell(float(ds.labels[r]), attrs[d]))
+            out.write(",".join(row) + "\n")

@@ -155,3 +155,54 @@ class TestFixtures:
         train, test = large
         train_rows = {r.tobytes() for r in train.features}
         assert all(r.tobytes() in train_rows for r in test.features)
+
+
+class TestWriteArff:
+    """write_arff — the capability the reference declares but never implements
+    (libarff/arff_data.h:131, arff_data.cpp:167)."""
+
+    def test_roundtrip_fixture(self, small, tmp_path):
+        from knn_tpu.data.arff import load_arff, write_arff
+
+        train, _ = small
+        out = tmp_path / "rt.arff"
+        write_arff(train, str(out))
+        back = load_arff(str(out))
+        np.testing.assert_array_equal(back.features, train.features)
+        np.testing.assert_array_equal(back.labels, train.labels)
+        assert back.num_classes == train.num_classes
+
+    def test_roundtrip_nan_and_nominal(self, tmp_path):
+        from knn_tpu.data.arff import load_arff, write_arff
+        from knn_tpu.data.dataset import Attribute, Dataset
+
+        ds = Dataset(
+            features=np.array([[1.5, 0.0], [np.nan, 1.0]], np.float32),
+            labels=np.array([0, 2], np.int32),
+            relation="with space",
+            attributes=[
+                Attribute("x", "numeric"),
+                Attribute("color", "nominal", ["red", "green"]),
+                Attribute("class", "numeric"),
+            ],
+        )
+        out = tmp_path / "rt.arff"
+        write_arff(ds, str(out))
+        back = load_arff(str(out))
+        np.testing.assert_array_equal(back.labels, ds.labels)
+        assert np.isnan(back.features[1, 0])
+        np.testing.assert_array_equal(back.features[:, 1], ds.features[:, 1])
+        assert back.relation == "with space"
+        assert back.attributes[1].nominal_values == ["red", "green"]
+
+    def test_attr_mismatch_rejected(self, tmp_path):
+        from knn_tpu.data.arff import write_arff
+        from knn_tpu.data.dataset import Attribute, Dataset
+
+        ds = Dataset(
+            features=np.zeros((1, 2), np.float32),
+            labels=np.zeros(1, np.int32),
+            attributes=[Attribute("only-one", "numeric")],
+        )
+        with pytest.raises(ValueError):
+            write_arff(ds, str(tmp_path / "bad.arff"))
