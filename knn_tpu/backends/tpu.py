@@ -39,7 +39,9 @@ from knn_tpu.utils.padding import pad_axis_to_multiple
 _DIST_FNS = {"exact": pairwise_sq_dists, "fast": pairwise_sq_dists_dot}
 
 
-@functools.partial(jax.jit, static_argnames=("k", "num_classes", "precision"))
+@functools.partial(
+    jax.jit, static_argnames=("k", "num_classes", "precision", "approx")
+)
 def knn_forward(
     train_x: jnp.ndarray,
     train_y: jnp.ndarray,
@@ -47,11 +49,21 @@ def knn_forward(
     k: int,
     num_classes: int,
     precision: str = "exact",
+    approx: bool = False,
 ) -> jnp.ndarray:
     """Full-matrix KNN classify: [N,D] train, [N] labels, [Q,D] queries ->
-    [Q] int32 predictions."""
+    [Q] int32 predictions.
+
+    ``approx=True`` swaps ``lax.top_k`` for ``lax.approx_max_k`` — the TPU's
+    hardware-accelerated approximate selection (default target recall 0.95).
+    A capability with no reference analogue: trade exact candidate selection
+    for throughput on very large N. Not prediction-parity; opt-in only."""
     d = _DIST_FNS[precision](test_x, train_x)
-    _, idx = topk_smallest(d, k)
+    if approx:
+        _, idx = lax.approx_max_k(-d, k)
+        idx = idx.astype(jnp.int32)
+    else:
+        _, idx = topk_smallest(d, k)
     return vote(train_y[idx], num_classes)
 
 
@@ -197,14 +209,16 @@ def predict_arrays(
     query_tile: int = 256,
     train_tile: int = 2048,
     force_tiled: bool = False,
+    approx: bool = False,
 ) -> np.ndarray:
-    """Host-side entry: pads, dispatches to the right compiled path, unpads."""
+    """Host-side entry: pads, dispatches to the right compiled path, unpads.
+    ``approx`` (full-matrix path only) uses TPU hardware approximate top-k."""
     q = test_x.shape[0]
     n = train_x.shape[0]
-    if not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT:
+    if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
         out = knn_forward(
             jnp.asarray(train_x), jnp.asarray(train_y), jnp.asarray(test_x),
-            k=k, num_classes=num_classes, precision=precision,
+            k=k, num_classes=num_classes, precision=precision, approx=approx,
         )
         return np.asarray(out)
 
@@ -230,11 +244,12 @@ def predict(
     query_tile: int = 256,
     train_tile: int = 2048,
     force_tiled: bool = False,
+    approx: bool = False,
     **_unused,
 ) -> np.ndarray:
     train.validate_for_knn(k, test)
     return predict_arrays(
         train.features, train.labels, test.features, k, train.num_classes,
         precision=precision, query_tile=query_tile, train_tile=train_tile,
-        force_tiled=force_tiled,
+        force_tiled=force_tiled, approx=approx,
     )

@@ -69,6 +69,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--warmup", action="store_true",
                    help="run once before timing (excludes compile time)")
+    p.add_argument("--approx", action="store_true",
+                   help="TPU hardware approximate top-k (not prediction-exact)")
     return p
 
 
@@ -123,6 +125,8 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
     )
     if args.precision != "auto":
         opts["precision"] = args.precision
+    if args.approx:
+        opts["approx"] = True
     if args.threads is not None:
         opts["num_threads"] = args.threads
     if args.devices is not None:

@@ -105,3 +105,36 @@ class TestGolden:
         train, test = large
         model = KNNClassifier(k=5, backend="tpu", force_tiled=True).fit(train)
         assert round(model.score(test), 4) == 0.9948
+
+
+class TestApproxTopK:
+    def test_approx_mode_runs_and_is_close(self, small):
+        # lax.approx_max_k: exact on CPU's fallback path, >=0.95 recall on
+        # TPU hardware. Opt-in, documented as not prediction-exact.
+        train, test = small
+        want = knn_oracle(
+            train.features, train.labels, test.features, 5, train.num_classes
+        )
+        got = predict_arrays(
+            train.features, train.labels, test.features, 5, train.num_classes,
+            approx=True,
+        )
+        assert got.shape == want.shape
+        assert (got == want).mean() >= 0.9
+
+    def test_cli_flag_plumbs_through(self, small, tmp_path):
+        import io
+
+        from knn_tpu.cli import run
+
+        from tests.fixtures import datasets_dir
+
+        d = datasets_dir()
+        buf = io.StringIO()
+        rc = run(
+            [str(d / "small-train.arff"), str(d / "small-test.arff"), "5",
+             "--backend", "tpu", "--approx", "--platform", "cpu"],
+            stdout=buf,
+        )
+        assert rc == 0
+        assert "The 5-NN classifier for 80 test instances" in buf.getvalue()
