@@ -55,8 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--persona", choices=sorted(_PERSONAS), default="tpu")
     p.add_argument("--backend", default=None, help="override the persona's backend")
     p.add_argument(
-        "--precision", choices=["exact", "fast", "auto"], default="exact",
+        "--precision", choices=["exact", "fast", "bf16", "auto"], default="exact",
         help="distance form: exact (reference parity), fast (MXU matmul), "
+        "bf16 (bfloat16 MXU operands, tpu-pallas only), "
         "auto (defer to the backend's default)",
     )
     p.add_argument("--query-tile", type=int, default=256)

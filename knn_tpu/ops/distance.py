@@ -37,3 +37,25 @@ def pairwise_sq_dists_dot(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarr
     cross = queries @ train.T  # [Q, N] — MXU
     d = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
     return jnp.where(jnp.isnan(d), jnp.inf, d)
+
+
+def pairwise_sq_dists_bf16(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
+    """Dot-form distances with bfloat16 MXU operands (float32 accumulation):
+    2x matmul throughput at ~3 fewer mantissa digits in the cross term. The
+    norm terms stay float32."""
+    q2 = jnp.sum(queries * queries, axis=-1, keepdims=True)
+    t2 = jnp.sum(train * train, axis=-1)[None, :]
+    cross = jnp.dot(
+        queries.astype(jnp.bfloat16),
+        train.astype(jnp.bfloat16).T,
+        preferred_element_type=jnp.float32,
+    )
+    d = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+    return jnp.where(jnp.isnan(d), jnp.inf, d)
+
+
+_DIST_FNS = {
+    "exact": pairwise_sq_dists,
+    "fast": pairwise_sq_dists_dot,
+    "bf16": pairwise_sq_dists_bf16,
+}

@@ -83,11 +83,15 @@ def _knn_kernel(
 
     q = q_ref[:]  # [BQ, D]
     t = t_ref[:]  # [BN, D]
-    if precision == "fast":
+    if precision in ("fast", "bf16"):
         # MXU distance block: |q|^2 - 2 q·t + |t|^2, clamped at 0. One matmul,
-        # but catastrophic cancellation perturbs near-zero distances.
+        # but catastrophic cancellation perturbs near-zero distances. "bf16"
+        # additionally feeds the MXU bfloat16 operands (f32 accumulation) for
+        # 2x matmul throughput at ~3 fewer mantissa digits in the cross term.
         q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
         t2 = jnp.sum(t * t, axis=1, keepdims=True).T  # [1, BN]
+        if precision == "bf16":
+            q, t = q.astype(jnp.bfloat16), t.astype(jnp.bfloat16)
         cross = jax.lax.dot_general(
             q, t,
             dimension_numbers=(((1,), (1,)), ((), ())),

@@ -27,13 +27,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from knn_tpu.backends import register
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.ops.distance import pairwise_sq_dists, pairwise_sq_dists_dot
+from knn_tpu.ops.distance import _DIST_FNS
 from knn_tpu.ops.topk import merge_topk_labeled
 from knn_tpu.ops.vote import vote
 from knn_tpu.parallel.mesh import make_mesh
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
-_DIST_FNS = {"exact": pairwise_sq_dists, "fast": pairwise_sq_dists_dot}
 
 
 def build_ring_fn(

@@ -113,6 +113,21 @@ class TestPallasKernel:
         got = model.predict(test)
         np.testing.assert_array_equal(got, want)
 
+    def test_bf16_precision_parity_on_small_ints(self, rng):
+        # bfloat16 represents small integers exactly, so on a 0/1 grid the
+        # bf16 MXU path must match the oracle bit-for-bit.
+        train_x = rng.integers(0, 2, (300, 32)).astype(np.float32)
+        train_y = rng.integers(0, 6, 300).astype(np.int32)
+        test_x = np.concatenate(
+            [train_x[:10], rng.integers(0, 2, (14, 32)).astype(np.float32)]
+        )
+        want = knn_oracle(train_x, train_y, test_x, 3, 6)
+        got = predict_pallas(
+            train_x, train_y, test_x, 3, 6,
+            block_q=8, block_n=128, interpret=True, precision="bf16",
+        )
+        np.testing.assert_array_equal(got, want)
+
     def test_wide_features_mnist_shaped(self, rng):
         # BASELINE config-5 shape class: D=784 (pads to 896 lanes), parity on
         # an integer grid where the matmul expansion is exact.
