@@ -49,6 +49,41 @@ class KNNClassifier:
         fn = get_backend(self.backend_name)
         return fn(self.train_, test, self.k, **self.backend_opts)
 
+    def kneighbors(self, test: Dataset):
+        """Per-query neighbor candidates: ``(dists [Q,k], indices [Q,k])``
+        sorted ascending by (distance, train index) — the framework's
+        tie-break order. No reference analogue (its kernel discards the
+        candidate set after voting, main.cpp:64-78); standard retrieval API.
+        """
+        import jax.numpy as jnp
+
+        from knn_tpu.backends.tpu import forward_candidates_core
+        from knn_tpu.utils.padding import pad_axis_to_multiple
+
+        train = self.train_
+        train.validate_for_knn(self.k, test)
+        q = test.num_instances
+        train_tile = max(min(2048, train.num_instances), self.k)
+        tx, _ = pad_axis_to_multiple(train.features, train_tile, axis=0)
+        ty, _ = pad_axis_to_multiple(train.labels, train_tile, axis=0)
+        qx, _ = pad_axis_to_multiple(test.features, 128, axis=0)
+        d, i, _ = forward_candidates_core(
+            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+            jnp.asarray(train.num_instances, jnp.int32),
+            k=self.k, train_tile=train_tile,
+        )
+        return np.asarray(d)[:q], np.asarray(i)[:q]
+
+    def predict_proba(self, test: Dataset) -> np.ndarray:
+        """[Q, num_classes] neighbor-vote fractions (counts / k)."""
+        train = self.train_
+        _, idx = self.kneighbors(test)
+        labels = train.labels[np.minimum(idx, train.num_instances - 1)]
+        counts = np.apply_along_axis(
+            np.bincount, 1, labels, minlength=train.num_classes
+        )
+        return counts.astype(np.float64) / self.k
+
     def confusion_matrix(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> np.ndarray:
         if predictions is None:
             predictions = self.predict(test)

@@ -112,3 +112,38 @@ def _bruteforce(train_x, train_y, test_x, k, num_classes):
                 best, best_c = cnt, ci
         out.append(best_c)
     return np.array(out, np.int32)
+
+
+class TestModelAPI:
+    """kneighbors / predict_proba — retrieval surface beyond the reference."""
+
+    def test_kneighbors_matches_oracle_order(self, rng):
+        from knn_tpu.data.dataset import Dataset
+        from knn_tpu.models.knn import KNNClassifier
+
+        base = rng.integers(0, 3, (40, 4)).astype(np.float32)
+        train_x = np.tile(base, (4, 1))  # duplicates -> dist==0 ties
+        train_y = rng.integers(0, 5, 160).astype(np.int32)
+        test_x = base[:12]
+        train = Dataset(features=train_x, labels=train_y)
+        test = Dataset(features=test_x, labels=np.zeros(12, np.int32))
+        k = 6
+        model = KNNClassifier(k=k, backend="tpu").fit(train)
+        d, i = model.kneighbors(test)
+        assert d.shape == (12, k) and i.shape == (12, k)
+        # Reference tie-break order: stable lexicographic (distance, index).
+        diff = test_x[:, None, :] - train_x[None, :, :]
+        dists = np.einsum("qnd,qnd->qn", diff, diff, dtype=np.float32)
+        for row in range(12):
+            want = np.lexsort((np.arange(160), dists[row]))[:k]
+            np.testing.assert_array_equal(i[row], want)
+
+    def test_predict_proba_consistent_with_predict(self, small):
+        from knn_tpu.models.knn import KNNClassifier
+
+        train, test = small
+        model = KNNClassifier(k=5, backend="tpu").fit(train)
+        proba = model.predict_proba(test)
+        assert proba.shape == (test.num_instances, train.num_classes)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0)
+        np.testing.assert_array_equal(proba.argmax(axis=1), model.predict(test))
