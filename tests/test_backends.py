@@ -1,0 +1,50 @@
+"""Cross-backend prediction equality — the framework-wide catch-all.
+
+The reference's de-facto verification is accuracy equality across its three
+binaries (SURVEY.md §4); this is the stronger form: every registered backend
+must produce *identical predictions* (not just accuracy) on a tie-heavy
+problem, so a new backend cannot silently diverge on the §3.5 contract.
+"""
+
+import numpy as np
+import pytest
+
+from knn_tpu.backends import available_backends, get_backend
+from knn_tpu.backends.oracle import knn_oracle
+from knn_tpu.data.dataset import Dataset
+
+
+@pytest.fixture(scope="module")
+def tie_problem():
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 4, (50, 6)).astype(np.float32)
+    train_x = np.tile(base, (5, 1))  # every row 5x -> dist==0 ties everywhere
+    train_y = rng.integers(0, 7, 250).astype(np.int32)
+    test_x = np.concatenate(
+        [base[:20], rng.integers(0, 4, (13, 6)).astype(np.float32)]
+    )
+    train = Dataset(features=train_x, labels=train_y)
+    test = Dataset(features=test_x, labels=np.zeros(33, np.int32))
+    want = knn_oracle(train_x, train_y, test_x, 5, train.num_classes)
+    return train, test, want
+
+
+def test_all_backends_registered():
+    names = available_backends()
+    for expected in (
+        "oracle", "tpu", "tpu-sharded", "tpu-train-sharded", "tpu-ring",
+        "tpu-pallas", "native", "native-mt",
+    ):
+        assert expected in names, f"backend '{expected}' missing from registry"
+
+
+@pytest.mark.parametrize("name", [
+    "oracle", "tpu", "tpu-sharded", "tpu-train-sharded", "tpu-ring",
+    "tpu-pallas", "native", "native-mt",
+])
+def test_backend_prediction_equality(tie_problem, name):
+    if name not in available_backends():
+        pytest.skip(f"{name} unavailable in this environment")
+    train, test, want = tie_problem
+    got = get_backend(name)(train, test, 5)
+    np.testing.assert_array_equal(got, want)
