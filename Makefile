@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu test bench clean
+.PHONY: all native main multi-thread mpi tpu test bench parity clean
 
 all: native main multi-thread mpi tpu
 
@@ -46,6 +46,9 @@ test:
 
 bench:
 	python3 bench.py
+
+parity:
+	python3 scripts/parity_report.py
 
 clean:
 	rm -rf $(LIB_DIR) main multi-thread mpi tpu build/fixtures

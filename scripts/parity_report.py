@@ -1,0 +1,89 @@
+"""Regenerate the SURVEY.md §6 accuracy table across backends and assert
+prediction-level parity (SURVEY.md §7 step 8).
+
+Runs every requested backend over the dataset ladder x k grid, checks exact
+prediction equality against the oracle (stronger than the reference's
+accuracy-equality grading, SURVEY.md §4), and prints a markdown table with
+golden-accuracy checkmarks.
+
+Usage:
+  python scripts/parity_report.py [--backends tpu,tpu-pallas,...] [--large]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+GOLDEN = {
+    ("small", 1): 0.8500, ("small", 5): 0.8625,
+    ("medium", 5): 0.3081,
+    ("large", 1): 0.9919, ("large", 5): 0.9948, ("large", 10): 0.7538,
+}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--backends", default="oracle,native,native-mt,tpu,tpu-pallas")
+    p.add_argument("--large", action="store_true",
+                   help="include the large dataset (slow off-TPU)")
+    args = p.parse_args()
+
+    from knn_tpu.backends import available_backends, get_backend
+    from knn_tpu.utils.evaluate import confusion_matrix, accuracy
+    from tests.fixtures import load_pair, using_reference_datasets
+
+    configs = [("small", 1), ("small", 5), ("medium", 5)]
+    if args.large:
+        configs += [("large", 1), ("large", 5), ("large", 10)]
+    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    missing = [b for b in backends if b not in available_backends()]
+    if missing:
+        print(f"note: skipping unavailable backends {missing}", file=sys.stderr)
+        backends = [b for b in backends if b not in missing]
+
+    is_ref = using_reference_datasets()
+    rows = []
+    failures = 0
+    for size, k in configs:
+        train, test = load_pair(size)
+        golden = None
+        for name in backends:
+            t0 = time.monotonic()
+            preds = get_backend(name)(train, test, k)
+            ms = (time.monotonic() - t0) * 1e3
+            acc = accuracy(confusion_matrix(preds, test.labels, test.num_classes))
+            if golden is None:
+                golden = preds
+                parity = "oracle"
+            else:
+                parity = "==" if np.array_equal(preds, golden) else "DIVERGED"
+                if parity == "DIVERGED":
+                    failures += 1
+            gold_ok = ""
+            if is_ref and (size, k) in GOLDEN:
+                gold_ok = " ✓" if round(acc, 4) == GOLDEN[(size, k)] else " ✗GOLDEN"
+                if "✗" in gold_ok:
+                    failures += 1
+            rows.append((size, k, name, acc, ms, parity + gold_ok))
+
+    print(f"| dataset | k | backend | accuracy | ms | parity |")
+    print(f"|---|---|---|---|---|---|")
+    for size, k, name, acc, ms, parity in rows:
+        print(f"| {size} | {k} | {name} | {acc:.4f} | {ms:.0f} | {parity} |")
+    if failures:
+        print(f"\n{failures} PARITY FAILURE(S)", file=sys.stderr)
+        return 1
+    print(f"\nall {len(rows)} runs prediction-identical"
+          + (" and golden-accurate" if is_ref else " (synthetic fixtures)"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
