@@ -25,6 +25,7 @@ C++ parser in ``knn_tpu/native/arff`` (bound via ctypes in
 from __future__ import annotations
 
 import math
+import re
 from typing import Iterable, Optional
 
 import numpy as np
@@ -32,6 +33,11 @@ import numpy as np
 from knn_tpu.data.dataset import Attribute, Dataset
 
 _NUMERIC_TYPES = {"numeric", "real", "integer"}
+
+# The ASCII whitespace set the native parser strips (arff_c.cc::strip);
+# using str.strip() default would also eat Unicode whitespace (\x0c, NBSP)
+# and silently diverge from the C++ implementation.
+_WS = " \t\r\n"
 
 
 class ArffError(ValueError):
@@ -44,29 +50,51 @@ class ArffError(ValueError):
 
 
 def _split_csv(line: str, path: str, lineno: int) -> list:
-    """Split a data row on commas, honoring single/double quotes."""
-    out, buf, quote = [], [], None
+    """Split a row on commas, honoring single/double quotes. Quoted content is
+    preserved verbatim (the reference lexer copies chars between quotes as-is,
+    arff_lexer.cpp:159-188 — ``' '`` is the one-space token, not empty); only
+    *unquoted* edge whitespace is trimmed."""
+    out = []
+    buf: list = []
+    quote = None
+    first_q = None  # index range [first_q, last_q) of quoted chars in buf
+    last_q = 0
+
+    def flush():
+        nonlocal buf, first_q, last_q
+        start, end = 0, len(buf)
+        fq = first_q if first_q is not None else end
+        while start < end and start < fq and buf[start] in " \t":
+            start += 1
+        while end > start and end > last_q and buf[end - 1] in " \t":
+            end -= 1
+        out.append("".join(buf[start:end]))
+        buf = []
+        first_q, last_q = None, 0
+
     for ch in line:
         if quote is not None:
             if ch == quote:
                 quote = None
             else:
+                if first_q is None:
+                    first_q = len(buf)
                 buf.append(ch)
+                last_q = len(buf)
         elif ch in ("'", '"'):
             quote = ch
         elif ch == ",":
-            out.append("".join(buf).strip())
-            buf = []
+            flush()
         else:
             buf.append(ch)
     if quote is not None:
         raise ArffError(path, lineno, "unterminated quoted value")
-    out.append("".join(buf).strip())
+    flush()
     return out
 
 
 def _parse_attribute(rest: str, path: str, lineno: int) -> Attribute:
-    rest = rest.strip()
+    rest = rest.strip(_WS)
     if not rest:
         raise ArffError(path, lineno, "@attribute needs a name and a type")
     # Name may be quoted.
@@ -75,20 +103,32 @@ def _parse_attribute(rest: str, path: str, lineno: int) -> Attribute:
         end = rest.find(q, 1)
         if end < 0:
             raise ArffError(path, lineno, "unterminated quoted attribute name")
-        name, rest = rest[1:end], rest[end + 1 :].strip()
+        name, rest = rest[1:end], rest[end + 1 :].strip(_WS)
     else:
-        parts = rest.split(None, 1)
+        parts = re.split(r"[ \t]+", rest, maxsplit=1)
         if len(parts) < 2:
             raise ArffError(path, lineno, f"@attribute '{parts[0]}' is missing a type")
-        name, rest = parts[0], parts[1].strip()
+        name, rest = parts[0], parts[1].strip(_WS)
     if not rest:
         raise ArffError(path, lineno, f"@attribute '{name}' is missing a type")
     if rest.startswith("{"):
         if not rest.endswith("}"):
             raise ArffError(path, lineno, "unterminated nominal value list")
-        values = _split_csv(rest[1:-1], path, lineno)
+        inner = rest[1:-1]
+        # "{a,b,}" is reference-valid: the comma before "}" is consumed as
+        # the previous token's terminator (arff_lexer.cpp:190, then
+        # next_token's unconditional advance) and "}" lexes as BRKT_CLOSE.
+        # Only a literal trailing comma is absorbed — a quoted-empty final
+        # value ({a,''}) still hits the empty-value error below. "{}" is an
+        # empty nominal set (reference: BRKT_CLOSE immediately ends the
+        # value loop).
+        values = [] if inner.strip(_WS) == "" else _split_csv(inner, path, lineno)
+        if values and values[-1] == "" and inner.rstrip(" \t").endswith(","):
+            values.pop()
+        if any(v == "" for v in values):
+            raise ArffError(path, lineno, "empty value in nominal list")
         return Attribute(name, "nominal", values)
-    type_word = rest.split()[0].lower()
+    type_word = re.split(r"[ \t]+", rest, maxsplit=1)[0].lower()
     if type_word in _NUMERIC_TYPES:
         return Attribute(name, "numeric")
     if type_word == "string":
@@ -132,19 +172,29 @@ def parse_arff_lines(
     rows: list = []
     in_data = False
     pending: list = []  # cells carried across physical lines (multi-line rows)
-    pending_line = 0
 
     for lineno, raw in enumerate(lines, start=1):
-        line = raw.strip()
+        line = raw.strip(_WS)
         if not line or line.startswith("%"):
             continue
         if not in_data and line.startswith("@"):
-            parts = line.split(None, 1)  # any whitespace separates the keyword
+            # ASCII space/tab separates the keyword — same set as the
+            # native parser (arff_c.cc find_first_of(" \t")), NOT
+            # Unicode whitespace.
+            parts = re.split(r"[ \t]+", line, maxsplit=1)
             word = parts[0]
             rest = parts[1] if len(parts) > 1 else ""
             key = word.lower()
             if key == "@relation":
-                relation = rest.strip().strip("'\"")
+                # Strip exactly one matched outer quote pair (same rule as
+                # the native parser) — not a greedy strip of quote chars.
+                relation = rest.strip(_WS)
+                if (
+                    len(relation) >= 2
+                    and relation[0] in ("'", '"')
+                    and relation[-1] == relation[0]
+                ):
+                    relation = relation[1:-1]
             elif key == "@attribute":
                 attributes.append(_parse_attribute(rest, path, lineno))
             elif key == "@data":
@@ -159,6 +209,18 @@ def parse_arff_lines(
         if line.startswith("{"):
             raise ArffError(path, lineno, "sparse ARFF rows are not supported")
         cells = _split_csv(line, path, lineno)
+        # A *trailing* comma is absorbed — the reference lexer stops a token
+        # on the comma and next_token's unconditional advance consumes it
+        # (arff_lexer.cpp:93,190) — so "1,2," tokenizes exactly like "1,2"
+        # (commonly a row continued on the next physical line). But a comma
+        # at token-START position (a ",3" continuation line, or ",,"
+        # interior) makes _read_str return "" which lexes as a spurious
+        # END_OF_FILE (arff_lexer.cpp:125-127), silently truncating the
+        # dataset there — a defect we replace with a clean located error.
+        if cells and cells[-1] == "" and line.endswith(","):
+            cells.pop()
+        if "" in cells:
+            raise ArffError(path, lineno, "empty value in data row")
         if pending:
             cells = pending + cells
             pending = []
@@ -167,7 +229,6 @@ def parse_arff_lines(
         # carry short rows forward rather than erroring immediately.
         if len(cells) < len(attributes):
             pending = cells
-            pending_line = lineno
             continue
         if len(cells) > len(attributes):
             raise ArffError(

@@ -63,23 +63,41 @@ std::string strip(const std::string& s) {
   return s.substr(b, e - b + 1);
 }
 
-// Split a line on commas honoring single/double quotes.
+// Split a line on commas honoring single/double quotes. Quoted content is
+// preserved verbatim (the reference lexer copies chars between quotes as-is,
+// arff_lexer.cpp:159-188 — "' '" is the one-space token, not empty); only
+// *unquoted* edge whitespace is trimmed.
 bool split_csv(const std::string& line, std::vector<std::string>& out,
                ParseState& st) {
   out.clear();
   std::string buf;
   char quote = 0;
+  size_t first_q = std::string::npos;  // [first_q, last_q) = quoted chars
+  size_t last_q = 0;
+  auto flush = [&]() {
+    size_t b = 0, e = buf.size();
+    size_t fq = first_q == std::string::npos ? e : first_q;
+    while (b < e && b < fq && (buf[b] == ' ' || buf[b] == '\t')) ++b;
+    while (e > b && e > last_q && (buf[e - 1] == ' ' || buf[e - 1] == '\t'))
+      --e;
+    out.push_back(buf.substr(b, e - b));
+    buf.clear();
+    first_q = std::string::npos;
+    last_q = 0;
+  };
   for (char ch : line) {
     if (quote) {
-      if (ch == quote)
+      if (ch == quote) {
         quote = 0;
-      else
+      } else {
+        if (first_q == std::string::npos) first_q = buf.size();
         buf.push_back(ch);
+        last_q = buf.size();
+      }
     } else if (ch == '\'' || ch == '"') {
       quote = ch;
     } else if (ch == ',') {
-      out.push_back(strip(buf));
-      buf.clear();
+      flush();
     } else {
       buf.push_back(ch);
     }
@@ -88,7 +106,7 @@ bool split_csv(const std::string& line, std::vector<std::string>& out,
     fail(st, "unterminated quoted value");
     return false;
   }
-  out.push_back(strip(buf));
+  flush();
   return true;
 }
 
@@ -127,8 +145,26 @@ bool parse_attribute(const std::string& rest_in, ParseState& st) {
       return false;
     }
     attr.type = "nominal";
+    std::string inner = rest.substr(1, rest.size() - 2);
     std::vector<std::string> vals;
-    if (!split_csv(rest.substr(1, rest.size() - 2), vals, st)) return false;
+    // "{a,b,}" is reference-valid: the comma before "}" is consumed as the
+    // previous token's terminator (arff_lexer.cpp:190, then next_token's
+    // unconditional advance) and "}" lexes as BRKT_CLOSE. Only a literal
+    // trailing comma is absorbed — a quoted-empty final value ({a,''})
+    // still hits the empty-value error below. "{}" is an empty nominal set
+    // (reference: BRKT_CLOSE immediately ends the value loop).
+    if (!strip(inner).empty()) {
+      if (!split_csv(inner, vals, st)) return false;
+      size_t lp = inner.find_last_not_of(" \t");
+      if (!vals.empty() && vals.back().empty() && lp != std::string::npos &&
+          inner[lp] == ',')
+        vals.pop_back();
+      for (const std::string& v : vals)
+        if (v.empty()) {
+          fail(st, "empty value in nominal list");
+          return false;
+        }
+    }
     attr.nominal = vals;
   } else {
     size_t sp = rest.find_first_of(" \t");
@@ -223,6 +259,21 @@ bool parse_buffer(const std::string& data, ParseState& st) {
       return false;
     }
     if (!split_csv(line, cells, st)) return false;
+    // A *trailing* comma is absorbed — the reference lexer stops a token on
+    // the comma and next_token's unconditional advance consumes it
+    // (arff_lexer.cpp:93,190) — so "1,2," tokenizes like "1,2" (commonly a
+    // row continued on the next physical line). But a comma at token-START
+    // position (a ",3" continuation line, or ",," interior) makes _read_str
+    // return "" which lexes as a spurious END_OF_FILE
+    // (arff_lexer.cpp:125-127), silently truncating the dataset there — a
+    // defect replaced here with a clean located error.
+    if (!cells.empty() && cells.back().empty() && line.back() == ',')
+      cells.pop_back();
+    for (const std::string& c : cells)
+      if (c.empty()) {
+        fail(st, "empty value in data row");
+        return false;
+      }
     if (!pending.empty()) {
       pending.insert(pending.end(), cells.begin(), cells.end());
       cells.swap(pending);
@@ -335,7 +386,9 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
       free(out->features);
       free(out->labels);
       memset(out, 0, sizeof(*out));
-      out->error = dup_string(st.path + ": instance " + std::to_string(i) +
+      // ":0:" — instance index, not line, is known here; same format as the
+      // Python parser's ArffError(path, 0, ...) for this case.
+      out->error = dup_string(st.path + ":0: instance " + std::to_string(i) +
                               " has a missing class label");
       return 1;
     }
@@ -351,7 +404,7 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
     j += "{\"name\":\"";
     json_escape(st.attrs[a].name, j);
     j += "\",\"type\":\"" + st.attrs[a].type + "\"";
-    if (!st.attrs[a].nominal.empty()) {
+    if (st.attrs[a].type == "nominal") {  // emit [] for "{}" (parity with py)
       j += ",\"nominal_values\":[";
       for (size_t v = 0; v < st.attrs[a].nominal.size(); ++v) {
         if (v) j += ",";

@@ -1,0 +1,136 @@
+"""Breakdown of the headline step: distance matrix vs top-k selection, plus
+alternative exact top-k formulations on the full [Q, N] matrix.
+
+Usage: python scripts/tune_breakdown.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+K = 5
+
+
+def slope(mkstep, bufs, r_lo=20, r_hi=80):
+    import jax
+
+    def timed(reps):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.monotonic()
+            out = None
+            for i in range(reps):
+                out = mkstep(bufs[i % len(bufs)])
+            jax.block_until_ready(out)
+            best = min(best, time.monotonic() - t0)
+        return best
+
+    t_lo, t_hi = timed(r_lo), timed(r_hi)
+    return (t_hi - t_lo) / (r_hi - r_lo)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bench import load_large
+    from knn_tpu.ops.distance import _DIST_FNS
+    from knn_tpu.ops.vote import vote
+    from knn_tpu.utils.evaluate import confusion_matrix, accuracy
+
+    train, test, _ = load_large()
+    q = test.num_instances
+    nc = train.num_classes
+    tx = jnp.asarray(train.features)
+    ty = jnp.asarray(train.labels)
+    bufs = [jnp.asarray(test.features + np.float32(i) * 1e-7) for i in range(8)]
+    jax.block_until_ready(bufs)
+    dist = _DIST_FNS["exact"]
+
+    @jax.jit
+    def d_only(qb):
+        return dist(qb, tx)
+
+    d_bufs = [d_only(b) for b in bufs]
+    jax.block_until_ready(d_bufs)
+
+    @jax.jit
+    def topk_only(d):
+        return lax.top_k(-d, K)
+
+    @functools.partial(jax.jit, static_argnames=())
+    def rounds_only(d):
+        # 5 rounds of (min, argmin-by-lowest-index, retire) — pure VPU.
+        n = d.shape[1]
+        idx = lax.broadcasted_iota(jnp.int32, d.shape, 1)
+        outs = []
+        for _ in range(K):
+            m = jnp.min(d, axis=1, keepdims=True)
+            is_min = d == m
+            sel = jnp.min(jnp.where(is_min, idx, np.int32(2**31 - 1)),
+                          axis=1, keepdims=True)
+            outs.append(sel)
+            d = jnp.where(is_min & (idx == sel), jnp.inf, d)
+        return jnp.concatenate(outs, axis=1)
+
+    @jax.jit
+    def twostage_only(d):
+        # [Q, N] -> [Q, B, n/B]: per-block top-K then merge the B*K finalists.
+        B = 16
+        n = d.shape[1]
+        pad = (-n) % B
+        dp = jnp.pad(d, ((0, 0), (0, pad)), constant_values=np.inf)
+        blk = dp.reshape(d.shape[0], B, -1)
+        nd, ni = lax.top_k(-blk, K)  # [Q, B, K]
+        base = (jnp.arange(B) * blk.shape[2])[None, :, None]
+        cd = (-nd).reshape(d.shape[0], B * K)
+        ci = (ni + base).reshape(d.shape[0], B * K)
+        # lexicographic final top-k via keyed sort
+        order = jnp.argsort(cd * np.float32(1.0), axis=1, stable=True)
+        cd_s = jnp.take_along_axis(cd, order, 1)[:, :K]
+        ci_s = jnp.take_along_axis(ci, order, 1)[:, :K]
+        return cd_s, ci_s
+
+    @jax.jit
+    def fused_rounds(qb):
+        d = dist(qb, tx)
+        n = d.shape[1]
+        idx = lax.broadcasted_iota(jnp.int32, d.shape, 1)
+        outs = []
+        for _ in range(K):
+            m = jnp.min(d, axis=1, keepdims=True)
+            is_min = d == m
+            sel = jnp.min(jnp.where(is_min, idx, np.int32(2**31 - 1)),
+                          axis=1, keepdims=True)
+            outs.append(sel)
+            d = jnp.where(is_min & (idx == sel), jnp.inf, d)
+        i = jnp.concatenate(outs, axis=1)
+        return vote(ty[i], nc)
+
+    for name, fn, bs in [
+        ("distance only", d_only, bufs),
+        ("lax.top_k only", topk_only, d_bufs),
+        ("5-round min-extract only", rounds_only, d_bufs),
+        ("two-stage blocked top_k only", twostage_only, d_bufs),
+        ("FUSED dist+5-round+vote", fused_rounds, bufs),
+    ]:
+        jax.block_until_ready(fn(bs[0]))
+        ms = slope(fn, bs, 10, 40) * 1e3
+        print(f"{name:34s} {ms:8.3f} ms/step", flush=True)
+
+    # Parity check for the fused path.
+    preds = np.asarray(fused_rounds(bufs[0]))
+    acc = accuracy(confusion_matrix(preds, test.labels, nc))
+    print(f"fused rounds accuracy {acc:.4f} ({q/(slope(fused_rounds, bufs)):,.0f} q/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,165 @@ class TestNativeParser:
             native_arff.parse(str(p))
 
 
+def _random_arff(rng) -> str:
+    """One random ARFF file exercising the dialect corners: mixed-case
+    keywords, quoted names/values, nominal sets, `%` comments, blank lines,
+    `?` missing cells, multi-line rows, scientific/negative numbers."""
+    lines = []
+    if rng.random() < 0.5:
+        lines.append("% a leading comment")
+    rel = rng.choice(["rel", "'quoted rel'", '"dq rel"'])
+    lines.append(f"{rng.choice(['@relation', '@RELATION', '@Relation'])} {rel}")
+    n_feat = int(rng.integers(1, 6))
+    attrs = []
+    for i in range(n_feat):
+        kind = rng.choice(["numeric", "nominal"])
+        name = rng.choice([f"a{i}", f"'attr {i}'"])
+        if kind == "numeric":
+            ty = rng.choice(["NUMERIC", "numeric", "REAL", "Integer"])
+            lines.append(f"@attribute {name} {ty}")
+            attrs.append(("numeric", None))
+        else:
+            vals = [f"v{j}" for j in range(int(rng.integers(2, 5)))]
+            quoted = [f"'{v} x'" if rng.random() < 0.3 else v for v in vals]
+            lines.append(f"@attribute {name} {{{', '.join(quoted)}}}")
+            attrs.append(("nominal", [v.strip("'").strip() for v in quoted]))
+    lines.append("@attribute class NUMERIC")
+    if rng.random() < 0.3:
+        lines.append("")
+        lines.append("% mid-file comment")
+    lines.append(rng.choice(["@data", "@DATA"]))
+    n_rows = int(rng.integers(0, 12))
+    for _ in range(n_rows):
+        cells = []
+        for kind, vals in attrs:
+            if rng.random() < 0.1:
+                cells.append("?")
+            elif kind == "numeric":
+                v = rng.choice([
+                    str(int(rng.integers(-50, 50))),
+                    f"{rng.normal():.6g}",
+                    f"{rng.normal() * 1e-4:.3e}",
+                ])
+                cells.append(v)
+            else:
+                v = vals[int(rng.integers(0, len(vals)))]
+                cells.append(f"'{v}'" if " " in v else v)
+        cells.append(str(int(rng.integers(0, 4))))
+        if len(cells) > 2 and rng.random() < 0.2:  # split row across lines
+            cut = int(rng.integers(1, len(cells)))
+            # Trailing comma continues the row (reference-valid; a LEADING
+            # comma on the continuation line truncates the reference and is
+            # a located error here — covered in the malformed cases).
+            lines.append(",".join(cells[:cut]) + ",")
+            lines.append(",".join(cells[cut:]))
+        else:
+            lines.append(",".join(cells))
+    return "\n".join(lines) + "\n"
+
+
+class TestFuzzDifferential:
+    """The native parser and the Python parser are independent
+    implementations of the same grammar (SURVEY.md §3.4); random valid files
+    must produce bit-identical arrays, and malformed files must fail in BOTH
+    with a location-bearing error."""
+
+    def test_random_valid_files_bit_identical(self, native_arff, tmp_path):
+        rng = np.random.default_rng(1234)
+        for trial in range(40):
+            p = tmp_path / f"fuzz{trial}.arff"
+            p.write_text(_random_arff(rng))
+            nat = native_arff.parse(str(p))
+            py = pyarff.parse_arff_file(str(p))
+            np.testing.assert_array_equal(
+                nat.features, py.features, err_msg=p.read_text()
+            )
+            np.testing.assert_array_equal(nat.labels, py.labels)
+            assert nat.relation == py.relation, p.read_text()
+            assert [(a.name, a.type, a.nominal_values) for a in nat.attributes] == \
+                [(a.name, a.type, a.nominal_values) for a in py.attributes]
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            "@relation r\n@attribute x NUMERIC\n@data\n",  # single attr: no feature cols is fine, but...
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\n1\n2,3,4\n",
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\nnotanum,0\n",
+            "@relation r\n@attribute c {a,b}\n@attribute class NUMERIC\n@data\nz,0\n",
+            "@relation r\n@bogus x\n@data\n",
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\n{0 1}\n",
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\n1,?\n",
+            "@relation r\n@attribute c {a,,b}\n@attribute class NUMERIC\n@data\na,0\n",
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\n1,,0\n",
+            "@relation r\n@attribute a NUMERIC\n@attribute b NUMERIC\n"
+            "@attribute class NUMERIC\n@data\n1,2\n,0\n",
+            "@relation r\n@attribute c {a,b,}\n@attribute class NUMERIC\n@data\nb,0\n",
+            "@relation r\n@attribute c {a,''}\n@attribute class NUMERIC\n@data\na,0\n",
+            "@relation r\n@attribute c {}\n@attribute class NUMERIC\n@data\n",
+            "@relation r\n@attribute a NUMERIC\n@attribute b NUMERIC\n"
+            "@attribute class NUMERIC\n@data\n1,2,\x0c\n3\n",
+            "@relation \"'q'\"\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,0\n",
+            "@relation\x0cfoo\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,0\n",
+        ],
+        ids=["no-rows-1attr", "overlong-row", "bad-number", "bad-nominal",
+             "bad-keyword", "sparse", "missing-label", "empty-nominal-decl",
+             "empty-data-field", "leading-comma-continuation",
+             "trailing-comma-nominal-valid", "quoted-empty-nominal",
+             "empty-nominal-set-valid", "formfeed-after-comma",
+             "nested-quoted-relation", "formfeed-keyword"],
+    )
+    def test_malformed_fails_in_both_or_neither(self, native_arff, tmp_path, body):
+        p = tmp_path / "m.arff"
+        p.write_text(body)
+        nat_err = py_err = None
+        nat = py = None
+        try:
+            nat = native_arff.parse(str(p))
+        except ValueError as e:
+            nat_err = str(e)
+        try:
+            py = pyarff.parse_arff_file(str(p))
+        except ValueError as e:
+            py_err = str(e)
+        assert (nat_err is None) == (py_err is None), (
+            f"parsers disagree on validity: native={nat_err!r} python={py_err!r}"
+        )
+        if nat_err is not None:
+            import re
+
+            assert re.search(r":\d+: ", nat_err), f"no location in {nat_err!r}"
+            assert re.search(r":\d+: ", py_err), f"no location in {py_err!r}"
+        if nat is not None and py is not None:
+            np.testing.assert_array_equal(nat.features, py.features)
+            np.testing.assert_array_equal(nat.labels, py.labels)
+            assert nat.relation == py.relation
+            assert [(a.name, a.type, a.nominal_values) for a in nat.attributes] == \
+                [(a.name, a.type, a.nominal_values) for a in py.attributes]
+
+    def test_quoted_content_preserved_verbatim(self, native_arff, tmp_path):
+        """The reference lexer copies chars between quotes as-is
+        (arff_lexer.cpp:159-188): `' '` is the one-space token — distinct
+        from an empty field — and inner spaces survive."""
+        p = tmp_path / "q.arff"
+        p.write_text(
+            "@relation r\n"
+            "@attribute c {' ', 'a  b', plain}\n"
+            "@attribute class NUMERIC\n"
+            "@data\n"
+            "' ',0\n"
+            "'a  b',1\n"
+            "plain,2\n"
+        )
+        nat = native_arff.parse(str(p))
+        py = pyarff.parse_arff_file(str(p))
+        assert nat.attributes[0].nominal_values == [" ", "a  b", "plain"]
+        assert py.attributes[0].nominal_values == [" ", "a  b", "plain"]
+        np.testing.assert_array_equal(nat.features, [[0.0], [1.0], [2.0]])
+        np.testing.assert_array_equal(py.features, nat.features)
+
+
 class TestNativeRuntime:
     def test_matches_oracle(self, rng):
         nb = _native_runtime()
