@@ -58,13 +58,22 @@ def load_large():
     )
 
 
-def _pipelined_slope(mkstep, bufs, r_lo, r_hi):
+def _pipelined_slope(mkstep, bufs, r_lo, r_hi, block_fn=None):
     """Marginal per-dispatch seconds: time r_lo and r_hi pipelined dispatches
     (one drain each, best of 3) and take the slope — subtracts the fixed
-    host-sync/tunnel round-trip that has nothing to do with device compute."""
+    host-sync/tunnel round-trip that has nothing to do with device compute.
+
+    `block_fn(out)` drains the pipeline; the default pulls the (first) output
+    to host via np.asarray. The tuning scripts share this helper so their
+    ms/step numbers stay methodology-comparable with bench.py's.
+    """
     import time
 
     import numpy as np
+
+    if block_fn is None:
+        def block_fn(out):
+            np.asarray(out if not isinstance(out, (tuple, list)) else out[0])
 
     def timed(reps):
         best = float("inf")
@@ -73,7 +82,7 @@ def _pipelined_slope(mkstep, bufs, r_lo, r_hi):
             out = None
             for i in range(reps):
                 out = mkstep(bufs[i % len(bufs)])
-            np.asarray(out if not isinstance(out, (tuple, list)) else out[0])
+            block_fn(out)
             best = min(best, time.monotonic() - t0)
         return best
 

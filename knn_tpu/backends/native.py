@@ -8,18 +8,16 @@ been built (``make native``); the registry treats that as "not available".
 from __future__ import annotations
 
 import ctypes
-from pathlib import Path
 
 import numpy as np
 
 from knn_tpu.backends import register
 from knn_tpu.data.dataset import Dataset
-
-_LIB_DIR = Path(__file__).parent.parent / "native" / "lib"
+from knn_tpu.native import build_if_missing
 
 
 def _load():
-    lib = ctypes.CDLL(str(_LIB_DIR / "libknn_runtime.so"))
+    lib = ctypes.CDLL(str(build_if_missing("libknn_runtime.so")))
     lib.knn_native_predict.argtypes = [
         ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_int32),

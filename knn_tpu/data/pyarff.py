@@ -40,6 +40,69 @@ _NUMERIC_TYPES = {"numeric", "real", "integer"}
 _WS = " \t\r\n"
 
 
+# Numeric cells must parse bit-identically to the native parser, which uses C
+# strtof with a full-consumption check (arff_c.cc::cell_to_float). Python's
+# float() diverges three ways: acceptance (digit-group underscores, non-ASCII
+# digits accepted; hex floats, nan(...) rejected), rounding (decimal → float64
+# → float32 double-rounds near-halfway tokens where strtof single-rounds to
+# float32), and NaN sign/payload. So the primary path calls libc strtof itself
+# via ctypes; the regex path below is the fallback for platforms where libc
+# isn't loadable by name and matches strtof's acceptance set (though not its
+# last-ulp rounding).
+_STRTOF_RE = re.compile(
+    r"[ \t\n\v\f\r]*"
+    r"[+-]?"
+    r"(?:"
+    r"(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?"
+    r"|(?P<hex>0[xX](?:[0-9a-fA-F]+\.?[0-9a-fA-F]*|\.[0-9a-fA-F]+)(?:[pP][+-]?\d+)?)"
+    r"|inf(?:inity)?"
+    r"|nan(?:\([0-9a-zA-Z_]*\))?"
+    r")\Z",
+    re.ASCII | re.IGNORECASE,
+)
+
+
+def _load_libc_strtof():
+    import ctypes
+
+    try:
+        fn = ctypes.CDLL(None).strtof
+    except (OSError, AttributeError):
+        return None
+    fn.restype = ctypes.c_float
+    fn.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
+    return fn
+
+
+_LIBC_STRTOF = _load_libc_strtof()
+
+
+def _strtof(tok: str) -> float:
+    """Parse `tok` exactly as the native parser does (C strtof + "entire token
+    consumed" check, arff_c.cc::cell_to_float) or raise ValueError."""
+    if _LIBC_STRTOF is not None:
+        import ctypes
+
+        buf = ctypes.create_string_buffer(tok.encode("utf-8"))
+        endp = ctypes.c_char_p()
+        val = _LIBC_STRTOF(buf, ctypes.byref(endp))
+        consumed = ctypes.cast(endp, ctypes.c_void_p).value - ctypes.addressof(buf)
+        # Mirror the native `endp == start || *endp != '\0'` rejection,
+        # including its quirk of stopping at an embedded NUL.
+        if consumed == 0 or buf.raw[consumed] != 0:
+            raise ValueError(tok)
+        return val
+    m = _STRTOF_RE.match(tok)
+    if m is None:
+        raise ValueError(tok)
+    s = tok.lstrip(" \t\n\v\f\r")
+    if m.group("hex") is not None:
+        return float.fromhex(s)
+    if s.lower().lstrip("+-").startswith("nan"):
+        return math.nan
+    return float(s)
+
+
 class ArffError(ValueError):
     """Parse error with file:line context, mirroring libarff's THROW style."""
 
@@ -157,7 +220,7 @@ def _cell_to_float(
             path, lineno, f"attribute '{attr.name}' of type {attr.type} is not numeric"
         )
     try:
-        return float(tok)
+        return _strtof(tok)
     except ValueError:
         raise ArffError(
             path, lineno, f"cannot parse '{tok}' as a number for '{attr.name}'"

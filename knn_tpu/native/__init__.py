@@ -1,3 +1,67 @@
 """Native (C++) components: the ARFF ingest library (``native/arff``) and the
 serial/threaded runtime kernels (``native/runtime``), bound via ctypes.
-Build with ``make native`` at the repo root."""
+
+The shared libraries build on demand at first import (or with ``make native``
+at the repo root): :func:`build_if_missing` compiles the single-TU library
+with the ambient C++ compiler when the ``.so`` is absent or older than its
+source, so a fresh checkout needs no explicit build step.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+_ROOT = Path(__file__).parent
+_LIB_DIR = _ROOT / "lib"
+
+_SOURCES = {
+    "libknn_arff.so": (_ROOT / "arff" / "arff_c.cc", []),
+    "libknn_runtime.so": (_ROOT / "runtime" / "knn_runtime.cc", ["-lpthread"]),
+}
+
+
+class NativeBuildError(RuntimeError):
+    """The C++ source exists and a compiler was found, but compilation failed.
+
+    Deliberately NOT an OSError: the backend registry treats OSError from
+    dlopen as "native backends unavailable" and continues silently, which is
+    right for a missing compiler but would hide a genuinely broken build.
+    """
+
+
+def build_if_missing(name: str) -> Path:
+    """Return the path to shared library `name`, compiling it if needed.
+
+    No-op when the library exists and is newer than its source. If no C++
+    compiler is available the stale/missing path is returned unchanged and the
+    subsequent ``ctypes.CDLL`` raises ``OSError``, which the backend registry
+    treats as "native backends unavailable".
+    """
+    out = _LIB_DIR / name
+    src, extra_link = _SOURCES[name]
+    if out.exists() and (not src.exists() or out.stat().st_mtime >= src.stat().st_mtime):
+        return out
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None or not src.exists():
+        return out
+    _LIB_DIR.mkdir(parents=True, exist_ok=True)
+    # Build to a pid-unique temp file and atomically rename, so concurrent
+    # importers (e.g. pytest-xdist workers) never dlopen a half-written .so.
+    tmp = _LIB_DIR / f".{name}.{os.getpid()}.tmp"
+    cmd = [
+        cxx, "-O3", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+        "-shared", "-o", str(tmp), str(src), *extra_link,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"building {name} failed:\n$ {' '.join(cmd)}\n{proc.stderr}"
+            )
+        os.replace(tmp, out)
+    finally:
+        tmp.unlink(missing_ok=True)
+    return out

@@ -8,13 +8,11 @@ from __future__ import annotations
 
 import ctypes
 import json
-from pathlib import Path
 
 import numpy as np
 
 from knn_tpu.data.dataset import Attribute, Dataset
-
-_LIB_DIR = Path(__file__).parent / "lib"
+from knn_tpu.native import build_if_missing
 
 
 class _KnnArffResult(ctypes.Structure):
@@ -31,8 +29,7 @@ class _KnnArffResult(ctypes.Structure):
 
 
 def _load():
-    path = _LIB_DIR / "libknn_arff.so"
-    lib = ctypes.CDLL(str(path))  # raises OSError if not built
+    lib = ctypes.CDLL(str(build_if_missing("libknn_arff.so")))  # OSError if unbuildable
     lib.knn_arff_parse.argtypes = [ctypes.c_char_p, ctypes.POINTER(_KnnArffResult)]
     lib.knn_arff_parse.restype = ctypes.c_int
     lib.knn_arff_free.argtypes = [ctypes.POINTER(_KnnArffResult)]

@@ -6,14 +6,14 @@ Usage: python scripts/tune_breakdown.py
 
 from __future__ import annotations
 
-import functools
 import sys
-import time
 from pathlib import Path
 
 import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import _pipelined_slope
 
 K = 5
 
@@ -21,19 +21,9 @@ K = 5
 def slope(mkstep, bufs, r_lo=20, r_hi=80):
     import jax
 
-    def timed(reps):
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.monotonic()
-            out = None
-            for i in range(reps):
-                out = mkstep(bufs[i % len(bufs)])
-            jax.block_until_ready(out)
-            best = min(best, time.monotonic() - t0)
-        return best
-
-    t_lo, t_hi = timed(r_lo), timed(r_hi)
-    return (t_hi - t_lo) / (r_hi - r_lo)
+    return _pipelined_slope(
+        mkstep, bufs, r_lo, r_hi, block_fn=jax.block_until_ready
+    )[0]
 
 
 def main():
@@ -66,10 +56,9 @@ def main():
     def topk_only(d):
         return lax.top_k(-d, K)
 
-    @functools.partial(jax.jit, static_argnames=())
+    @jax.jit
     def rounds_only(d):
         # 5 rounds of (min, argmin-by-lowest-index, retire) — pure VPU.
-        n = d.shape[1]
         idx = lax.broadcasted_iota(jnp.int32, d.shape, 1)
         outs = []
         for _ in range(K):
@@ -102,7 +91,6 @@ def main():
     @jax.jit
     def fused_rounds(qb):
         d = dist(qb, tx)
-        n = d.shape[1]
         idx = lax.broadcasted_iota(jnp.int32, d.shape, 1)
         outs = []
         for _ in range(K):
@@ -115,6 +103,7 @@ def main():
         i = jnp.concatenate(outs, axis=1)
         return vote(ty[i], nc)
 
+    fused_s = None
     for name, fn, bs in [
         ("distance only", d_only, bufs),
         ("lax.top_k only", topk_only, d_bufs),
@@ -123,13 +112,15 @@ def main():
         ("FUSED dist+5-round+vote", fused_rounds, bufs),
     ]:
         jax.block_until_ready(fn(bs[0]))
-        ms = slope(fn, bs, 10, 40) * 1e3
-        print(f"{name:34s} {ms:8.3f} ms/step", flush=True)
+        s = slope(fn, bs, 10, 40)
+        if fn is fused_rounds:
+            fused_s = s
+        print(f"{name:34s} {s*1e3:8.3f} ms/step", flush=True)
 
-    # Parity check for the fused path.
+    # Parity check for the fused path (q/s from the measurement above).
     preds = np.asarray(fused_rounds(bufs[0]))
     acc = accuracy(confusion_matrix(preds, test.labels, nc))
-    print(f"fused rounds accuracy {acc:.4f} ({q/(slope(fused_rounds, bufs)):,.0f} q/s)")
+    print(f"fused rounds accuracy {acc:.4f} ({q/fused_s:,.0f} q/s)")
 
 
 if __name__ == "__main__":

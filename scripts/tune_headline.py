@@ -8,30 +8,19 @@ Usage: python scripts/tune_headline.py
 from __future__ import annotations
 
 import sys
-import time
 from pathlib import Path
 
 import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from bench import _pipelined_slope
+
 K = 5
 
 
 def slope(mkstep, bufs, r_lo=20, r_hi=80):
-    def timed(reps):
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.monotonic()
-            out = None
-            for i in range(reps):
-                out = mkstep(bufs[i % len(bufs)])
-            np.asarray(out if not isinstance(out, (tuple, list)) else out[0])
-            best = min(best, time.monotonic() - t0)
-        return best
-
-    t_lo, t_hi = timed(r_lo), timed(r_hi)
-    return (t_hi - t_lo) / (r_hi - r_lo)
+    return _pipelined_slope(mkstep, bufs, r_lo, r_hi)[0]
 
 
 def main():

@@ -215,6 +215,44 @@ class TestFuzzDifferential:
             assert [(a.name, a.type, a.nominal_values) for a in nat.attributes] == \
                 [(a.name, a.type, a.nominal_values) for a in py.attributes]
 
+    @pytest.mark.parametrize(
+        "tok",
+        ["0x10", "0x1.8p1", "-0x.8", "1_0", "١", "１", "inf", "-infinity",
+         "nan", "-nan", "nan(x7_)", "1e999", "1e-999", ".5", "5.", "+.25",
+         "0x", "0x1p", "1.5e", ".", "+", "1.2.3", "Infinit", "0X1F",
+         "7.038531e-26"],  # strtof single-rounds; float64→float32 would be 1 ulp off
+        ids=repr,
+    )
+    def test_numeric_token_parity(self, native_arff, tmp_path, tok):
+        """Numeric cells go through C strtof in the native parser
+        (arff_c.cc::cell_to_float); the Python parser must accept and reject
+        the exact same token set with BIT-identical float32 values — Python
+        float()'s extras (digit underscores, non-ASCII digits) must fail,
+        strtof's extras (hex floats, nan(...)) must succeed, and rounding and
+        NaN sign must match at the bit level (last-ulp near-halfway decimals,
+        '-nan' sign bit)."""
+        p = tmp_path / "tok.arff"
+        p.write_text(
+            "@relation t\n@attribute a NUMERIC\n@attribute class NUMERIC\n"
+            f"@data\n{tok},1\n"
+        )
+        nat_val = py_val = nat_err = py_err = None
+        try:
+            nat_val = native_arff.parse(str(p)).features[0, 0]
+        except ValueError as e:
+            nat_err = str(e)
+        try:
+            py_val = pyarff.parse_arff_file(str(p)).features[0, 0]
+        except ValueError as e:
+            py_err = str(e)
+        assert (nat_err is None) == (py_err is None), (
+            f"validity disagrees for {tok!r}: native={nat_err!r} python={py_err!r}"
+        )
+        if nat_err is None:
+            assert np.float32(py_val).tobytes() == np.float32(nat_val).tobytes(), (
+                f"bit mismatch for {tok!r}: python={py_val!r} native={nat_val!r}"
+            )
+
     def test_quoted_content_preserved_verbatim(self, native_arff, tmp_path):
         """The reference lexer copies chars between quotes as-is
         (arff_lexer.cpp:159-188): `' '` is the one-space token — distinct
@@ -235,6 +273,33 @@ class TestFuzzDifferential:
         assert py.attributes[0].nominal_values == [" ", "a  b", "plain"]
         np.testing.assert_array_equal(nat.features, [[0.0], [1.0], [2.0]])
         np.testing.assert_array_equal(py.features, nat.features)
+
+
+class TestOnDemandBuild:
+    def test_compile_failure_is_loud(self, tmp_path, monkeypatch):
+        """A broken .cc must raise NativeBuildError (with compiler stderr),
+        not OSError — the registry swallows OSError as 'not built', which
+        would silently drop the native backends."""
+        from knn_tpu import native as native_pkg
+
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++\n")
+        monkeypatch.setitem(native_pkg._SOURCES, "libbad.so", (bad, []))
+        monkeypatch.setattr(native_pkg, "_LIB_DIR", tmp_path / "lib")
+        with pytest.raises(native_pkg.NativeBuildError, match="libbad"):
+            native_pkg.build_if_missing("libbad.so")
+
+    def test_missing_source_and_lib_returns_path(self, tmp_path, monkeypatch):
+        """No source and no prebuilt lib → return the (absent) path so CDLL
+        raises plain OSError and the registry degrades gracefully."""
+        from knn_tpu import native as native_pkg
+
+        monkeypatch.setitem(
+            native_pkg._SOURCES, "libgone.so", (tmp_path / "gone.cc", [])
+        )
+        monkeypatch.setattr(native_pkg, "_LIB_DIR", tmp_path / "lib")
+        out = native_pkg.build_if_missing("libgone.so")
+        assert not out.exists()
 
 
 class TestNativeRuntime:
