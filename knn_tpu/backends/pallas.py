@@ -23,10 +23,11 @@ def predict(
     train: Dataset,
     test: Dataset,
     k: int,
-    block_q: int = 256,
-    block_n: int = 1024,
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
     interpret: Optional[bool] = None,
     precision: str = "auto",
+    engine: str = "auto",
     **_unused,
 ) -> np.ndarray:
     train.validate_for_knn(k, test)
@@ -38,5 +39,5 @@ def predict(
     return predict_pallas(
         train.features, train.labels, test.features, k, train.num_classes,
         block_q=block_q, block_n=block_n, interpret=interpret,
-        precision=precision,
+        precision=precision, engine=engine,
     )

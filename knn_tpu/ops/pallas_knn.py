@@ -187,37 +187,306 @@ def knn_pallas_candidates(
     )(jnp.asarray(n_valid, jnp.int32).reshape(1), test_x, train_x)
 
 
+def _knn_stripe_kernel(
+    n_valid_ref, q_ref, tT_ref, out_d_ref, out_i_ref, cand_d_ref, cand_i_ref,
+    *, k: int, block_n: int, d_true: int, n_tiles: int,
+):
+    """Lane-striped exact KNN tile kernel (narrow-feature fast path).
+
+    The round-based merge in :func:`_knn_kernel` pays k cross-LANE
+    min-reductions per train tile — slow on the VPU. Here each of the 128
+    lanes keeps its own k-candidate stripe, and the per-tile selection runs
+    across *planes* (128-column chunks of the tile), so the hot loop is pure
+    elementwise [BQ, 128] compare/select with zero cross-lane traffic. The
+    kernel emits the per-lane candidate sets ``[BQ, k*128]`` (level-major);
+    the cheap final 128·k → k merge happens outside in XLA (a cross-lane
+    reduction that costs ~20x the whole kernel if done in Mosaic).
+
+    Layout: train arrives TRANSPOSED ``[D, N]`` so each feature contributes a
+    sublane-broadcast row plane and the query contributes a lane-broadcast
+    column — distances accumulate over the true feature count in source order
+    (exact parity with main.cpp:17-19). The candidate buffers are VMEM
+    scratch persisting across the train-tile sweep; outputs are written once
+    on the last train tile (writing the accumulator through the output refs
+    instead costs an HBM write-back per grid step — ~20x the whole kernel).
+    """
+    j = pl.program_id(1)
+    lanes = 128
+
+    @pl.when(j == 0)
+    def _init():
+        cand_d_ref[:] = jnp.full(cand_d_ref.shape, jnp.inf, jnp.float32)
+        cand_i_ref[:] = jnp.full(cand_i_ref.shape, _INT_MAX, jnp.int32)
+
+    q = q_ref[:]  # [BQ, D_pad]
+    nv = n_valid_ref[0]
+    bq = q.shape[0]
+    g = block_n // lanes
+
+    # Exact subtraction-form distance for the whole tile, accumulated over
+    # feature planes in source order: [BQ,1] lane-broadcast minus [1,BN]
+    # sublane-broadcast per feature.
+    d_full = jnp.zeros((bq, block_n), jnp.float32)
+    for f in range(d_true):
+        diff = q[:, f : f + 1] - tT_ref[f, :].reshape(1, block_n)
+        d_full = d_full + diff * diff
+    d_full = jnp.where(jnp.isnan(d_full), jnp.inf, d_full)
+
+    # Selection planes: the g tile chunks plus the k running candidate levels.
+    # Index planes stay [BQ, 128] (a [BQ, BN] iota next to the broadcast
+    # distance planes trips a Mosaic layout-inference crash; 128-wide chunks
+    # with scalar offsets lower cleanly).
+    i128 = jax.lax.broadcasted_iota(jnp.int32, (bq, lanes), 1)
+    d_planes, i_planes = [], []
+    for c in range(g):
+        gcol = i128 + (j * block_n + c * lanes)
+        valid = gcol < nv
+        d_planes.append(
+            jnp.where(valid, d_full[:, c * lanes : (c + 1) * lanes], jnp.inf)
+        )
+        i_planes.append(jnp.where(valid, gcol, _INT_MAX))
+    d_planes += [cand_d_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
+    i_planes += [cand_i_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
+
+    # k rounds of lexicographic (distance, index) min across planes. All ops
+    # are elementwise [BQ, 128]; ties resolve to the lowest global index
+    # (first-seen-wins, main.cpp:47). Retirement keys on index alone — global
+    # indices are unique, and the INT_MAX padding dupes all carry +inf.
+    for level in range(k):
+        m_d = d_planes[0]
+        for p in range(1, len(d_planes)):
+            m_d = jnp.minimum(m_d, d_planes[p])
+        m_i = _INT_MAX * jnp.ones_like(i_planes[0])
+        for p in range(len(d_planes)):
+            m_i = jnp.minimum(
+                m_i, jnp.where(d_planes[p] == m_d, i_planes[p], _INT_MAX)
+            )
+        cand_d_ref[:, level * lanes : (level + 1) * lanes] = m_d
+        cand_i_ref[:, level * lanes : (level + 1) * lanes] = m_i
+        if level + 1 < k:
+            for p in range(len(d_planes)):
+                taken = i_planes[p] == m_i
+                d_planes[p] = jnp.where(taken, jnp.inf, d_planes[p])
+                i_planes[p] = jnp.where(taken, _INT_MAX, i_planes[p])
+
+    @pl.when(j == n_tiles - 1)
+    def _writeback():
+        out_d_ref[:] = cand_d_ref[:]
+        out_i_ref[:] = cand_i_ref[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "block_q", "block_n", "interpret", "d_true"),
+)
+def knn_pallas_stripe_candidates(
+    train_xT: jnp.ndarray,
+    test_x: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    k: int,
+    block_q: int = 448,
+    block_n: int = 2048,
+    interpret: bool = False,
+    d_true: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact lane-striped kernel entry. ``train_xT`` is the TRANSPOSED train
+    matrix ``[D_pad, N_pad]`` (N padded to ``block_n``, D padded to a sublane
+    multiple); ``test_x`` is ``[Q_pad, D_pad]``. Returns ``([Q,k] dists,
+    [Q,k] int32 global indices)`` sorted ascending by (distance, index)."""
+    d_pad, n_pad = train_xT.shape
+    q_pad = test_x.shape[0]
+    assert n_pad % block_n == 0 and q_pad % block_q == 0 and block_n % 128 == 0
+    assert d_true is None or d_true <= d_pad
+    grid = (q_pad // block_q, n_pad // block_n)
+
+    kernel = functools.partial(
+        _knn_stripe_kernel,
+        k=k,
+        block_n=block_n,
+        d_true=d_true if d_true is not None else d_pad,
+        n_tiles=grid[1],
+    )
+    cand_d, cand_i = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_q, test_x.shape[1]), lambda i, j, n_ref: (i, 0)),
+                pl.BlockSpec((d_pad, block_n), lambda i, j, n_ref: (0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_q, k * 128), lambda i, j, n_ref: (i, 0)),
+                pl.BlockSpec((block_q, k * 128), lambda i, j, n_ref: (i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, k * 128), jnp.float32),
+                pltpu.VMEM((block_q, k * 128), jnp.int32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, k * 128), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, k * 128), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=3 * q_pad * n_pad * (d_true or d_pad) + 8 * q_pad * n_pad * k,
+            bytes_accessed=(q_pad + n_pad) * d_pad * 4 + q_pad * k * 128 * 8,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1), test_x, train_xT)
+
+    # Final 128·k -> k merge: one lexicographic (distance, index) sort per
+    # query — the framework's single tie-break rule (ops/topk.py).
+    d_sorted, i_sorted = jax.lax.sort(
+        (cand_d, cand_i), dimension=-1, num_keys=2
+    )
+    return d_sorted[:, :k], i_sorted[:, :k]
+
+
+def stripe_prepare_train(
+    train_x: np.ndarray, block_n: int
+) -> Tuple[np.ndarray, int]:
+    """Lay out the train matrix for the stripe kernel: rows padded to a
+    ``block_n`` multiple, features zero-padded to a sublane multiple, then
+    transposed to ``[D_pad, N_pad]``. Returns ``(train_xT, d_pad)`` — the
+    single definition of the kernel's input layout (bench.py and the host
+    entries share it)."""
+    d_true = train_x.shape[1]
+    d_pad = ((d_true + 7) // 8) * 8
+    tx, _ = pad_axis_to_multiple(train_x.astype(np.float32), block_n, axis=0)
+    txT = np.ascontiguousarray(np.pad(tx, ((0, 0), (0, d_pad - d_true))).T)
+    return txT, d_pad
+
+
+def stripe_prepare_queries(
+    test_x: np.ndarray, block_q: int, d_pad: int
+) -> np.ndarray:
+    """Pad queries to a ``block_q`` row multiple and ``d_pad`` features."""
+    d_true = test_x.shape[1]
+    qx, _ = pad_axis_to_multiple(test_x.astype(np.float32), block_q, axis=0)
+    return np.pad(qx, ((0, 0), (0, d_pad - d_true)))
+
+
+def stripe_block_sizes(
+    block_q: Optional[int], block_n: Optional[int], q: int
+) -> Tuple[int, int]:
+    """Resolve stripe block sizes: defaults tuned on v5e (448, 2048), block_n
+    rounded to the 128-lane multiple the kernel requires, block_q clipped so
+    one tile covers small query sets."""
+    block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
+    block_q = min(block_q or 448, ((q + 7) // 8) * 8)
+    return block_q, block_n
+
+
+def stripe_candidates_arrays(
+    train_x: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
+    interpret: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host entry for the lane-striped kernel: handles padding and the [D, N]
+    train transposition, returns unpadded ``([Q,k] dists, [Q,k] indices)``."""
+    n, d_true = train_x.shape
+    q = test_x.shape[0]
+    block_q, block_n = stripe_block_sizes(block_q, block_n, q)
+    txT, d_pad = stripe_prepare_train(train_x, block_n)
+    qx = stripe_prepare_queries(test_x, block_q, d_pad)
+    d, idx = knn_pallas_stripe_candidates(
+        jnp.asarray(txT), jnp.asarray(qx), n, k,
+        block_q=block_q, block_n=block_n, interpret=interpret, d_true=d_true,
+    )
+    return np.asarray(d)[:q], np.asarray(idx)[:q]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "num_classes", "block_q", "block_n", "d_true", "interpret"),
+)
+def knn_stripe_classify(
+    train_xT: jnp.ndarray,
+    train_y: jnp.ndarray,
+    test_x: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    k: int,
+    num_classes: int,
+    block_q: int = 448,
+    block_n: int = 2048,
+    d_true: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One-dispatch classify on pre-padded device arrays: stripe kernel +
+    lexicographic merge + vote, fused under a single jit. The headline exact
+    path (bench.py) — 2.6x the full-matrix XLA formulation on TPU v5e."""
+    from knn_tpu.ops.vote import vote
+
+    _, idx = knn_pallas_stripe_candidates(
+        train_xT, test_x, n_valid, k,
+        block_q=block_q, block_n=block_n, interpret=interpret, d_true=d_true,
+    )
+    safe = jnp.minimum(idx, train_y.shape[0] - 1)
+    return vote(train_y[safe], num_classes)
+
+
 def predict_pallas(
     train_x: np.ndarray,
     train_y: np.ndarray,
     test_x: np.ndarray,
     k: int,
     num_classes: int,
-    block_q: int = 256,
-    block_n: int = 1024,
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
     interpret: Optional[bool] = None,
     precision: str = "exact",
+    engine: str = "auto",
 ) -> np.ndarray:
     """Host entry: pad (queries, train rows, feature lanes), run the kernel,
     gather labels, vote. Interpret mode defaults on for non-TPU backends so the
-    same code path is testable on the CPU mesh (SURVEY.md §4)."""
+    same code path is testable on the CPU mesh (SURVEY.md §4).
+
+    ``engine``: "stripe" = the lane-striped exact kernel (fastest for narrow
+    features), "merge" = the tile-merge kernel (any width; required for the
+    fast/bf16 MXU distance forms), "auto" = stripe when it applies."""
     from knn_tpu.ops.vote import vote
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n, q = train_x.shape[0], test_x.shape[0]
     d_true = train_x.shape[1]
-    block_n = max(block_n, k)  # streaming merge needs k candidates per tile
-    tx, _ = pad_axis_to_multiple(train_x.astype(np.float32), block_n, axis=0)
-    qx, _ = pad_axis_to_multiple(test_x.astype(np.float32), block_q, axis=0)
-    tx, _ = pad_axis_to_multiple(tx, 128, axis=1)  # lane-align features
-    qx, _ = pad_axis_to_multiple(qx, 128, axis=1)
+    if engine == "auto":
+        engine = (
+            "stripe"
+            if precision == "exact" and d_true <= 64 and k <= 16
+            else "merge"
+        )
 
-    _, idx = knn_pallas_candidates(
-        jnp.asarray(tx), jnp.asarray(qx), n, k,
-        block_q=block_q, block_n=block_n, interpret=interpret,
-        d_true=d_true, precision=precision,
-    )
-    idx = np.asarray(idx)[:q]
+    if engine == "stripe":
+        if precision != "exact":
+            raise ValueError("the stripe engine implements the exact form only")
+        _, idx = stripe_candidates_arrays(
+            train_x, test_x, k,
+            block_q=block_q, block_n=block_n, interpret=interpret,
+        )
+    elif engine == "merge":
+        block_q = block_q or 256
+        block_n = max(block_n or 1024, k)  # per-tile top-k needs k <= tile width
+        tx, _ = pad_axis_to_multiple(train_x.astype(np.float32), block_n, axis=0)
+        qx, _ = pad_axis_to_multiple(test_x.astype(np.float32), block_q, axis=0)
+        tx, _ = pad_axis_to_multiple(tx, 128, axis=1)  # lane-align features
+        qx, _ = pad_axis_to_multiple(qx, 128, axis=1)
+
+        _, idx = knn_pallas_candidates(
+            jnp.asarray(tx), jnp.asarray(qx), n, k,
+            block_q=block_q, block_n=block_n, interpret=interpret,
+            d_true=d_true, precision=precision,
+        )
+        idx = np.asarray(idx)[:q]
+    else:
+        raise ValueError(f"unknown pallas engine {engine!r}; use 'auto', 'stripe', or 'merge'")
     labels = train_y[np.minimum(idx, n - 1)]
     return np.asarray(vote(jnp.asarray(labels), num_classes))

@@ -1,0 +1,169 @@
+"""Micro-exploration of the headline exact path: where do the ms go, and can a
+two-stage (chunked) exact top-k or a leaner Pallas merge beat the current best?
+
+Usage: python scripts/explore_exact.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import _pipelined_slope, load_large
+
+K = 5
+
+
+def slope(mkstep, bufs, r_lo=20, r_hi=80):
+    return _pipelined_slope(mkstep, bufs, r_lo, r_hi)[0]
+
+
+def main():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from knn_tpu.ops.distance import pairwise_sq_dists
+    from knn_tpu.ops.vote import vote
+    from knn_tpu.utils.padding import pad_axis_to_multiple
+
+    train, test, _ = load_large()
+    n, d_true = train.features.shape
+    q = test.num_instances
+    nc = train.num_classes
+    tx = jnp.asarray(train.features)
+    ty = jnp.asarray(train.labels)
+    bufs = [jnp.asarray(test.features + np.float32(i) * 1e-7) for i in range(8)]
+    jax.block_until_ready(bufs)
+    golden = None
+
+    def report(name, step, preds=None):
+        nonlocal golden
+        ms = slope(step, bufs) * 1e3
+        par = ""
+        if preds is not None:
+            if golden is None:
+                golden = preds
+            par = "==" if np.array_equal(preds, golden) else "DIVERGED"
+        print(f"{name:44s} {ms:8.3f} ms/step  {q/(ms/1e3):10.0f} q/s  {par}")
+
+    # --- component timings ---
+    @jax.jit
+    def dist_only(qb):
+        return pairwise_sq_dists(qb, tx).sum(axis=1)  # cheap reduce to avoid IO
+
+    report("distance only (+sum reduce)", dist_only)
+
+    @jax.jit
+    def dist_topk(qb):
+        d = pairwise_sq_dists(qb, tx)
+        nd, i = lax.top_k(-d, K)
+        return i
+
+    report("distance + lax.top_k", dist_topk)
+
+    @jax.jit
+    def dist_approx(qb):
+        d = pairwise_sq_dists(qb, tx)
+        _, i = lax.approx_max_k(-d, K)
+        return i
+
+    report("distance + approx_max_k", dist_approx)
+
+    # --- two-stage chunked exact top-k ---
+    def make_two_stage(chunk):
+        txp, _ = pad_axis_to_multiple(train.features, chunk, axis=0)
+        txj = jnp.asarray(txp)
+        n_pad = txj.shape[0]
+        c = n_pad // chunk
+
+        @jax.jit
+        def step(qb):
+            d = pairwise_sq_dists(qb, txj)  # [Q, n_pad]
+            col = jnp.arange(n_pad)
+            d = jnp.where(col[None, :] < n, d, jnp.inf)
+            dc = d.reshape(qb.shape[0], c, chunk)
+            nd, li = lax.top_k(-dc, K)  # [Q, c, K]
+            gi = (li + (jnp.arange(c) * chunk)[None, :, None]).astype(jnp.int32)
+            df = (-nd).reshape(qb.shape[0], c * K)
+            gf = gi.reshape(qb.shape[0], c * K)
+            ds, is_ = lax.sort((df, gf), dimension=-1, num_keys=2)
+            return vote(ty[jnp.minimum(is_[:, :K], n - 1)], nc)
+
+        return step
+
+    for chunk in (1024, 2048, 4096, 8192):
+        step = make_two_stage(chunk)
+        report(f"two-stage exact chunk={chunk}", step, np.asarray(step(bufs[0])))
+
+    # --- lane-striped pallas exact kernel ---
+    from knn_tpu.ops.pallas_knn import knn_pallas_stripe_candidates
+
+    for b_q, b_n in ((896, 2048), (896, 4096), (448, 2048), (1792, 2048),
+                     (1792, 4096), (1792, 32768)):
+        txp, _ = pad_axis_to_multiple(train.features, b_n, axis=0)
+        txT = jnp.asarray(np.ascontiguousarray(
+            np.pad(txp, ((0, 0), (0, 16 - d_true))).T))  # [16, N_pad]
+        bufs_p = []
+        for i in range(8):
+            qp, _ = pad_axis_to_multiple(
+                test.features + np.float32(i) * 1e-7, b_q, axis=0)
+            qp = np.pad(qp, ((0, 0), (0, 16 - d_true)))
+            bufs_p.append(jnp.asarray(qp))
+        jax.block_until_ready(bufs_p)
+
+        def step_stripe(qb, txT=txT, b_q=b_q, b_n=b_n):
+            _, i = knn_pallas_stripe_candidates(
+                txT, qb, n, K, block_q=b_q, block_n=b_n, d_true=d_true)
+            return vote(ty[jnp.minimum(i, n - 1)], nc)
+
+        try:
+            p = np.asarray(step_stripe(bufs_p[0]))[:q]
+        except Exception as e:
+            print(f"stripe bq={b_q} bn={b_n}: FAILED {type(e).__name__}: {str(e)[:160]}")
+            continue
+        ms = slope(step_stripe, bufs_p) * 1e3
+        if golden is None:
+            golden = p
+        par = "==" if np.array_equal(p, golden) else "DIVERGED"
+        print(f"{f'pallas stripe exact bq={b_q} bn={b_n}':44s} {ms:8.3f} ms/step  "
+              f"{q/(ms/1e3):10.0f} q/s  {par}")
+
+    # --- current best paths for reference ---
+    from knn_tpu.backends.tpu import knn_forward, knn_forward_tiled
+
+    def step_full(qb):
+        return knn_forward(tx, ty, qb, k=K, num_classes=nc)
+
+    report("full-matrix exact (current)", step_full, np.asarray(step_full(bufs[0])))
+
+    txp, _ = pad_axis_to_multiple(train.features, 32768, axis=0)
+    typ, _ = pad_axis_to_multiple(train.labels, 32768, axis=0)
+    txj, tyj = jnp.asarray(txp), jnp.asarray(typ)
+    nv = jnp.asarray(n, jnp.int32)
+    bufs_t = []
+    for i in range(8):
+        qp, _ = pad_axis_to_multiple(test.features + np.float32(i) * 1e-7, 1792, axis=0)
+        bufs_t.append(jnp.asarray(qp))
+    jax.block_until_ready(bufs_t)
+
+    def step_tiled(qb):
+        return knn_forward_tiled(
+            txj, tyj, qb, nv, k=K, num_classes=nc, precision="exact",
+            query_tile=1792, train_tile=32768)
+
+    ms = slope(step_tiled, bufs_t) * 1e3
+    p = np.asarray(step_tiled(bufs_t[0]))[:q]
+    par = "==" if np.array_equal(p, golden) else "DIVERGED"
+    print(f"{'tiled exact q=1792 t=32768 (best)':44s} {ms:8.3f} ms/step  "
+          f"{q/(ms/1e3):10.0f} q/s  {par}")
+
+
+if __name__ == "__main__":
+    main()

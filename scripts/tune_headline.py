@@ -86,6 +86,34 @@ def main():
         report(f"tiled exact q={q_tile} t={t_tile}", step_tiled, bufs,
                np.asarray(step_tiled(bufs[0]))[:q])
 
+    # 2b. Lane-striped Pallas exact kernel, block sweep (current headline).
+    from knn_tpu.ops.pallas_knn import (
+        knn_stripe_classify, stripe_prepare_train, stripe_prepare_queries,
+    )
+
+    for b_q, b_n in [(448, 2048), (448, 4096), (256, 2048), (896, 2048),
+                     (224, 2048), (448, 1024)]:
+        try:
+            txT_h, d_pad = stripe_prepare_train(train.features, b_n)
+            txT = jnp.asarray(txT_h)
+            nv = jnp.asarray(n, jnp.int32)
+            bufs = []
+            for i in range(8):
+                bufs.append(jnp.asarray(stripe_prepare_queries(
+                    test.features + np.float32(i) * 1e-7, b_q, d_pad)))
+            jax.block_until_ready(bufs)
+
+            def step_stripe(qb, txT=txT, nv=nv, b_q=b_q, b_n=b_n):
+                return knn_stripe_classify(
+                    txT, ty, qb, nv, k=K, num_classes=nc,
+                    block_q=b_q, block_n=b_n, d_true=d_true)
+
+            p = np.asarray(step_stripe(bufs[0]))[:q]
+        except Exception as e:
+            print(f"stripe bq={b_q} bn={b_n}: FAILED {type(e).__name__}")
+            continue
+        report(f"pallas stripe exact bq={b_q} bn={b_n}", step_stripe, bufs, p)
+
     # 3. Pallas exact, block sweep.
     for b_q, b_n in [(256, 1024), (256, 4096), (896, 4096), (896, 8192),
                      (1792, 2048)]:

@@ -102,6 +102,57 @@ class TestPallasKernel:
         )
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.parametrize("engine", ["stripe", "merge"])
+    def test_engines_match_oracle(self, rng, engine):
+        train_x, train_y, test_x, c = _int_grid_problem(rng, n=300, q=40, d=6)
+        want = knn_oracle(train_x, train_y, test_x, 4, c)
+        got = predict_pallas(
+            train_x, train_y, test_x, 4, c,
+            block_q=16, block_n=128, interpret=True, engine=engine,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_stripe_candidates_sorted_and_padded_masked(self, rng):
+        # Raw stripe-kernel output contract: sorted by (dist, index), padded
+        # train rows never surface, distances match brute force.
+        from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
+
+        train_x = rng.integers(0, 4, (130, 5)).astype(np.float32)
+        test_x = rng.integers(0, 4, (17, 5)).astype(np.float32)
+        k = 7
+        d, i = stripe_candidates_arrays(
+            train_x, test_x, k, block_q=16, block_n=128, interpret=True
+        )
+        assert (i < 130).all(), "padded train rows leaked into candidates"
+        assert np.isfinite(d).all()
+        assert (d[:, :-1] <= d[:, 1:]).all()
+        same = d[:, :-1] == d[:, 1:]
+        assert (i[:, :-1][same] < i[:, 1:][same]).all()
+        bruteforce = ((test_x[:, None, :] - train_x[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(d, np.sort(bruteforce, axis=1)[:, :k], rtol=1e-5)
+
+    def test_stripe_duplicate_rows_across_tiles(self, rng):
+        # Duplicates landing in the same lane stripe across different train
+        # tiles AND in different lanes: merge must keep lowest global index.
+        base = rng.integers(0, 3, (64, 4)).astype(np.float32)
+        train_x = np.tile(base, (8, 1))  # dup every 64 rows; block_n=128
+        train_y = rng.integers(0, 5, 512).astype(np.int32)
+        test_x = base[:16]
+        want = knn_oracle(train_x, train_y, test_x, 9, 5)
+        got = predict_pallas(
+            train_x, train_y, test_x, 9, 5,
+            block_q=8, block_n=128, interpret=True, engine="stripe",
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_stripe_rejects_fast_precision(self, rng):
+        train_x, train_y, test_x, c = _int_grid_problem(rng, n=64, q=8, d=4)
+        with pytest.raises(ValueError, match="exact"):
+            predict_pallas(
+                train_x, train_y, test_x, 1, c,
+                interpret=True, engine="stripe", precision="fast",
+            )
+
     def test_backend_registered(self, small):
         from knn_tpu.models.knn import KNNClassifier
 
