@@ -213,7 +213,6 @@ def main():
     from knn_tpu.backends.tpu import knn_forward
     from knn_tpu.ops.pallas_knn import knn_stripe_classify
     from knn_tpu.utils.evaluate import confusion_matrix, accuracy
-    from knn_tpu.utils.padding import pad_axis_to_multiple
 
     t0 = time.monotonic()
     train, test, is_reference = load_large()

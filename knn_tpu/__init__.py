@@ -29,6 +29,6 @@ __version__ = "0.1.0"
 
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.data.arff import load_arff
-from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor
 
-__all__ = ["Dataset", "load_arff", "KNNClassifier", "__version__"]
+__all__ = ["Dataset", "load_arff", "KNNClassifier", "KNNRegressor", "__version__"]

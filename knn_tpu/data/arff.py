@@ -26,6 +26,10 @@ from knn_tpu.data.dataset import Attribute, Dataset
 from knn_tpu.data import pyarff
 
 _CACHE_ENV = "KNN_TPU_ARFF_CACHE"
+# Bumped when the cached array schema changes (v2: + raw_targets), so caches
+# written by older code are simply never found rather than silently read
+# without the newer fields.
+_CACHE_SCHEMA = 2
 
 
 def _cache_path(path: str) -> Optional[Path]:
@@ -33,7 +37,7 @@ def _cache_path(path: str) -> Optional[Path]:
     if not cache_dir:
         return None
     st = os.stat(path)
-    key = f"{os.path.abspath(path)}:{st.st_size}:{st.st_mtime_ns}"
+    key = f"v{_CACHE_SCHEMA}:{os.path.abspath(path)}:{st.st_size}:{st.st_mtime_ns}"
     digest = hashlib.sha256(key.encode()).hexdigest()[:24]
     return Path(cache_dir) / f"{Path(path).stem}-{digest}.npz"
 
@@ -56,6 +60,7 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
                 labels=z["labels"],
                 relation=str(z["relation"]),
                 attributes=attrs,
+                raw_targets=z["raw_targets"] if "raw_targets" in z else None,
             )
 
     ds: Optional[Dataset] = None
@@ -76,6 +81,7 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
             cache,
             features=ds.features,
             labels=ds.labels,
+            raw_targets=ds.targets,
             relation=ds.relation,
             attributes=json.dumps(
                 [
@@ -135,5 +141,5 @@ def write_arff(ds: Dataset, path: str) -> None:
         out.write("\n@data\n")
         for r in range(n):
             row = [cell(ds.features[r, c], attrs[c]) for c in range(d)]
-            row.append(cell(float(ds.labels[r]), attrs[d]))
+            row.append(cell(float(ds.targets[r]), attrs[d]))
             out.write(",".join(row) + "\n")

@@ -34,6 +34,9 @@ class Dataset:
     ``labels``:   int32 [N] — the last attribute cast to int.
     ``num_classes``: max(label)+1, the reference's lazily-cached definition
     (libarff/arff_data.cpp:41-58).
+    ``raw_targets``: float32 [N] — the last attribute *before* the int cast,
+    kept for the regression extension (the reference pipeline only ever casts,
+    main.cpp:57). Optional; falls back to ``labels`` via :attr:`targets`.
     Missing values (``?``) are stored as NaN in ``features``.
     """
 
@@ -41,6 +44,7 @@ class Dataset:
     labels: np.ndarray
     relation: str = ""
     attributes: Sequence[Attribute] = dataclasses.field(default_factory=list)
+    raw_targets: Optional[np.ndarray] = None
 
     def __post_init__(self):
         self.features = np.ascontiguousarray(self.features, dtype=np.float32)
@@ -51,6 +55,23 @@ class Dataset:
             raise ValueError(
                 f"labels shape {self.labels.shape} does not match N={self.features.shape[0]}"
             )
+        if self.raw_targets is not None:
+            self.raw_targets = np.ascontiguousarray(
+                self.raw_targets, dtype=np.float32
+            )
+            if self.raw_targets.shape != (self.features.shape[0],):
+                raise ValueError(
+                    f"raw_targets shape {self.raw_targets.shape} does not match "
+                    f"N={self.features.shape[0]}"
+                )
+
+    @property
+    def targets(self) -> np.ndarray:
+        """float32 regression targets: the uncast class column when the parser
+        kept it, else the int labels."""
+        if self.raw_targets is not None:
+            return self.raw_targets
+        return self.labels.astype(np.float32)
 
     @property
     def num_instances(self) -> int:

@@ -318,7 +318,10 @@ def parse_arff_lines(
         bad = int(np.isnan(raw_labels).argmax())
         raise ArffError(path, 0, f"instance {bad} has a missing class label")
     labels = raw_labels.astype(np.int32)
-    return Dataset(features=features, labels=labels, relation=relation, attributes=attributes)
+    return Dataset(
+        features=features, labels=labels, relation=relation,
+        attributes=attributes, raw_targets=raw_labels.astype(np.float32),
+    )
 
 
 def parse_arff_file(path: str) -> Dataset:

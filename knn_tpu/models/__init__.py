@@ -1,3 +1,3 @@
-from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor
 
-__all__ = ["KNNClassifier"]
+__all__ = ["KNNClassifier", "KNNRegressor"]

@@ -91,3 +91,83 @@ class KNNClassifier:
 
     def score(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> float:
         return accuracy(self.confusion_matrix(test, predictions))
+
+
+class KNNRegressor:
+    """k-nearest-neighbor regression — a model family the reference does not
+    have (its pipeline casts the class column to int unconditionally,
+    main.cpp:57); the framework keeps the uncast column
+    (``Dataset.raw_targets``) so numeric targets survive ingest.
+
+    Neighbor selection is identical to the classifier (squared Euclidean,
+    lexicographic (distance, train-index) order — SURVEY.md §3.5), so the
+    same TPU candidate kernel backs both models. ``weights``:
+
+    - ``"uniform"``: mean of the k neighbor targets.
+    - ``"distance"``: inverse-distance weighting; when a query coincides
+      exactly with train rows (distance 0), the prediction is the mean of
+      those exact matches only.
+    """
+
+    def __init__(self, k: int, weights: str = "uniform", **backend_opts):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if weights not in ("uniform", "distance"):
+            raise ValueError(f"weights must be 'uniform' or 'distance', got {weights!r}")
+        self.k = k
+        self.weights = weights
+        self.backend_opts = backend_opts
+        self._train: Optional[Dataset] = None
+
+    def fit(self, train: Dataset) -> "KNNRegressor":
+        if self.k > train.num_instances:
+            raise ValueError(
+                f"k={self.k} exceeds the number of train instances "
+                f"({train.num_instances})"
+            )
+        self._train = train
+        return self
+
+    @property
+    def train_(self) -> Dataset:
+        if self._train is None:
+            raise RuntimeError("call fit() before predict()/score()")
+        return self._train
+
+    def kneighbors(self, test: Dataset):
+        """Delegates to the classifier's candidate machinery (same kernel)."""
+        clf = KNNClassifier(self.k, **self.backend_opts)
+        clf._train = self._train
+        return clf.kneighbors(test)
+
+    def predict(self, test: Dataset) -> np.ndarray:
+        train = self.train_
+        if test.num_features != train.num_features:
+            raise ValueError(
+                f"train has {train.num_features} features but test has "
+                f"{test.num_features}"
+            )
+        dists, idx = self.kneighbors(test)
+        neigh = train.targets[np.minimum(idx, train.num_instances - 1)]
+        if self.weights == "uniform":
+            return neigh.mean(axis=1).astype(np.float32)
+        exact = dists == 0.0
+        any_exact = exact.any(axis=1)
+        with np.errstate(divide="ignore"):
+            w = np.where(exact, 0.0, 1.0 / dists)
+        w = np.where(any_exact[:, None], exact.astype(np.float64), w)
+        w_sum = w.sum(axis=1)
+        weighted = (w * neigh).sum(axis=1) / np.where(w_sum > 0, w_sum, 1.0)
+        # All-inf distances (e.g. NaN queries) zero every weight; fall back to
+        # the uniform mean rather than emitting 0/0.
+        return np.where(w_sum > 0, weighted, neigh.mean(axis=1)).astype(np.float32)
+
+    def score(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> float:
+        """Coefficient of determination R^2 against ``test.targets``."""
+        if predictions is None:
+            predictions = self.predict(test)
+        y = test.targets.astype(np.float64)
+        p = predictions.astype(np.float64)
+        ss_res = float(((y - p) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        return 1.0 - ss_res / ss_tot if ss_tot else (1.0 if ss_res == 0 else 0.0)

@@ -329,6 +329,12 @@ char* dup_string(const std::string& s) {
 
 extern "C" {
 
+// Bumped whenever KnnArffResult's layout changes (raw_targets was inserted
+// for the regression extension). The Python binding refuses to use a library
+// whose ABI version does not match, so a stale prebuilt .so can never be
+// read through the wrong struct layout.
+int knn_arff_abi_version(void) { return 2; }
+
 // Result of parsing: dense features [n, d_features] + labels [n] where the
 // class is the last declared attribute cast to int (main.cpp:57,66 contract).
 // attrs_json describes all attributes (name/type/nominal values).
@@ -336,6 +342,7 @@ extern "C" {
 struct KnnArffResult {
   float* features;
   int32_t* labels;
+  float* raw_targets;  // the class column before the int cast (regression)
   int64_t n;
   int64_t d_features;
   int32_t num_classes;  // max(label)+1 (arff_data.cpp:41-58 semantics)
@@ -377,6 +384,7 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
   out->d_features = (int64_t)df;
   out->features = (float*)malloc(sizeof(float) * n * (df ? df : 1));
   out->labels = (int32_t*)malloc(sizeof(int32_t) * (n ? n : 1));
+  out->raw_targets = (float*)malloc(sizeof(float) * (n ? n : 1));
   int32_t max_label = -1;
   for (size_t i = 0; i < n; ++i) {
     const float* row = &st.cells[i * d];
@@ -385,6 +393,7 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
     if (std::isnan(lab)) {
       free(out->features);
       free(out->labels);
+      free(out->raw_targets);
       memset(out, 0, sizeof(*out));
       // ":0:" — instance index, not line, is known here; same format as the
       // Python parser's ArffError(path, 0, ...) for this case.
@@ -393,6 +402,7 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
       return 1;
     }
     out->labels[i] = (int32_t)lab;
+    out->raw_targets[i] = lab;
     if (out->labels[i] > max_label) max_label = out->labels[i];
   }
   out->num_classes = max_label + 1;
@@ -425,6 +435,7 @@ void knn_arff_free(KnnArffResult* r) {
   if (!r) return;
   free(r->features);
   free(r->labels);
+  free(r->raw_targets);
   free(r->relation);
   free(r->attrs_json);
   free(r->error);

@@ -19,6 +19,7 @@ class _KnnArffResult(ctypes.Structure):
     _fields_ = [
         ("features", ctypes.POINTER(ctypes.c_float)),
         ("labels", ctypes.POINTER(ctypes.c_int32)),
+        ("raw_targets", ctypes.POINTER(ctypes.c_float)),
         ("n", ctypes.c_int64),
         ("d_features", ctypes.c_int64),
         ("num_classes", ctypes.c_int32),
@@ -28,8 +29,24 @@ class _KnnArffResult(ctypes.Structure):
     ]
 
 
+_ABI_VERSION = 2  # must match knn_arff_abi_version() in arff_c.cc
+
+
 def _load():
     lib = ctypes.CDLL(str(build_if_missing("libknn_arff.so")))  # OSError if unbuildable
+    # A stale prebuilt .so (source unavailable / no compiler to rebuild) must
+    # never be read through a newer struct layout — that is silent memory
+    # corruption. Old libraries lack the version symbol entirely; both cases
+    # surface as OSError, which load_arff treats as "native unavailable".
+    try:
+        abi = lib.knn_arff_abi_version()
+    except AttributeError as e:
+        raise OSError(f"libknn_arff.so predates the ABI version export: {e}")
+    if abi != _ABI_VERSION:
+        raise OSError(
+            f"libknn_arff.so ABI version {abi} != expected {_ABI_VERSION}; rebuild "
+            f"with `make native`"
+        )
     lib.knn_arff_parse.argtypes = [ctypes.c_char_p, ctypes.POINTER(_KnnArffResult)]
     lib.knn_arff_parse.restype = ctypes.c_int
     lib.knn_arff_free.argtypes = [ctypes.POINTER(_KnnArffResult)]
@@ -52,6 +69,8 @@ def parse(path: str) -> Dataset:
             if n and df else np.zeros((n, df), np.float32)
         labels = np.ctypeslib.as_array(res.labels, shape=(n,)).copy() \
             if n else np.zeros((n,), np.int32)
+        raw_targets = np.ctypeslib.as_array(res.raw_targets, shape=(n,)).copy() \
+            if n else np.zeros((n,), np.float32)
         attrs = [
             Attribute(a["name"], a["type"], a.get("nominal_values"))
             for a in json.loads(res.attrs_json.decode() if res.attrs_json else "[]")
@@ -61,6 +80,7 @@ def parse(path: str) -> Dataset:
             labels=labels,
             relation=res.relation.decode() if res.relation else "",
             attributes=attrs,
+            raw_targets=raw_targets,
         )
     finally:
         _lib.knn_arff_free(ctypes.byref(res))

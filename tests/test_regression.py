@@ -1,0 +1,166 @@
+"""KNNRegressor — the regression model family (a framework extension; the
+reference casts the class column to int unconditionally, main.cpp:57, so it
+cannot express this). Neighbor selection must be identical to the classifier's
+(squared Euclidean, (distance, index) lexicographic order, SURVEY.md §3.5);
+the reduction over neighbor targets is what's new.
+"""
+
+import numpy as np
+import pytest
+
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNRegressor
+
+
+def _brute_neighbors(train_x, test_x, k):
+    d = ((test_x[:, None, :] - train_x[None, :, :]) ** 2).sum(-1)
+    n = train_x.shape[0]
+    order = np.lexsort(
+        (np.broadcast_to(np.arange(n), d.shape), d), axis=1
+    )[:, :k]
+    return np.take_along_axis(d, order, axis=1), order
+
+
+def _make(rng, n=400, q=60, d=6):
+    train_x = rng.integers(0, 5, (n, d)).astype(np.float32)
+    targets = rng.normal(0, 10, n).astype(np.float32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.integers(0, 5, (q - q // 2, d)).astype(np.float32)]
+    )
+    train = Dataset(
+        features=train_x,
+        labels=np.maximum(targets, 0).astype(np.int32),
+        raw_targets=targets,
+    )
+    test = Dataset(
+        features=test_x,
+        labels=np.zeros(q, np.int32),
+        raw_targets=rng.normal(0, 10, q).astype(np.float32),
+    )
+    return train, test
+
+
+class TestKNNRegressor:
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_uniform_matches_bruteforce(self, rng, k):
+        train, test = _make(rng)
+        model = KNNRegressor(k=k).fit(train)
+        got = model.predict(test)
+        _, order = _brute_neighbors(train.features, test.features, k)
+        want = train.raw_targets[order].mean(axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_distance_weighted(self, rng):
+        train, test = _make(rng)
+        k = 4
+        model = KNNRegressor(k=k, weights="distance").fit(train)
+        got = model.predict(test)
+        dists, order = _brute_neighbors(train.features, test.features, k)
+        want = np.empty(test.num_instances, np.float64)
+        for i in range(test.num_instances):
+            t = train.raw_targets[order[i]].astype(np.float64)
+            if (dists[i] == 0).any():
+                want[i] = t[dists[i] == 0].mean()
+            else:
+                w = 1.0 / dists[i]
+                want[i] = (w * t).sum() / w.sum()
+        np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5)
+
+    def test_exact_match_query_returns_exact_target(self, rng):
+        # A query equal to exactly one train row: distance weighting must
+        # return that row's target exactly, however close other rows are.
+        train_x = np.array([[0.0, 0.0], [10.0, 10.0], [0.1, 0.0]], np.float32)
+        targets = np.array([7.0, 100.0, -50.0], np.float32)
+        train = Dataset(train_x, np.zeros(3, np.int32), raw_targets=targets)
+        test = Dataset(train_x[:1], np.zeros(1, np.int32))
+        got = KNNRegressor(k=2, weights="distance").fit(train).predict(test)
+        np.testing.assert_allclose(got, [7.0])
+
+    def test_nan_query_falls_back_to_uniform_mean(self):
+        train = Dataset(
+            np.array([[1.0], [2.0], [3.0]], np.float32),
+            np.zeros(3, np.int32),
+            raw_targets=np.array([1.0, 2.0, 9.0], np.float32),
+        )
+        test = Dataset(np.array([[np.nan]], np.float32), np.zeros(1, np.int32))
+        got = KNNRegressor(k=2, weights="distance").fit(train).predict(test)
+        # All distances +inf -> neighbors admitted in index order (0, 1).
+        np.testing.assert_allclose(got, [(1.0 + 2.0) / 2])
+
+    def test_score_is_r2(self, rng):
+        train, test = _make(rng, n=200, q=30)
+        model = KNNRegressor(k=3).fit(train)
+        preds = model.predict(test)
+        y = test.targets.astype(np.float64)
+        want = 1 - ((y - preds) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+        assert model.score(test) == pytest.approx(want)
+        # k=1 on a duplicate-free train set reproduces targets exactly.
+        uniq = Dataset(
+            np.arange(12, dtype=np.float32).reshape(6, 2),
+            np.zeros(6, np.int32),
+            raw_targets=np.linspace(-3, 3, 6).astype(np.float32),
+        )
+        assert KNNRegressor(k=1).fit(uniq).score(uniq) == pytest.approx(1.0)
+
+    def test_validation_errors(self, rng):
+        train, test = _make(rng, n=10, q=4)
+        with pytest.raises(ValueError, match="k must be"):
+            KNNRegressor(k=0)
+        with pytest.raises(ValueError, match="weights"):
+            KNNRegressor(k=1, weights="gaussian")
+        with pytest.raises(ValueError, match="exceeds"):
+            KNNRegressor(k=11).fit(train)
+        bad = Dataset(np.zeros((4, 3), np.float32), np.zeros(4, np.int32))
+        with pytest.raises(ValueError, match="features"):
+            KNNRegressor(k=1).fit(train).predict(bad)
+        with pytest.raises(RuntimeError, match="fit"):
+            KNNRegressor(k=1).predict(test)
+
+
+class TestRawTargets:
+    def test_parsers_keep_uncast_targets(self, tmp_path):
+        # 5.7 casts to label 5 (reference semantics) but the raw column
+        # survives for regression — in BOTH parsers, identically.
+        src = tmp_path / "t.arff"
+        src.write_text(
+            "@relation r\n"
+            "@attribute x NUMERIC\n"
+            "@attribute y NUMERIC\n"
+            "@data\n"
+            "1.0,5.7\n"
+            "2.0,0.25\n"
+            "3.0,3\n"
+        )
+        from knn_tpu.data import pyarff
+
+        ds_py = pyarff.parse_arff_file(str(src))
+        np.testing.assert_array_equal(ds_py.labels, [5, 0, 3])
+        np.testing.assert_allclose(ds_py.raw_targets, [5.7, 0.25, 3.0], rtol=1e-6)
+
+        try:
+            from knn_tpu.native import arff_native
+        except (ImportError, OSError):
+            pytest.skip("native parser unavailable")
+        ds_c = arff_native.parse(str(src))
+        np.testing.assert_array_equal(ds_c.labels, ds_py.labels)
+        np.testing.assert_array_equal(ds_c.raw_targets, ds_py.raw_targets)
+
+    def test_write_arff_round_trips_float_targets(self, tmp_path):
+        from knn_tpu.data.arff import write_arff, load_arff
+
+        ds = Dataset(
+            np.array([[1.0], [2.0]], np.float32),
+            np.array([5, 0], np.int32),
+            raw_targets=np.array([5.7, 0.25], np.float32),
+        )
+        out = tmp_path / "o.arff"
+        write_arff(ds, str(out))
+        back = load_arff(str(out))
+        np.testing.assert_array_equal(back.labels, ds.labels)
+        np.testing.assert_allclose(back.raw_targets, ds.raw_targets, rtol=1e-6)
+
+    def test_targets_fallback_without_raw(self):
+        ds = Dataset(np.zeros((2, 1), np.float32), np.array([3, 1], np.int32))
+        np.testing.assert_array_equal(ds.targets, [3.0, 1.0])
+        assert ds.targets.dtype == np.float32
