@@ -16,6 +16,29 @@ from knn_tpu.data.dataset import Dataset
 from knn_tpu.utils.evaluate import confusion_matrix, accuracy
 
 
+def _kneighbors_arrays(train_x: np.ndarray, test_x: np.ndarray, k: int):
+    """Shared retrieval core for both model families: ``(dists [Q,k],
+    indices [Q,k])`` sorted by (distance, train index). Pure geometry — no
+    label semantics, so the regressor can use it with negative/float targets
+    that the classifier's label validation would reject."""
+    import jax.numpy as jnp
+
+    from knn_tpu.backends.tpu import forward_candidates_core
+    from knn_tpu.utils.padding import pad_axis_to_multiple
+
+    n, q = train_x.shape[0], test_x.shape[0]
+    train_tile = max(min(2048, n), k)
+    tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
+    ty = np.zeros(tx.shape[0], np.int32)  # placeholder labels, unused
+    qx, _ = pad_axis_to_multiple(test_x, 128, axis=0)
+    d, i, _ = forward_candidates_core(
+        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+        jnp.asarray(n, jnp.int32),
+        k=k, train_tile=train_tile,
+    )
+    return np.asarray(d)[:q], np.asarray(i)[:q]
+
+
 class KNNClassifier:
     """k-nearest-neighbor classifier with reference-exact tie semantics
     (SURVEY.md §3.5) and a pluggable execution strategy.
@@ -55,24 +78,9 @@ class KNNClassifier:
         tie-break order. No reference analogue (its kernel discards the
         candidate set after voting, main.cpp:64-78); standard retrieval API.
         """
-        import jax.numpy as jnp
-
-        from knn_tpu.backends.tpu import forward_candidates_core
-        from knn_tpu.utils.padding import pad_axis_to_multiple
-
         train = self.train_
         train.validate_for_knn(self.k, test)
-        q = test.num_instances
-        train_tile = max(min(2048, train.num_instances), self.k)
-        tx, _ = pad_axis_to_multiple(train.features, train_tile, axis=0)
-        ty, _ = pad_axis_to_multiple(train.labels, train_tile, axis=0)
-        qx, _ = pad_axis_to_multiple(test.features, 128, axis=0)
-        d, i, _ = forward_candidates_core(
-            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
-            jnp.asarray(train.num_instances, jnp.int32),
-            k=self.k, train_tile=train_tile,
-        )
-        return np.asarray(d)[:q], np.asarray(i)[:q]
+        return _kneighbors_arrays(train.features, test.features, self.k)
 
     def predict_proba(self, test: Dataset) -> np.ndarray:
         """[Q, num_classes] neighbor-vote fractions (counts / k)."""
@@ -135,10 +143,9 @@ class KNNRegressor:
         return self._train
 
     def kneighbors(self, test: Dataset):
-        """Delegates to the classifier's candidate machinery (same kernel)."""
-        clf = KNNClassifier(self.k, **self.backend_opts)
-        clf._train = self._train
-        return clf.kneighbors(test)
+        """Same candidate kernel as the classifier, without its label
+        validation (regression targets may be negative/non-integer)."""
+        return _kneighbors_arrays(self.train_.features, test.features, self.k)
 
     def predict(self, test: Dataset) -> np.ndarray:
         train = self.train_
@@ -151,6 +158,7 @@ class KNNRegressor:
         neigh = train.targets[np.minimum(idx, train.num_instances - 1)]
         if self.weights == "uniform":
             return neigh.mean(axis=1).astype(np.float32)
+        dists = dists.astype(np.float64)  # 1/d on tiny float32 d overflows
         exact = dists == 0.0
         any_exact = exact.any(axis=1)
         with np.errstate(divide="ignore"):

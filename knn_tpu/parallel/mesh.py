@@ -27,6 +27,15 @@ def maybe_init_distributed() -> None:
     """
     # Check env FIRST: jax.process_count() would initialize the local backend,
     # and jax.distributed.initialize() must run before any backend init.
+    from knn_tpu.parallel.multihost import init_from_env
+
+    try:
+        if init_from_env():  # our launcher's explicit coordinator env vars
+            return
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return
+        raise  # coordinator unreachable etc. — fail loudly, not single-process
     if not (
         os.environ.get("JAX_COORDINATOR_ADDRESS")
         or os.environ.get("COORDINATOR_ADDRESS")

@@ -113,6 +113,15 @@ def predict(
     **_unused,
 ) -> np.ndarray:
     train.validate_for_knn(k, test)
+    if jax.process_count() > 1:
+        # Launched multi-controller (scripts/launch_multihost.py or a TPU
+        # pod): span every process's devices, like mpiexec spanning ranks.
+        from knn_tpu.parallel.multihost import predict_query_sharded_global
+
+        return predict_query_sharded_global(
+            train.features, train.labels, test.features, k, train.num_classes,
+            precision=precision, query_tile=query_tile, train_tile=train_tile,
+        )
     return predict_query_sharded(
         train.features, train.labels, test.features, k, train.num_classes,
         num_devices=num_devices, precision=precision,

@@ -1,0 +1,80 @@
+"""mpiexec-analogue launcher for the multi-process KNN worker.
+
+Reference invocation (mpi.cpp:123)::
+
+    mpiexec -np P ./mpi train.arff test.arff k
+
+Equivalent here::
+
+    python scripts/launch_multihost.py -np P train.arff test.arff k
+
+Spawns P copies of ``knn_tpu.parallel.multihost`` on this machine, wires the
+JAX distributed coordinator env vars (the launcher role mpiexec plays for
+MPI_Init), and streams rank 0's output. Off-TPU each process gets
+``--devices-per-proc`` virtual CPU devices, so a laptop can exercise the same
+multi-controller code path a TPU pod runs; on a real pod, run one worker per
+host with the same env vars instead (or rely on auto-detection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="launch_multihost")
+    p.add_argument("-np", "--num-procs", type=int, default=2)
+    p.add_argument("--devices-per-proc", type=int, default=2,
+                   help="virtual CPU devices per process (ignored on TPU)")
+    p.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("rest", nargs=argparse.REMAINDER,
+                   help="worker args: train.arff test.arff k [flags]")
+    args = p.parse_args()
+    if not args.rest:
+        p.error("missing worker args: train.arff test.arff k")
+
+    port = free_port()
+    procs = []
+    for rank in range(args.num_procs):
+        env = dict(
+            os.environ,
+            KNN_TPU_COORD_ADDR=f"127.0.0.1:{port}",
+            KNN_TPU_NUM_PROCS=str(args.num_procs),
+            KNN_TPU_PROC_ID=str(rank),
+        )
+        if args.platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.devices_per_proc}"
+            ).strip()
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "knn_tpu.parallel.multihost", *args.rest],
+                env=env,
+                cwd=REPO,
+                stdout=None if rank == 0 else subprocess.DEVNULL,
+                stderr=None if rank == 0 else subprocess.DEVNULL,
+            )
+        )
+    rc = 0
+    for proc in procs:
+        rc = proc.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Multi-process (multi-controller) distributed path — the MPI replacement,
+tested the way the reference never could be: an actual 2-process run over a
+coordinator, exercising jax.distributed init, a global mesh spanning both
+processes' devices, shard_map scatter/compute, and the replicating
+all-gather (SURVEY.md §4 called multi-node testing out as absent upstream).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+DATASETS = Path("/root/reference/datasets")
+
+
+@pytest.mark.skipif(not DATASETS.exists(), reason="reference datasets unavailable")
+def test_two_process_launch_matches_oracle(tmp_path):
+    from knn_tpu.backends.oracle import knn_oracle
+    from knn_tpu.data.arff import load_arff
+
+    dump = tmp_path / "preds.npy"
+    proc = subprocess.run(
+        [
+            sys.executable, "scripts/launch_multihost.py",
+            "-np", "2", "--devices-per-proc", "2",
+            str(DATASETS / "small-train.arff"),
+            str(DATASETS / "small-test.arff"),
+            "5", "--dump-predictions", str(dump),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Accuracy was 0.8625" in proc.stdout
+
+    train = load_arff(str(DATASETS / "small-train.arff"))
+    test = load_arff(str(DATASETS / "small-test.arff"))
+    want = knn_oracle(
+        train.features, train.labels, test.features, 5, train.num_classes
+    )
+    got = np.load(dump)
+    np.testing.assert_array_equal(got, want)

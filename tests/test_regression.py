@@ -28,9 +28,12 @@ def _make(rng, n=400, q=60, d=6):
         [train_x[rng.choice(n, q // 2, replace=False)],
          rng.integers(0, 5, (q - q // 2, d)).astype(np.float32)]
     )
+    # Deliberately negative int-cast labels: regression data routinely has
+    # negative targets, and the regressor must never trip the classifier's
+    # non-negative-label validation.
     train = Dataset(
         features=train_x,
-        labels=np.maximum(targets, 0).astype(np.int32),
+        labels=targets.astype(np.int32),
         raw_targets=targets,
     )
     test = Dataset(
@@ -76,6 +79,19 @@ class TestKNNRegressor:
         test = Dataset(train_x[:1], np.zeros(1, np.int32))
         got = KNNRegressor(k=2, weights="distance").fit(train).predict(test)
         np.testing.assert_allclose(got, [7.0])
+
+    def test_tiny_nonzero_distances_stay_finite(self):
+        # 1/d in float32 overflows to inf for d below ~3e-39, turning the
+        # weighted mean into inf/inf = NaN; weights must be computed in f64.
+        train = Dataset(
+            np.array([[0.0], [1e-20], [1.0]], np.float32),
+            np.zeros(3, np.int32),
+            raw_targets=np.array([2.0, 4.0, 100.0], np.float32),
+        )
+        test = Dataset(np.array([[5e-21]], np.float32), np.zeros(1, np.int32))
+        got = KNNRegressor(k=2, weights="distance").fit(train).predict(test)
+        assert np.isfinite(got).all()
+        assert 2.0 <= got[0] <= 4.0
 
     def test_nan_query_falls_back_to_uniform_mean(self):
         train = Dataset(
