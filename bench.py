@@ -231,7 +231,7 @@ def main():
     from knn_tpu.ops.pallas_knn import stripe_prepare_train, stripe_prepare_queries
 
     n, d_true = train.features.shape
-    block_q, block_n = 448, 2048  # 1,718 queries -> 4 blocks of 448
+    block_q, block_n = 896, 2048  # 1,718 queries -> 2 blocks of 896
     txT_host, d_pad = stripe_prepare_train(train.features, block_n)
     txT = jax.device_put(jnp.asarray(txT_host), dev)
     nv = jnp.asarray(n, jnp.int32)

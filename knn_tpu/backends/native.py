@@ -68,7 +68,11 @@ def knn_native(
 
 
 @register("native")
-def predict_serial(train: Dataset, test: Dataset, k: int, **_unused) -> np.ndarray:
+def predict_serial(
+    train: Dataset, test: Dataset, k: int, metric: str = "euclidean", **_unused
+) -> np.ndarray:
+    if metric != "euclidean":
+        raise ValueError("the native runtime implements euclidean only")
     train.validate_for_knn(k, test)
     return knn_native(
         train.features, train.labels, test.features, k, train.num_classes,
@@ -78,8 +82,11 @@ def predict_serial(train: Dataset, test: Dataset, k: int, **_unused) -> np.ndarr
 
 @register("native-mt")
 def predict_mt(
-    train: Dataset, test: Dataset, k: int, num_threads: int = 0, **_unused
+    train: Dataset, test: Dataset, k: int, num_threads: int = 0,
+    metric: str = "euclidean", **_unused
 ) -> np.ndarray:
+    if metric != "euclidean":
+        raise ValueError("the native runtime implements euclidean only")
     train.validate_for_knn(k, test)
     return knn_native(
         train.features, train.labels, test.features, k, train.num_classes,

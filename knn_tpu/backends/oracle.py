@@ -20,12 +20,39 @@ from knn_tpu.backends import register
 from knn_tpu.data.dataset import Dataset
 
 
+def _metric_dists(test_block, train_x, metric: str) -> np.ndarray:
+    """[chunk, D] queries x [N, D] train -> [chunk, N] float32 distances per
+    metric, with formulas matching ops/distance.py so oracle/TPU parity
+    holds. The [chunk, N, D] diff tensor is materialized only for the metrics
+    that read it."""
+    if metric in ("euclidean", "manhattan", "chebyshev"):
+        diff = test_block[:, None, :] - train_x[None, :, :]
+    if metric == "euclidean":
+        return np.einsum("qnd,qnd->qn", diff, diff, dtype=np.float32)
+    if metric == "manhattan":
+        return np.abs(diff).sum(axis=-1, dtype=np.float32)
+    if metric == "chebyshev":
+        if diff.shape[-1] == 0:
+            return np.zeros(diff.shape[:2], np.float32)
+        return np.abs(diff).max(axis=-1).astype(np.float32)
+    if metric == "cosine":
+        qn = np.sqrt((test_block * test_block).sum(-1, dtype=np.float32))[:, None]
+        tn = np.sqrt((train_x * train_x).sum(-1, dtype=np.float32))[None, :]
+        cross = test_block @ train_x.T
+        denom = qn * tn
+        with np.errstate(invalid="ignore"):
+            sim = np.where(denom > 0, cross / np.where(denom > 0, denom, 1.0), 0.0)
+        return (1.0 - sim).astype(np.float32)
+    raise ValueError(f"unknown metric {metric!r}")
+
+
 def knn_oracle(
     train_x: np.ndarray,
     train_y: np.ndarray,
     test_x: np.ndarray,
     k: int,
     num_classes: int,
+    metric: str = "euclidean",
 ) -> np.ndarray:
     """Pure-array oracle: float32 [N,D] train, int32 [N] labels, float32 [Q,D]
     queries -> int32 [Q] predictions."""
@@ -41,8 +68,7 @@ def knn_oracle(
     chunk = max(1, min(q, int(4e7) // max(n * d_feat, 1)))
     for s in range(0, q, chunk):
         e = min(q, s + chunk)
-        diff = test_x[s:e, None, :] - train_x[None, :, :]
-        dists = np.einsum("qnd,qnd->qn", diff, diff, dtype=np.float32)
+        dists = _metric_dists(test_x[s:e], train_x, metric)
         # Framework-wide policy: NaN distances count as +inf (the reference is
         # UB here — SURVEY.md §3.5.5); +inf candidates are admitted in
         # (distance, index) order.
@@ -57,8 +83,11 @@ def knn_oracle(
 
 
 @register("oracle")
-def predict(train: Dataset, test: Dataset, k: int, **_unused) -> np.ndarray:
+def predict(
+    train: Dataset, test: Dataset, k: int, metric: str = "euclidean", **_unused
+) -> np.ndarray:
     train.validate_for_knn(k, test)
     return knn_oracle(
-        train.features, train.labels, test.features, k, train.num_classes
+        train.features, train.labels, test.features, k, train.num_classes,
+        metric=metric,
     )

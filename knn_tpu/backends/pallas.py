@@ -28,8 +28,11 @@ def predict(
     interpret: Optional[bool] = None,
     precision: str = "auto",
     engine: str = "auto",
+    metric: str = "euclidean",
     **_unused,
 ) -> np.ndarray:
+    if metric != "euclidean":
+        raise ValueError("the pallas kernels implement euclidean only")
     train.validate_for_knn(k, test)
     if precision == "auto":
         # The exact form unrolls the feature axis on the VPU — right for the

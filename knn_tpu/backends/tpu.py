@@ -31,7 +31,7 @@ from jax import lax
 
 from knn_tpu.backends import register
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.ops.distance import _DIST_FNS
+from knn_tpu.ops.distance import _DIST_FNS, resolve_form
 from knn_tpu.ops.topk import topk_smallest, merge_topk, merge_topk_labeled
 from knn_tpu.ops.vote import vote
 from knn_tpu.utils.padding import pad_axis_to_multiple
@@ -209,9 +209,13 @@ def predict_arrays(
     train_tile: int = 2048,
     force_tiled: bool = False,
     approx: bool = False,
+    metric: str = "euclidean",
 ) -> np.ndarray:
     """Host-side entry: pads, dispatches to the right compiled path, unpads.
-    ``approx`` (full-matrix path only) uses TPU hardware approximate top-k."""
+    ``approx`` (full-matrix path only) uses TPU hardware approximate top-k.
+    ``metric`` selects the distance (euclidean honors ``precision`` forms —
+    ops/distance.py::resolve_form)."""
+    precision = resolve_form(precision, metric)
     q = test_x.shape[0]
     n = train_x.shape[0]
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
@@ -244,11 +248,12 @@ def predict(
     train_tile: int = 2048,
     force_tiled: bool = False,
     approx: bool = False,
+    metric: str = "euclidean",
     **_unused,
 ) -> np.ndarray:
     train.validate_for_knn(k, test)
     return predict_arrays(
         train.features, train.labels, test.features, k, train.num_classes,
         precision=precision, query_tile=query_tile, train_tile=train_tile,
-        force_tiled=force_tiled, approx=approx,
+        force_tiled=force_tiled, approx=approx, metric=metric,
     )

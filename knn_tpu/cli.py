@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--persona", choices=sorted(_PERSONAS), default="tpu")
     p.add_argument("--backend", default=None, help="override the persona's backend")
     p.add_argument(
+        "--metric",
+        choices=["euclidean", "manhattan", "chebyshev", "cosine"],
+        default="euclidean",
+        help="distance metric (euclidean = reference semantics; others are "
+        "framework extensions, unsupported by the native backends)",
+    )
+    p.add_argument(
         "--precision", choices=["exact", "fast", "bf16", "auto"], default="exact",
         help="distance form: exact (reference parity), fast (MXU matmul), "
         "bf16 (bfloat16 MXU operands, tpu-pallas only), "
@@ -124,6 +131,8 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         query_tile=args.query_tile,
         train_tile=args.train_tile,
     )
+    if args.metric != "euclidean":
+        opts["metric"] = args.metric
     if args.precision != "auto":
         opts["precision"] = args.precision
     if args.approx:
@@ -134,11 +143,15 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         opts["num_devices"] = args.devices
 
     fn = get_backend(backend_name)
-    if args.warmup:
-        fn(train, test, args.k, **opts)
-    with maybe_profile(args.trace_dir):
-        with RegionTimer() as t:
-            predictions = fn(train, test, args.k, **opts)
+    try:
+        if args.warmup:
+            fn(train, test, args.k, **opts)
+        with maybe_profile(args.trace_dir):
+            with RegionTimer() as t:
+                predictions = fn(train, test, args.k, **opts)
+    except ValueError as e:  # e.g. metric unsupported by this backend
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
     cm = confusion_matrix(predictions, test.labels, test.num_classes)
     acc = accuracy(cm)

@@ -16,7 +16,9 @@ from knn_tpu.data.dataset import Dataset
 from knn_tpu.utils.evaluate import confusion_matrix, accuracy
 
 
-def _kneighbors_arrays(train_x: np.ndarray, test_x: np.ndarray, k: int):
+def _kneighbors_arrays(
+    train_x: np.ndarray, test_x: np.ndarray, k: int, metric: str = "euclidean"
+):
     """Shared retrieval core for both model families: ``(dists [Q,k],
     indices [Q,k])`` sorted by (distance, train index). Pure geometry — no
     label semantics, so the regressor can use it with negative/float targets
@@ -24,8 +26,10 @@ def _kneighbors_arrays(train_x: np.ndarray, test_x: np.ndarray, k: int):
     import jax.numpy as jnp
 
     from knn_tpu.backends.tpu import forward_candidates_core
+    from knn_tpu.ops.distance import resolve_form
     from knn_tpu.utils.padding import pad_axis_to_multiple
 
+    form = resolve_form("exact", metric)
     n, q = train_x.shape[0], test_x.shape[0]
     train_tile = max(min(2048, n), k)
     tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
@@ -34,7 +38,7 @@ def _kneighbors_arrays(train_x: np.ndarray, test_x: np.ndarray, k: int):
     d, i, _ = forward_candidates_core(
         jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
         jnp.asarray(n, jnp.int32),
-        k=k, train_tile=train_tile,
+        k=k, train_tile=train_tile, precision=form,
     )
     return np.asarray(d)[:q], np.asarray(i)[:q]
 
@@ -49,11 +53,18 @@ class KNNClassifier:
     >>> model.score(test_ds)
     """
 
-    def __init__(self, k: int, backend: str = "tpu", **backend_opts):
+    def __init__(
+        self, k: int, backend: str = "tpu", metric: str = "euclidean",
+        **backend_opts,
+    ):
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
+        from knn_tpu.ops.distance import resolve_form
+
+        resolve_form("exact", metric)  # validate early
         self.k = k
         self.backend_name = backend
+        self.metric = metric
         self.backend_opts = backend_opts
         self._train: Optional[Dataset] = None
 
@@ -70,7 +81,7 @@ class KNNClassifier:
 
     def predict(self, test: Dataset) -> np.ndarray:
         fn = get_backend(self.backend_name)
-        return fn(self.train_, test, self.k, **self.backend_opts)
+        return fn(self.train_, test, self.k, metric=self.metric, **self.backend_opts)
 
     def kneighbors(self, test: Dataset):
         """Per-query neighbor candidates: ``(dists [Q,k], indices [Q,k])``
@@ -80,7 +91,9 @@ class KNNClassifier:
         """
         train = self.train_
         train.validate_for_knn(self.k, test)
-        return _kneighbors_arrays(train.features, test.features, self.k)
+        return _kneighbors_arrays(
+            train.features, test.features, self.k, metric=self.metric
+        )
 
     def predict_proba(self, test: Dataset) -> np.ndarray:
         """[Q, num_classes] neighbor-vote fractions (counts / k)."""
@@ -117,14 +130,19 @@ class KNNRegressor:
       those exact matches only.
     """
 
-    def __init__(self, k: int, weights: str = "uniform", **backend_opts):
+    def __init__(
+        self, k: int, weights: str = "uniform", metric: str = "euclidean"
+    ):
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         if weights not in ("uniform", "distance"):
             raise ValueError(f"weights must be 'uniform' or 'distance', got {weights!r}")
+        from knn_tpu.ops.distance import resolve_form
+
+        resolve_form("exact", metric)  # validate early
         self.k = k
         self.weights = weights
-        self.backend_opts = backend_opts
+        self.metric = metric
         self._train: Optional[Dataset] = None
 
     def fit(self, train: Dataset) -> "KNNRegressor":
@@ -145,15 +163,18 @@ class KNNRegressor:
     def kneighbors(self, test: Dataset):
         """Same candidate kernel as the classifier, without its label
         validation (regression targets may be negative/non-integer)."""
-        return _kneighbors_arrays(self.train_.features, test.features, self.k)
-
-    def predict(self, test: Dataset) -> np.ndarray:
         train = self.train_
         if test.num_features != train.num_features:
             raise ValueError(
                 f"train has {train.num_features} features but test has "
                 f"{test.num_features}"
             )
+        return _kneighbors_arrays(
+            train.features, test.features, self.k, metric=self.metric
+        )
+
+    def predict(self, test: Dataset) -> np.ndarray:
+        train = self.train_
         dists, idx = self.kneighbors(test)
         neigh = train.targets[np.minimum(idx, train.num_instances - 1)]
         if self.weights == "uniform":

@@ -54,8 +54,59 @@ def pairwise_sq_dists_bf16(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndar
     return jnp.where(jnp.isnan(d), jnp.inf, d)
 
 
+def pairwise_manhattan(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
+    """[Q, D], [N, D] -> [Q, N] L1 (cityblock) distances. A metric extension —
+    the reference hard-codes squared Euclidean (main.cpp:14-23)."""
+    d = jnp.sum(jnp.abs(queries[:, None, :] - train[None, :, :]), axis=-1)
+    return jnp.where(jnp.isnan(d), jnp.inf, d)
+
+
+def pairwise_chebyshev(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
+    """[Q, D], [N, D] -> [Q, N] L-inf distances (max coordinate gap)."""
+    if queries.shape[-1] == 0:  # max has no identity; zero features -> dist 0
+        return jnp.zeros((queries.shape[0], train.shape[0]), jnp.float32)
+    d = jnp.max(jnp.abs(queries[:, None, :] - train[None, :, :]), axis=-1)
+    return jnp.where(jnp.isnan(d), jnp.inf, d)
+
+
+def pairwise_cosine(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
+    """[Q, D], [N, D] -> [Q, N] cosine distances ``1 - q·t/(|q||t|)``; the
+    cross term rides the MXU. Zero vectors get distance 1 (orthogonal-like)."""
+    qn = jnp.sqrt(jnp.sum(queries * queries, axis=-1, keepdims=True))
+    tn = jnp.sqrt(jnp.sum(train * train, axis=-1))[None, :]
+    cross = queries @ train.T
+    denom = qn * tn
+    sim = jnp.where(denom > 0, cross / jnp.where(denom > 0, denom, 1.0), 0.0)
+    d = 1.0 - sim
+    return jnp.where(jnp.isnan(d), jnp.inf, d)
+
+
+# Distance-form registry. The first three are *forms of squared Euclidean*
+# (reference semantics at different speed/accuracy points); the rest are
+# metric extensions selected via ``metric=`` (resolve_form).
 _DIST_FNS = {
     "exact": pairwise_sq_dists,
     "fast": pairwise_sq_dists_dot,
     "bf16": pairwise_sq_dists_bf16,
+    "manhattan": pairwise_manhattan,
+    "chebyshev": pairwise_chebyshev,
+    "cosine": pairwise_cosine,
 }
+
+METRICS = ("euclidean", "manhattan", "chebyshev", "cosine")
+
+
+def resolve_form(precision: str, metric: str = "euclidean") -> str:
+    """Map (metric, precision) onto a ``_DIST_FNS`` key. Euclidean honors the
+    precision forms (exact/fast/bf16); every other metric has one form and
+    rejects a non-default precision rather than silently ignoring it."""
+    if metric in (None, "euclidean"):
+        return precision
+    if metric not in _DIST_FNS:
+        raise ValueError(f"unknown metric {metric!r}; choose from {METRICS}")
+    if precision not in ("exact", "auto"):
+        raise ValueError(
+            f"metric {metric!r} has a single implementation; precision "
+            f"{precision!r} does not apply"
+        )
+    return metric

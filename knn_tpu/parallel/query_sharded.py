@@ -110,8 +110,12 @@ def predict(
     precision: str = "exact",
     query_tile: int = 128,
     train_tile: int = 2048,
+    metric: str = "euclidean",
     **_unused,
 ) -> np.ndarray:
+    from knn_tpu.ops.distance import resolve_form
+
+    precision = resolve_form(precision, metric)
     train.validate_for_knn(k, test)
     if jax.process_count() > 1:
         # Launched multi-controller (scripts/launch_multihost.py or a TPU

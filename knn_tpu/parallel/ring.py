@@ -141,8 +141,12 @@ def predict(
     k: int,
     num_devices: Optional[int] = None,
     precision: str = "exact",
+    metric: str = "euclidean",
     **_unused,
 ) -> np.ndarray:
+    from knn_tpu.ops.distance import resolve_form
+
+    precision = resolve_form(precision, metric)
     train.validate_for_knn(k, test)
     return predict_ring(
         train.features, train.labels, test.features, k, train.num_classes,

@@ -139,8 +139,12 @@ def predict(
     precision: str = "exact",
     query_tile: int = 128,
     train_tile: int = 1024,
+    metric: str = "euclidean",
     **_unused,
 ) -> np.ndarray:
+    from knn_tpu.ops.distance import resolve_form
+
+    precision = resolve_form(precision, metric)
     train.validate_for_knn(k, test)
     return predict_train_sharded(
         train.features, train.labels, test.features, k, train.num_classes,

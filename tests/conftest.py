@@ -47,5 +47,11 @@ def large():
 
 
 @pytest.fixture(scope="session")
+def small_paths():
+    d = fixtures.datasets_dir()
+    return str(d / "small-train.arff"), str(d / "small-test.arff")
+
+
+@pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

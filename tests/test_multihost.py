@@ -10,24 +10,24 @@ import sys
 from pathlib import Path
 
 import numpy as np
-import pytest
+
+from tests import fixtures
 
 REPO = Path(__file__).resolve().parent.parent
-DATASETS = Path("/root/reference/datasets")
 
 
-@pytest.mark.skipif(not DATASETS.exists(), reason="reference datasets unavailable")
 def test_two_process_launch_matches_oracle(tmp_path):
     from knn_tpu.backends.oracle import knn_oracle
     from knn_tpu.data.arff import load_arff
 
+    datasets = fixtures.datasets_dir()  # reference checkout or synth fallback
     dump = tmp_path / "preds.npy"
     proc = subprocess.run(
         [
             sys.executable, "scripts/launch_multihost.py",
             "-np", "2", "--devices-per-proc", "2",
-            str(DATASETS / "small-train.arff"),
-            str(DATASETS / "small-test.arff"),
+            str(datasets / "small-train.arff"),
+            str(datasets / "small-test.arff"),
             "5", "--dump-predictions", str(dump),
         ],
         cwd=REPO,
@@ -36,10 +36,12 @@ def test_two_process_launch_matches_oracle(tmp_path):
         timeout=240,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert "Accuracy was 0.8625" in proc.stdout
+    assert "Accuracy was" in proc.stdout
+    if fixtures.using_reference_datasets():
+        assert "Accuracy was 0.8625" in proc.stdout  # golden, BASELINE.md
 
-    train = load_arff(str(DATASETS / "small-train.arff"))
-    test = load_arff(str(DATASETS / "small-test.arff"))
+    train = load_arff(str(datasets / "small-train.arff"))
+    test = load_arff(str(datasets / "small-test.arff"))
     want = knn_oracle(
         train.features, train.labels, test.features, 5, train.num_classes
     )

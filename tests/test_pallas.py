@@ -145,6 +145,29 @@ class TestPallasKernel:
         )
         np.testing.assert_array_equal(got, want)
 
+    def test_stripe_fuzz_random_shapes_match_oracle(self, rng):
+        # Randomized shapes exercise every padding boundary: n below/above
+        # block_n, q not a block_q multiple, d=1..13, k up to n.
+        from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
+
+        for trial in range(12):
+            n = int(rng.integers(3, 400))
+            q = int(rng.integers(1, 60))
+            d = int(rng.integers(1, 14))
+            k = int(rng.integers(1, min(n, 12) + 1))
+            train_x = rng.integers(0, 3, (n, d)).astype(np.float32)
+            test_x = rng.integers(0, 3, (q, d)).astype(np.float32)
+            dists, idx = stripe_candidates_arrays(
+                train_x, test_x, k, block_q=32, block_n=128, interpret=True
+            )
+            bf = ((test_x[:, None, :] - train_x[None, :, :]) ** 2).sum(-1)
+            order = np.lexsort(
+                (np.broadcast_to(np.arange(n), bf.shape), bf), axis=1
+            )[:, :k]
+            np.testing.assert_array_equal(
+                idx, order, err_msg=f"trial {trial}: n={n} q={q} d={d} k={k}"
+            )
+
     def test_stripe_rejects_fast_precision(self, rng):
         train_x, train_y, test_x, c = _int_grid_problem(rng, n=64, q=8, d=4)
         with pytest.raises(ValueError, match="exact"):
