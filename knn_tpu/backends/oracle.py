@@ -42,7 +42,11 @@ def _metric_dists(test_block, train_x, metric: str) -> np.ndarray:
         denom = qn * tn
         with np.errstate(invalid="ignore"):
             sim = np.where(denom > 0, cross / np.where(denom > 0, denom, 1.0), 0.0)
-        return (1.0 - sim).astype(np.float32)
+        d = (1.0 - sim).astype(np.float32)
+        # NaN features poison cross/denom but `denom > 0` is False for NaN,
+        # which would leave those rows at d=1.0; enforce NaN -> +inf.
+        d[np.isnan(cross) | np.isnan(denom)] = np.inf
+        return d
     raise ValueError(f"unknown metric {metric!r}")
 
 

@@ -194,6 +194,14 @@ def forward_candidates_core(
     )
 
 
+# Jitted candidate retrieval — models.kneighbors dispatches through this
+# instead of tracing forward_candidates_core op-by-op eagerly.
+knn_forward_candidates = jax.jit(
+    forward_candidates_core,
+    static_argnames=("k", "precision", "query_tile", "train_tile"),
+)
+
+
 # [Q, N] float32 distance-matrix cells above which the tiled path is used.
 _FULL_MATRIX_CELL_LIMIT = 16 * 1024 * 1024
 

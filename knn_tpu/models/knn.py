@@ -25,7 +25,7 @@ def _kneighbors_arrays(
     that the classifier's label validation would reject."""
     import jax.numpy as jnp
 
-    from knn_tpu.backends.tpu import forward_candidates_core
+    from knn_tpu.backends.tpu import knn_forward_candidates
     from knn_tpu.ops.distance import resolve_form
     from knn_tpu.utils.padding import pad_axis_to_multiple
 
@@ -35,7 +35,7 @@ def _kneighbors_arrays(
     tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
     ty = np.zeros(tx.shape[0], np.int32)  # placeholder labels, unused
     qx, _ = pad_axis_to_multiple(test_x, 128, axis=0)
-    d, i, _ = forward_candidates_core(
+    d, i, _ = knn_forward_candidates(
         jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
         jnp.asarray(n, jnp.int32),
         k=k, train_tile=train_tile, precision=form,
@@ -55,16 +55,25 @@ class KNNClassifier:
 
     def __init__(
         self, k: int, backend: str = "tpu", metric: str = "euclidean",
-        **backend_opts,
+        weights: str = "uniform", **backend_opts,
     ):
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
+        if weights not in ("uniform", "distance"):
+            raise ValueError(f"weights must be 'uniform' or 'distance', got {weights!r}")
+        if weights == "distance" and (backend != "tpu" or backend_opts):
+            raise ValueError(
+                "weights='distance' computes its vote from the JAX candidate "
+                "kernel; a backend choice or backend options would be "
+                "silently ignored — drop them or use weights='uniform'"
+            )
         from knn_tpu.ops.distance import resolve_form
 
         resolve_form("exact", metric)  # validate early
         self.k = k
         self.backend_name = backend
         self.metric = metric
+        self.weights = weights
         self.backend_opts = backend_opts
         self._train: Optional[Dataset] = None
 
@@ -80,8 +89,32 @@ class KNNClassifier:
         return self._train
 
     def predict(self, test: Dataset) -> np.ndarray:
+        if self.weights == "distance":
+            # Weighted vote (opt-in extension; the reference vote is an
+            # unweighted bincount, main.cpp:65-67): per-class inverse-distance
+            # weight sums, ties to the lowest class id like the reference.
+            return np.argmax(self._weighted_class_scores(test), axis=1).astype(
+                np.int32
+            )
         fn = get_backend(self.backend_name)
         return fn(self.train_, test, self.k, metric=self.metric, **self.backend_opts)
+
+    def _weighted_class_scores(self, test: Dataset) -> np.ndarray:
+        train = self.train_
+        dists, idx = self.kneighbors(test)
+        labels = train.labels[np.minimum(idx, train.num_instances - 1)]
+        dists = dists.astype(np.float64)
+        exact = dists == 0.0
+        any_exact = exact.any(axis=1)
+        with np.errstate(divide="ignore"):
+            w = np.where(exact, 0.0, 1.0 / dists)
+        w = np.where(any_exact[:, None], exact.astype(np.float64), w)
+        all_inf = ~np.isfinite(w).all(axis=1) | (w.sum(axis=1) == 0)
+        w = np.where(all_inf[:, None], 1.0, w)  # degenerate rows: uniform
+        scores = np.zeros((test.num_instances, train.num_classes))
+        for c in range(train.num_classes):
+            scores[:, c] = np.where(labels == c, w, 0.0).sum(axis=1)
+        return scores
 
     def kneighbors(self, test: Dataset):
         """Per-query neighbor candidates: ``(dists [Q,k], indices [Q,k])``
@@ -96,8 +129,12 @@ class KNNClassifier:
         )
 
     def predict_proba(self, test: Dataset) -> np.ndarray:
-        """[Q, num_classes] neighbor-vote fractions (counts / k)."""
+        """[Q, num_classes] neighbor-vote fractions: counts/k for uniform
+        weights, normalized inverse-distance weight sums otherwise."""
         train = self.train_
+        if self.weights == "distance":
+            scores = self._weighted_class_scores(test)
+            return scores / scores.sum(axis=1, keepdims=True)
         _, idx = self.kneighbors(test)
         labels = train.labels[np.minimum(idx, train.num_instances - 1)]
         counts = np.apply_along_axis(

@@ -78,7 +78,11 @@ def pairwise_cosine(queries: jnp.ndarray, train: jnp.ndarray) -> jnp.ndarray:
     denom = qn * tn
     sim = jnp.where(denom > 0, cross / jnp.where(denom > 0, denom, 1.0), 0.0)
     d = 1.0 - sim
-    return jnp.where(jnp.isnan(d), jnp.inf, d)
+    # NaN features poison cross/denom, and `denom > 0` is False for NaN —
+    # without an explicit check those rows would land at d=1.0 instead of
+    # following the framework-wide NaN -> +inf policy.
+    bad = jnp.isnan(cross) | jnp.isnan(denom) | jnp.isnan(d)
+    return jnp.where(bad, jnp.inf, d)
 
 
 # Distance-form registry. The first three are *forms of squared Euclidean*

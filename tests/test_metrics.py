@@ -141,6 +141,21 @@ class TestMetricErrors:
                   "--metric", "cosine"])
         assert rc == 1
 
+    def test_cosine_nan_features_excluded(self):
+        # NaN-feature rows must follow the NaN -> +inf policy under cosine
+        # too (a bare `denom > 0` guard would leave them at d=1.0, ranking
+        # them ahead of anti-correlated valid neighbors).
+        train_x = np.array([[1.0, 0.0], [np.nan, 1.0], [-1.0, 0.0]], np.float32)
+        train_y = np.array([0, 1, 2], np.int32)
+        test_x = np.array([[1.0, 0.0]], np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 2, 3, metric="cosine")
+        model = KNNClassifier(k=2, metric="cosine").fit(Dataset(train_x, train_y))
+        _, idx = model.kneighbors(Dataset(test_x, np.zeros(1, np.int32)))
+        # Neighbors: row 0 (d=0) then row 2 (d=2); NaN row 1 must be last.
+        np.testing.assert_array_equal(idx[0], [0, 2])
+        got = model.predict(Dataset(test_x, np.zeros(1, np.int32)))
+        np.testing.assert_array_equal(got, want)
+
     def test_model_rejects_unknown_metric(self):
         with pytest.raises(ValueError, match="unknown metric"):
             KNNClassifier(k=1, metric="hamming")

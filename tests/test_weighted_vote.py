@@ -1,0 +1,79 @@
+"""Distance-weighted classification voting (opt-in extension; the reference
+vote is an unweighted bincount with lowest-class-id ties, main.cpp:64-78,
+which stays the default)."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier
+
+
+def _problem(rng, n=300, q=40, d=5, c=6):
+    train_x = rng.uniform(0, 10, (n, d)).astype(np.float32)
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.uniform(0, 10, (q - q // 2, d)).astype(np.float32)]
+    )
+    return Dataset(train_x, train_y), Dataset(test_x, np.zeros(q, np.int32))
+
+
+class TestWeightedVote:
+    def test_matches_manual_weighted_argmax(self, rng):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=7, weights="distance").fit(train)
+        got = model.predict(test)
+        dists, idx = model.kneighbors(test)
+        labels = train.labels[idx]
+        want = np.empty(test.num_instances, np.int32)
+        for i in range(test.num_instances):
+            d = dists[i].astype(np.float64)
+            if (d == 0).any():
+                w = (d == 0).astype(np.float64)
+            else:
+                w = 1.0 / d
+            scores = np.zeros(train.num_classes)
+            for lbl, wt in zip(labels[i], w):
+                scores[lbl] += wt
+            want[i] = np.argmax(scores)
+        np.testing.assert_array_equal(got, want)
+
+    def test_exact_match_dominates(self):
+        # Query equal to one train row: its class must win outright even
+        # against k-1 very close neighbors of another class.
+        train = Dataset(
+            np.array([[0.0], [0.01], [0.02], [0.03]], np.float32),
+            np.array([3, 1, 1, 1], np.int32),
+        )
+        test = Dataset(np.array([[0.0]], np.float32), np.zeros(1, np.int32))
+        model = KNNClassifier(k=4, weights="distance").fit(train)
+        assert model.predict(test)[0] == 3
+        proba = model.predict_proba(test)
+        assert proba[0, 3] == pytest.approx(1.0)
+
+    def test_uniform_default_unchanged(self, rng):
+        # weights="uniform" must stay bit-identical to the backend vote.
+        train, test = _problem(rng)
+        a = KNNClassifier(k=5).fit(train).predict(test)
+        b = KNNClassifier(k=5, weights="uniform").fit(train).predict(test)
+        np.testing.assert_array_equal(a, b)
+
+    def test_proba_normalized(self, rng):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=5, weights="distance").fit(train)
+        proba = model.predict_proba(test)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-9)
+        assert (proba >= 0).all()
+
+    def test_bad_weights_rejected(self):
+        with pytest.raises(ValueError, match="weights"):
+            KNNClassifier(k=1, weights="rank")
+
+    def test_backend_options_rejected_with_weighted_vote(self):
+        # The weighted vote always uses the JAX candidate kernel; accepting a
+        # backend choice and silently ignoring it would mislead.
+        with pytest.raises(ValueError, match="silently ignored"):
+            KNNClassifier(k=1, backend="native", weights="distance")
+        with pytest.raises(ValueError, match="silently ignored"):
+            KNNClassifier(k=1, weights="distance", precision="fast")
