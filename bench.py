@@ -114,18 +114,31 @@ def bench_mnist():
         bufs.append(jnp.asarray(qp))
     jax.block_until_ready(bufs)
 
-    def step(qb):
-        return knn_pallas_candidates(
-            txj, qb, n, k, block_q=256, block_n=1024, d_true=d, precision="fast"
-        )
+    def make_step(precision):
+        def step(qb):
+            return knn_pallas_candidates(
+                txj, qb, n, k, block_q=256, block_n=1024, d_true=d,
+                precision=precision,
+            )
+        return step
 
+    step = make_step("fast")
     t0 = time.monotonic()
     np.asarray(step(bufs[0])[0])
     log(f"compile+first run: {time.monotonic() - t0:.2f}s")
     per_step, sync = _pipelined_slope(step, bufs, 10, 40)
     qps = q / per_step
     tflops = 2 * q * n * d / per_step / 1e12
-    log(f"{per_step*1e3:.2f} ms/step, ~{sync*1e3:.0f} ms sync overhead")
+    log(f"f32 matmul form: {per_step*1e3:.2f} ms/step, "
+        f"~{sync*1e3:.0f} ms sync overhead")
+
+    # bfloat16 MXU operands (f32 accumulation): 2x matmul throughput at ~3
+    # fewer mantissa digits in the cross term — the wide-feature speed knob.
+    step_bf16 = make_step("bf16")
+    np.asarray(step_bf16(bufs[0])[0])
+    bf16_step, _ = _pipelined_slope(step_bf16, bufs, 10, 40)
+    log(f"bf16 form: {bf16_step*1e3:.2f} ms/step "
+        f"({q/bf16_step:.0f} q/s, {2*q*n*d/bf16_step/1e12:.0f} Tflop/s)")
     print(
         json.dumps(
             {
@@ -135,6 +148,8 @@ def bench_mnist():
                 "vs_baseline": None,
                 "tflops": round(tflops, 1),
                 "step_ms": round(per_step * 1e3, 3),
+                "bf16_qps": round(q / bf16_step, 1),
+                "bf16_tflops": round(2 * q * n * d / bf16_step / 1e12, 1),
             }
         )
     )

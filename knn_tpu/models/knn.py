@@ -43,6 +43,55 @@ def _kneighbors_arrays(
     return np.asarray(d)[:q], np.asarray(i)[:q]
 
 
+def _inverse_distance_weights(dists: np.ndarray):
+    """Shared inverse-distance weighting for both model families: float64
+    weights (1/d on tiny f32 distances overflows), exact-distance-0 matches
+    claim all the weight, and rows whose weights all vanish (all-inf
+    distances) are flagged for a uniform fallback. Returns ``(w, degenerate)``
+    where ``degenerate`` marks rows needing the uniform treatment."""
+    dists = dists.astype(np.float64)
+    exact = dists == 0.0
+    any_exact = exact.any(axis=1)
+    with np.errstate(divide="ignore"):
+        w = np.where(exact, 0.0, 1.0 / dists)
+    w = np.where(any_exact[:, None], exact.astype(np.float64), w)
+    degenerate = w.sum(axis=1) == 0
+    return w, degenerate
+
+
+def radius_neighbors_arrays(
+    train_x: np.ndarray,
+    test_x: np.ndarray,
+    radius: float,
+    max_neighbors: int = 128,
+    metric: str = "euclidean",
+):
+    """All train rows within ``radius`` of each query, as fixed-shape masked
+    arrays — the TPU-friendly formulation (variable-length results defeat
+    static shapes): ``(dists [Q,m], indices [Q,m], mask [Q,m])`` where
+    ``m = min(max_neighbors, N)``, candidates sorted by (distance, index),
+    ``mask`` marking the within-radius entries. Euclidean radii are compared
+    against *squared* distances, matching the framework's distance values.
+
+    Raises when a query's neighborhood might exceed ``max_neighbors`` (every
+    returned candidate in-radius with more train rows unseen) rather than
+    silently truncating.
+    """
+    n = train_x.shape[0]
+    m = min(max_neighbors, n)
+    d, i = _kneighbors_arrays(train_x, test_x, m, metric=metric)
+    mask = d <= radius
+    full = mask.all(axis=1)
+    if m < n and bool(full.any()):
+        rows = np.nonzero(full)[0][:5]
+        raise ValueError(
+            f"queries {rows.tolist()} have at least {m} neighbors within "
+            f"radius {radius}; raise max_neighbors (or shrink the radius) to "
+            f"get complete neighborhoods"
+        )
+    return d, i, mask
+
+
 class KNNClassifier:
     """k-nearest-neighbor classifier with reference-exact tie semantics
     (SURVEY.md §3.5) and a pluggable execution strategy.
@@ -103,14 +152,8 @@ class KNNClassifier:
         train = self.train_
         dists, idx = self.kneighbors(test)
         labels = train.labels[np.minimum(idx, train.num_instances - 1)]
-        dists = dists.astype(np.float64)
-        exact = dists == 0.0
-        any_exact = exact.any(axis=1)
-        with np.errstate(divide="ignore"):
-            w = np.where(exact, 0.0, 1.0 / dists)
-        w = np.where(any_exact[:, None], exact.astype(np.float64), w)
-        all_inf = ~np.isfinite(w).all(axis=1) | (w.sum(axis=1) == 0)
-        w = np.where(all_inf[:, None], 1.0, w)  # degenerate rows: uniform
+        w, degenerate = _inverse_distance_weights(dists)
+        w = np.where(degenerate[:, None], 1.0, w)  # degenerate rows: uniform
         scores = np.zeros((test.num_instances, train.num_classes))
         for c in range(train.num_classes):
             scores[:, c] = np.where(labels == c, w, 0.0).sum(axis=1)
@@ -126,6 +169,17 @@ class KNNClassifier:
         train.validate_for_knn(self.k, test)
         return _kneighbors_arrays(
             train.features, test.features, self.k, metric=self.metric
+        )
+
+    def radius_neighbors(
+        self, test: Dataset, radius: float, max_neighbors: int = 128
+    ):
+        """Within-radius retrieval (``(dists, indices, mask)`` fixed-shape
+        masked arrays — see :func:`radius_neighbors_arrays`)."""
+        train = self.train_
+        train.validate_for_knn(1, test)
+        return radius_neighbors_arrays(
+            train.features, test.features, radius, max_neighbors, self.metric
         )
 
     def predict_proba(self, test: Dataset) -> np.ndarray:
@@ -197,6 +251,20 @@ class KNNRegressor:
             raise RuntimeError("call fit() before predict()/score()")
         return self._train
 
+    def radius_neighbors(
+        self, test: Dataset, radius: float, max_neighbors: int = 128
+    ):
+        """Within-radius retrieval — see :func:`radius_neighbors_arrays`."""
+        train = self.train_
+        if test.num_features != train.num_features:
+            raise ValueError(
+                f"train has {train.num_features} features but test has "
+                f"{test.num_features}"
+            )
+        return radius_neighbors_arrays(
+            train.features, test.features, radius, max_neighbors, self.metric
+        )
+
     def kneighbors(self, test: Dataset):
         """Same candidate kernel as the classifier, without its label
         validation (regression targets may be negative/non-integer)."""
@@ -216,17 +284,12 @@ class KNNRegressor:
         neigh = train.targets[np.minimum(idx, train.num_instances - 1)]
         if self.weights == "uniform":
             return neigh.mean(axis=1).astype(np.float32)
-        dists = dists.astype(np.float64)  # 1/d on tiny float32 d overflows
-        exact = dists == 0.0
-        any_exact = exact.any(axis=1)
-        with np.errstate(divide="ignore"):
-            w = np.where(exact, 0.0, 1.0 / dists)
-        w = np.where(any_exact[:, None], exact.astype(np.float64), w)
+        w, degenerate = _inverse_distance_weights(dists)
         w_sum = w.sum(axis=1)
-        weighted = (w * neigh).sum(axis=1) / np.where(w_sum > 0, w_sum, 1.0)
+        weighted = (w * neigh).sum(axis=1) / np.where(degenerate, 1.0, w_sum)
         # All-inf distances (e.g. NaN queries) zero every weight; fall back to
         # the uniform mean rather than emitting 0/0.
-        return np.where(w_sum > 0, weighted, neigh.mean(axis=1)).astype(np.float32)
+        return np.where(degenerate, neigh.mean(axis=1), weighted).astype(np.float32)
 
     def score(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> float:
         """Coefficient of determination R^2 against ``test.targets``."""

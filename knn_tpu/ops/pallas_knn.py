@@ -189,9 +189,10 @@ def knn_pallas_candidates(
 
 def _knn_stripe_kernel(
     n_valid_ref, q_ref, tT_ref, out_d_ref, out_i_ref, cand_d_ref, cand_i_ref,
-    *, k: int, block_n: int, d_true: int, n_tiles: int,
+    *, k: int, block_n: int, d_true: int, n_tiles: int, precision: str = "exact",
 ):
-    """Lane-striped exact KNN tile kernel (narrow-feature fast path).
+    """Lane-striped KNN tile kernel (exact subtraction-form distance by
+    default; ``precision="fast"/"bf16"`` swaps in the MXU matmul expansion).
 
     The round-based merge in :func:`_knn_kernel` pays k cross-LANE
     min-reductions per train tile — slow on the VPU. Here each of the 128
@@ -223,13 +224,30 @@ def _knn_stripe_kernel(
     bq = q.shape[0]
     g = block_n // lanes
 
-    # Exact subtraction-form distance for the whole tile, accumulated over
-    # feature planes in source order: [BQ,1] lane-broadcast minus [1,BN]
-    # sublane-broadcast per feature.
-    d_full = jnp.zeros((bq, block_n), jnp.float32)
-    for f in range(d_true):
-        diff = q[:, f : f + 1] - tT_ref[f, :].reshape(1, block_n)
-        d_full = d_full + diff * diff
+    if precision in ("fast", "bf16"):
+        # MXU distance for the whole tile via |q|^2 - 2 q.t + |t|^2; the
+        # transposed train layout makes the cross term one dot with the
+        # feature (sublane) axis contracted. Wide-feature mode: not
+        # prediction-exact near 0 (ops/distance.py caveats apply).
+        t = tT_ref[:]  # [D_pad, BN]
+        q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
+        t2 = jnp.sum(t * t, axis=0).reshape(1, block_n)  # [1, BN]
+        qc, tc = (q.astype(jnp.bfloat16), t.astype(jnp.bfloat16)) \
+            if precision == "bf16" else (q, t)
+        cross = jax.lax.dot_general(
+            qc, tc,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        d_full = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+    else:
+        # Exact subtraction-form distance for the whole tile, accumulated over
+        # feature planes in source order: [BQ,1] lane-broadcast minus [1,BN]
+        # sublane-broadcast per feature.
+        d_full = jnp.zeros((bq, block_n), jnp.float32)
+        for f in range(d_true):
+            diff = q[:, f : f + 1] - tT_ref[f, :].reshape(1, block_n)
+            d_full = d_full + diff * diff
     d_full = jnp.where(jnp.isnan(d_full), jnp.inf, d_full)
 
     # Selection planes: the g tile chunks plus the k running candidate levels.
@@ -277,7 +295,7 @@ def _knn_stripe_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "block_q", "block_n", "interpret", "d_true"),
+    static_argnames=("k", "block_q", "block_n", "interpret", "d_true", "precision"),
 )
 def knn_pallas_stripe_candidates(
     train_xT: jnp.ndarray,
@@ -288,8 +306,9 @@ def knn_pallas_stripe_candidates(
     block_n: int = 2048,
     interpret: bool = False,
     d_true: Optional[int] = None,
+    precision: str = "exact",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Exact lane-striped kernel entry. ``train_xT`` is the TRANSPOSED train
+    """Lane-striped kernel entry. ``train_xT`` is the TRANSPOSED train
     matrix ``[D_pad, N_pad]`` (N padded to ``block_n``, D padded to a sublane
     multiple); ``test_x`` is ``[Q_pad, D_pad]``. Returns ``([Q,k] dists,
     [Q,k] int32 global indices)`` sorted ascending by (distance, index)."""
@@ -305,6 +324,7 @@ def knn_pallas_stripe_candidates(
         block_n=block_n,
         d_true=d_true if d_true is not None else d_pad,
         n_tiles=grid[1],
+        precision=precision,
     )
     cand_d, cand_i = pl.pallas_call(
         kernel,
@@ -389,6 +409,7 @@ def stripe_candidates_arrays(
     block_q: Optional[int] = None,
     block_n: Optional[int] = None,
     interpret: bool = False,
+    precision: str = "exact",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Host entry for the lane-striped kernel: handles padding and the [D, N]
     train transposition, returns unpadded ``([Q,k] dists, [Q,k] indices)``."""
@@ -400,13 +421,17 @@ def stripe_candidates_arrays(
     d, idx = knn_pallas_stripe_candidates(
         jnp.asarray(txT), jnp.asarray(qx), n, k,
         block_q=block_q, block_n=block_n, interpret=interpret, d_true=d_true,
+        precision=precision,
     )
     return np.asarray(d)[:q], np.asarray(idx)[:q]
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "num_classes", "block_q", "block_n", "d_true", "interpret"),
+    static_argnames=(
+        "k", "num_classes", "block_q", "block_n", "d_true", "interpret",
+        "precision",
+    ),
 )
 def knn_stripe_classify(
     train_xT: jnp.ndarray,
@@ -419,6 +444,7 @@ def knn_stripe_classify(
     block_n: int = 2048,
     d_true: Optional[int] = None,
     interpret: bool = False,
+    precision: str = "exact",
 ) -> jnp.ndarray:
     """One-dispatch classify on pre-padded device arrays: stripe kernel +
     lexicographic merge + vote, fused under a single jit. The headline exact
@@ -428,6 +454,7 @@ def knn_stripe_classify(
     _, idx = knn_pallas_stripe_candidates(
         train_xT, test_x, n_valid, k,
         block_q=block_q, block_n=block_n, interpret=interpret, d_true=d_true,
+        precision=precision,
     )
     safe = jnp.minimum(idx, train_y.shape[0] - 1)
     return vote(train_y[safe], num_classes)
@@ -449,9 +476,10 @@ def predict_pallas(
     gather labels, vote. Interpret mode defaults on for non-TPU backends so the
     same code path is testable on the CPU mesh (SURVEY.md §4).
 
-    ``engine``: "stripe" = the lane-striped exact kernel (fastest for narrow
-    features), "merge" = the tile-merge kernel (any width; required for the
-    fast/bf16 MXU distance forms), "auto" = stripe when it applies."""
+    ``engine``: "stripe" = the lane-striped kernel (fastest for narrow
+    features; supports every precision form), "merge" = the tile-merge
+    kernel (the wide-feature default), "auto" = stripe for narrow-feature
+    exact problems, merge otherwise."""
     from knn_tpu.ops.vote import vote
 
     if interpret is None:
@@ -465,12 +493,15 @@ def predict_pallas(
             else "merge"
         )
 
+    if precision not in ("exact", "fast", "bf16"):
+        raise ValueError(
+            f"unknown precision {precision!r}; choose exact, fast, or bf16"
+        )
     if engine == "stripe":
-        if precision != "exact":
-            raise ValueError("the stripe engine implements the exact form only")
         _, idx = stripe_candidates_arrays(
             train_x, test_x, k,
             block_q=block_q, block_n=block_n, interpret=interpret,
+            precision=precision,
         )
     elif engine == "merge":
         block_q = block_q or 256

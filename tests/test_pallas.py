@@ -168,13 +168,22 @@ class TestPallasKernel:
                 idx, order, err_msg=f"trial {trial}: n={n} q={q} d={d} k={k}"
             )
 
-    def test_stripe_rejects_fast_precision(self, rng):
-        train_x, train_y, test_x, c = _int_grid_problem(rng, n=64, q=8, d=4)
-        with pytest.raises(ValueError, match="exact"):
-            predict_pallas(
-                train_x, train_y, test_x, 1, c,
-                interpret=True, engine="stripe", precision="fast",
-            )
+    @pytest.mark.parametrize("precision", ["fast", "bf16"])
+    def test_stripe_mxu_forms_match_oracle_on_01_grid(self, rng, precision):
+        # 0/1 features: the matmul expansion and bf16 casts are exact, so the
+        # stripe kernel's MXU distance modes must match the oracle bit-for-bit.
+        train_x = rng.integers(0, 2, (300, 33)).astype(np.float32)
+        train_y = rng.integers(0, 6, 300).astype(np.int32)
+        test_x = np.concatenate(
+            [train_x[:16], rng.integers(0, 2, (16, 33)).astype(np.float32)]
+        )
+        want = knn_oracle(train_x, train_y, test_x, 5, 6)
+        got = predict_pallas(
+            train_x, train_y, test_x, 5, 6,
+            block_q=32, block_n=128, interpret=True,
+            engine="stripe", precision=precision,
+        )
+        np.testing.assert_array_equal(got, want)
 
     def test_backend_registered(self, small):
         from knn_tpu.models.knn import KNNClassifier

@@ -1,0 +1,65 @@
+"""Within-radius retrieval (fixed-shape masked formulation, a framework
+extension; the reference has no retrieval API at all)."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor, radius_neighbors_arrays
+
+
+def _problem(rng, n=250, q=30, d=4):
+    train_x = rng.uniform(0, 10, (n, d)).astype(np.float32)
+    test_x = rng.uniform(0, 10, (q, d)).astype(np.float32)
+    return train_x, test_x
+
+
+class TestRadiusNeighbors:
+    def test_matches_bruteforce_sets(self, rng):
+        train_x, test_x = _problem(rng)
+        radius = 6.0  # squared-distance radius
+        d, i, mask = radius_neighbors_arrays(train_x, test_x, radius, 64)
+        bf = ((test_x[:, None, :] - train_x[None, :, :]) ** 2).sum(-1)
+        for row in range(test_x.shape[0]):
+            want = set(np.nonzero(bf[row] <= radius)[0].tolist())
+            got = set(i[row][mask[row]].tolist())
+            assert got == want, f"row {row}"
+        # Candidates come back sorted ascending by distance (inf-padded rows
+        # compare equal, so restrict to pairs with a finite left element).
+        left, right = d[:, :-1], d[:, 1:]
+        finite = np.isfinite(left)
+        assert (left[finite] <= right[finite]).all()
+
+    def test_truncation_raises(self, rng):
+        train_x, test_x = _problem(rng, n=100)
+        with pytest.raises(ValueError, match="raise max_neighbors"):
+            radius_neighbors_arrays(train_x, test_x, np.inf, max_neighbors=8)
+
+    def test_max_neighbors_at_n_never_truncates(self, rng):
+        train_x, test_x = _problem(rng, n=40, q=5)
+        d, i, mask = radius_neighbors_arrays(train_x, test_x, np.inf, 40)
+        assert mask.all()
+
+    def test_model_methods(self, rng):
+        train_x, test_x = _problem(rng, n=60, q=8)
+        train = Dataset(
+            train_x, np.zeros(60, np.int32),
+            raw_targets=rng.normal(size=60).astype(np.float32),
+        )
+        test = Dataset(test_x, np.zeros(8, np.int32))
+        for model in (KNNClassifier(k=1).fit(train), KNNRegressor(k=1).fit(train)):
+            d, i, mask = model.radius_neighbors(test, 3.0, max_neighbors=60)
+            assert d.shape == i.shape == mask.shape == (8, 60)
+
+    def test_metric_respected(self, rng):
+        train_x = np.array([[0.0, 0.0], [2.0, 2.0]], np.float32)
+        test_x = np.array([[1.0, 1.0]], np.float32)
+        # manhattan distances: 2 and 2; euclidean squared: 2 and 2. chebyshev: 1, 1.
+        _, _, mask_c = radius_neighbors_arrays(
+            train_x, test_x, 1.0, 2, metric="chebyshev"
+        )
+        assert mask_c.sum() == 2
+        _, _, mask_m = radius_neighbors_arrays(
+            train_x, test_x, 1.0, 2, metric="manhattan"
+        )
+        assert mask_m.sum() == 0
