@@ -206,6 +206,49 @@ knn_forward_candidates = jax.jit(
 _FULL_MATRIX_CELL_LIMIT = 16 * 1024 * 1024
 
 
+def _predict_query_batched(
+    train_x, train_y, test_x, k, num_classes, *,
+    precision, query_tile, train_tile, force_tiled, approx, query_batch,
+):
+    """Stream queries in fixed ``query_batch`` chunks (last chunk padded so
+    one compiled shape serves every dispatch). All chunks are enqueued
+    asynchronously before any result is fetched — the device pipelines
+    compute while the host pads the next chunk, the streaming analogue of
+    how the pthread backend keeps every worker busy on its query range."""
+    q = test_x.shape[0]
+    n = train_x.shape[0]
+    train_tile = max(train_tile, k)
+    use_full = not force_tiled and query_batch * n <= _FULL_MATRIX_CELL_LIMIT
+    if use_full or approx:
+        tx, ty = jnp.asarray(train_x), jnp.asarray(train_y)
+    else:
+        txp, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
+        typ, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
+        tx, ty = jnp.asarray(txp), jnp.asarray(typ)
+        nv = jnp.asarray(n, jnp.int32)
+
+    outs = []
+    for s in range(0, q, query_batch):
+        chunk = test_x[s : s + query_batch]
+        if chunk.shape[0] < query_batch:  # pad: one shape, one executable
+            chunk = np.pad(chunk, ((0, query_batch - chunk.shape[0]), (0, 0)))
+        if use_full or approx:
+            outs.append(knn_forward(
+                tx, ty, jnp.asarray(chunk), k=k, num_classes=num_classes,
+                precision=precision, approx=approx,
+            ))
+        else:
+            qp, _ = pad_axis_to_multiple(chunk, query_tile, axis=0)
+            outs.append(knn_forward_tiled(
+                tx, ty, jnp.asarray(qp), nv,
+                k=k, num_classes=num_classes, precision=precision,
+                query_tile=query_tile, train_tile=train_tile,
+            ))
+    # Each chunk's device output may carry tile padding beyond query_batch;
+    # trim per chunk so concatenation preserves global query order.
+    return np.concatenate([np.asarray(o)[:query_batch] for o in outs])[:q]
+
+
 def predict_arrays(
     train_x: np.ndarray,
     train_y: np.ndarray,
@@ -218,14 +261,26 @@ def predict_arrays(
     force_tiled: bool = False,
     approx: bool = False,
     metric: str = "euclidean",
+    query_batch: "int | None" = None,
 ) -> np.ndarray:
     """Host-side entry: pads, dispatches to the right compiled path, unpads.
     ``approx`` (full-matrix path only) uses TPU hardware approximate top-k.
     ``metric`` selects the distance (euclidean honors ``precision`` forms —
-    ops/distance.py::resolve_form)."""
+    ops/distance.py::resolve_form). ``query_batch`` streams the query set
+    through the device in fixed-size host chunks — bounded device memory for
+    query sets far larger than HBM, with all chunks dispatched before the
+    first result is pulled so transfers overlap compute."""
     precision = resolve_form(precision, metric)
     q = test_x.shape[0]
     n = train_x.shape[0]
+    if query_batch is not None and query_batch < 1:
+        raise ValueError(f"query_batch must be >= 1, got {query_batch}")
+    if query_batch is not None and q > query_batch:
+        return _predict_query_batched(
+            train_x, train_y, test_x, k, num_classes,
+            precision=precision, query_tile=query_tile, train_tile=train_tile,
+            force_tiled=force_tiled, approx=approx, query_batch=query_batch,
+        )
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
         out = knn_forward(
             jnp.asarray(train_x), jnp.asarray(train_y), jnp.asarray(test_x),
@@ -257,6 +312,7 @@ def predict(
     force_tiled: bool = False,
     approx: bool = False,
     metric: str = "euclidean",
+    query_batch: "int | None" = None,
     **_unused,
 ) -> np.ndarray:
     train.validate_for_knn(k, test)
@@ -264,4 +320,5 @@ def predict(
         train.features, train.labels, test.features, k, train.num_classes,
         precision=precision, query_tile=query_tile, train_tile=train_tile,
         force_tiled=force_tiled, approx=approx, metric=metric,
+        query_batch=query_batch,
     )

@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--query-tile", type=int, default=256)
     p.add_argument("--train-tile", type=int, default=2048)
+    p.add_argument("--query-batch", type=int, default=None,
+                   help="stream queries through the device in chunks of this "
+                   "size (bounds device memory for huge query sets)")
     p.add_argument("--devices", type=int, default=None,
                    help="device count for sharded backends (default: all)")
     p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
@@ -133,6 +136,8 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
     )
     if args.metric != "euclidean":
         opts["metric"] = args.metric
+    if args.query_batch is not None:
+        opts["query_batch"] = args.query_batch
     if args.precision != "auto":
         opts["precision"] = args.precision
     if args.approx:

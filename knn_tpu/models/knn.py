@@ -251,16 +251,20 @@ class KNNRegressor:
             raise RuntimeError("call fit() before predict()/score()")
         return self._train
 
-    def radius_neighbors(
-        self, test: Dataset, radius: float, max_neighbors: int = 128
-    ):
-        """Within-radius retrieval — see :func:`radius_neighbors_arrays`."""
+    def _check_features(self, test: Dataset) -> Dataset:
         train = self.train_
         if test.num_features != train.num_features:
             raise ValueError(
                 f"train has {train.num_features} features but test has "
                 f"{test.num_features}"
             )
+        return train
+
+    def radius_neighbors(
+        self, test: Dataset, radius: float, max_neighbors: int = 128
+    ):
+        """Within-radius retrieval — see :func:`radius_neighbors_arrays`."""
+        train = self._check_features(test)
         return radius_neighbors_arrays(
             train.features, test.features, radius, max_neighbors, self.metric
         )
@@ -268,12 +272,7 @@ class KNNRegressor:
     def kneighbors(self, test: Dataset):
         """Same candidate kernel as the classifier, without its label
         validation (regression targets may be negative/non-integer)."""
-        train = self.train_
-        if test.num_features != train.num_features:
-            raise ValueError(
-                f"train has {train.num_features} features but test has "
-                f"{test.num_features}"
-            )
+        train = self._check_features(test)
         return _kneighbors_arrays(
             train.features, test.features, self.k, metric=self.metric
         )

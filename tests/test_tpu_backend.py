@@ -138,3 +138,48 @@ class TestApproxTopK:
         )
         assert rc == 0
         assert "The 5-NN classifier for 80 test instances" in buf.getvalue()
+
+
+class TestQueryBatching:
+    """Host-side query streaming: batched must equal unbatched bit-for-bit,
+    including a ragged last chunk and both compiled paths."""
+
+    def test_batched_equals_unbatched_full_matrix(self, rng):
+        from knn_tpu.backends.tpu import predict_arrays
+
+        train_x = rng.integers(0, 4, (300, 6)).astype(np.float32)
+        train_y = rng.integers(0, 7, 300).astype(np.int32)
+        test_x = rng.integers(0, 4, (157, 6)).astype(np.float32)  # ragged vs 64
+        want = predict_arrays(train_x, train_y, test_x, 3, 7)
+        got = predict_arrays(
+            train_x, train_y, test_x, 3, 7, query_batch=64
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_batched_equals_unbatched_tiled(self, rng):
+        from knn_tpu.backends.tpu import predict_arrays
+
+        train_x = rng.integers(0, 4, (500, 5)).astype(np.float32)
+        train_y = rng.integers(0, 5, 500).astype(np.int32)
+        test_x = rng.integers(0, 4, (97, 5)).astype(np.float32)
+        want = predict_arrays(
+            train_x, train_y, test_x, 4, 5, force_tiled=True,
+            query_tile=32, train_tile=128,
+        )
+        got = predict_arrays(
+            train_x, train_y, test_x, 4, 5, force_tiled=True,
+            query_tile=32, train_tile=128, query_batch=40,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_cli_flag(self, small_paths):
+        import io
+
+        from knn_tpu.cli import run
+
+        train_p, test_p = small_paths
+        out = io.StringIO()
+        rc = run([train_p, test_p, "1", "--query-batch", "32",
+                  "--platform", "cpu"], stdout=out)
+        assert rc == 0
+        assert "80 test instances" in out.getvalue()
