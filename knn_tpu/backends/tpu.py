@@ -211,10 +211,12 @@ def _predict_query_batched(
     precision, query_tile, train_tile, force_tiled, approx, query_batch,
 ):
     """Stream queries in fixed ``query_batch`` chunks (last chunk padded so
-    one compiled shape serves every dispatch). All chunks are enqueued
-    asynchronously before any result is fetched — the device pipelines
-    compute while the host pads the next chunk, the streaming analogue of
-    how the pthread backend keeps every worker busy on its query range."""
+    one compiled shape serves every dispatch). A small in-flight window of
+    dispatched chunks keeps the device pipeline full while bounding device
+    memory — only ``window`` chunk inputs/outputs are resident at once, so
+    the query set can exceed HBM; fetching a result retires its buffers.
+    The streaming analogue of how the pthread backend keeps every worker
+    busy on its query range."""
     q = test_x.shape[0]
     n = train_x.shape[0]
     train_tile = max(train_tile, k)
@@ -227,26 +229,36 @@ def _predict_query_batched(
         tx, ty = jnp.asarray(txp), jnp.asarray(typ)
         nv = jnp.asarray(n, jnp.int32)
 
-    outs = []
+    window = 4  # in-flight dispatches: enough to pipeline, bounds residency
+    pending: list = []
+    results: list = []
+
+    def drain_one():
+        # Fetching frees our reference to the device buffers; trim tile
+        # padding per chunk so concatenation preserves global query order.
+        results.append(np.asarray(pending.pop(0))[:query_batch])
+
     for s in range(0, q, query_batch):
         chunk = test_x[s : s + query_batch]
         if chunk.shape[0] < query_batch:  # pad: one shape, one executable
             chunk = np.pad(chunk, ((0, query_batch - chunk.shape[0]), (0, 0)))
         if use_full or approx:
-            outs.append(knn_forward(
+            pending.append(knn_forward(
                 tx, ty, jnp.asarray(chunk), k=k, num_classes=num_classes,
                 precision=precision, approx=approx,
             ))
         else:
             qp, _ = pad_axis_to_multiple(chunk, query_tile, axis=0)
-            outs.append(knn_forward_tiled(
+            pending.append(knn_forward_tiled(
                 tx, ty, jnp.asarray(qp), nv,
                 k=k, num_classes=num_classes, precision=precision,
                 query_tile=query_tile, train_tile=train_tile,
             ))
-    # Each chunk's device output may carry tile padding beyond query_batch;
-    # trim per chunk so concatenation preserves global query order.
-    return np.concatenate([np.asarray(o)[:query_batch] for o in outs])[:q]
+        if len(pending) > window:
+            drain_one()
+    while pending:
+        drain_one()
+    return np.concatenate(results)[:q]
 
 
 def predict_arrays(

@@ -359,12 +359,10 @@ def knn_pallas_stripe_candidates(
         interpret=interpret,
     )(jnp.asarray(n_valid, jnp.int32).reshape(1), test_x, train_xT)
 
-    # Final 128·k -> k merge: one lexicographic (distance, index) sort per
-    # query — the framework's single tie-break rule (ops/topk.py).
-    d_sorted, i_sorted = jax.lax.sort(
-        (cand_d, cand_i), dimension=-1, num_keys=2
-    )
-    return d_sorted[:, :k], i_sorted[:, :k]
+    # Final 128·k -> k merge in XLA. k rounds of lexicographic (distance,
+    # index) min-extraction — same tie order as a two-key sort but ~2x
+    # cheaper at small k (no full sort of 128k columns).
+    return _merge_topk_rounds(cand_d, cand_i, k)
 
 
 def stripe_prepare_train(

@@ -38,8 +38,11 @@ def sort_candidates_labeled(
     dists: jnp.ndarray, idx: jnp.ndarray, labels: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Sort (distance, global-index, label) triples lexicographically by
-    (distance, index) along the last axis — the single definition of the
-    tie-break rule every merging path shares."""
+    (distance, index) along the last axis — the tie-break rule every merging
+    path shares. Two sanctioned realizations exist: this two-key sort, and
+    ``ops/pallas_knn._merge_topk_rounds`` (k rounds of min-extraction over
+    the same keys — cheaper when only the k best are needed). Any change to
+    the tie semantics must update both."""
     return lax.sort((dists, idx, labels), dimension=-1, num_keys=2)
 
 
