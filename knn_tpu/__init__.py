@@ -17,7 +17,9 @@ datasets) as a JAX / XLA / shard_map / Pallas framework:
 - ``knn_tpu.parallel``  — multi-device strategies over a ``jax.sharding.Mesh``:
   query-sharded (the MPI analogue), train-sharded with all-gather top-k merge,
   and a ring schedule (ring-attention structure with top-k accumulation).
-- ``knn_tpu.models``    — the high-level ``KNNClassifier`` API.
+- ``knn_tpu.models``    — the high-level ``KNNClassifier`` / ``KNNRegressor``
+  APIs (kneighbors / radius_neighbors retrieval, uniform or inverse-distance
+  weighting, pluggable metric).
 - ``knn_tpu.utils``     — timing, padding, evaluation, output formatting.
 
 The behavioral contract (SURVEY.md §3.5) is preserved exactly: squared

@@ -152,6 +152,7 @@ class TestFuzzDifferential:
                 nat.features, py.features, err_msg=p.read_text()
             )
             np.testing.assert_array_equal(nat.labels, py.labels)
+            np.testing.assert_array_equal(nat.raw_targets, py.raw_targets)
             assert nat.relation == py.relation, p.read_text()
             assert [(a.name, a.type, a.nominal_values) for a in nat.attributes] == \
                 [(a.name, a.type, a.nominal_values) for a in py.attributes]
