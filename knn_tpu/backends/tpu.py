@@ -274,17 +274,29 @@ def predict_arrays(
     approx: bool = False,
     metric: str = "euclidean",
     query_batch: "int | None" = None,
+    engine: str = "auto",
 ) -> np.ndarray:
     """Host-side entry: pads, dispatches to the right compiled path, unpads.
     ``approx`` (full-matrix path only) uses TPU hardware approximate top-k.
     ``metric`` selects the distance (euclidean honors ``precision`` forms —
     ops/distance.py::resolve_form). ``query_batch`` streams the query set
     through the device in fixed-size host chunks — bounded device memory for
-    query sets far larger than HBM, with all chunks dispatched before the
-    first result is pulled so transfers overlap compute."""
+    query sets far larger than HBM, with a fixed in-flight dispatch window so
+    transfers overlap compute (the chunked path always uses the XLA kernels).
+    ``engine``: "auto" (default) hands exact euclidean narrow-feature problems
+    on a real TPU to the lane-striped Pallas kernel (~2.5x the XLA
+    formulations — docs/KERNELS.md); "stripe" forces that kernel (interpreted
+    off-TPU, so it is testable anywhere); "xla" keeps the jit
+    full-matrix/tiled paths."""
+    if engine not in ("auto", "stripe", "xla"):
+        raise ValueError(
+            f"unknown engine {engine!r}; choose 'auto', 'stripe', or 'xla'"
+        )
     precision = resolve_form(precision, metric)
     q = test_x.shape[0]
     n = train_x.shape[0]
+    if q == 0:
+        return np.empty(0, np.int32)
     if query_batch is not None and query_batch < 1:
         raise ValueError(f"query_batch must be >= 1, got {query_batch}")
     if query_batch is not None and q > query_batch:
@@ -292,6 +304,23 @@ def predict_arrays(
             train_x, train_y, test_x, k, num_classes,
             precision=precision, query_tile=query_tile, train_tile=train_tile,
             force_tiled=force_tiled, approx=approx, query_batch=query_batch,
+        )
+    # Same eligibility rule as predict_pallas's engine auto-selection
+    # (docs/KERNELS.md): exact, narrow features, small k.
+    if engine == "stripe" or (
+        engine == "auto"
+        and not approx
+        and not force_tiled
+        and metric == "euclidean"
+        and precision == "exact"
+        and train_x.shape[1] <= 64
+        and k <= 16
+        and jax.default_backend() == "tpu"
+    ):
+        from knn_tpu.ops.pallas_knn import stripe_classify_arrays
+
+        return stripe_classify_arrays(
+            train_x, train_y, test_x, k, num_classes, precision=precision,
         )
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
         out = knn_forward(
@@ -325,6 +354,7 @@ def predict(
     approx: bool = False,
     metric: str = "euclidean",
     query_batch: "int | None" = None,
+    engine: str = "auto",
     **_unused,
 ) -> np.ndarray:
     train.validate_for_knn(k, test)
@@ -332,5 +362,5 @@ def predict(
         train.features, train.labels, test.features, k, train.num_classes,
         precision=precision, query_tile=query_tile, train_tile=train_tile,
         force_tiled=force_tiled, approx=approx, metric=metric,
-        query_batch=query_batch,
+        query_batch=query_batch, engine=engine,
     )

@@ -458,6 +458,37 @@ def knn_stripe_classify(
     return vote(train_y[safe], num_classes)
 
 
+def stripe_classify_arrays(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> np.ndarray:
+    """Host entry for a full stripe-kernel classify: resolves block sizes,
+    lays out the inputs, runs the fused classify jit, trims padding. The
+    single definition of the stripe host plumbing (the tpu backend's auto
+    dispatch and the bench share it). ``interpret`` defaults to on for
+    non-TPU platforms so the same path is testable on CPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q = test_x.shape[0]
+    block_q, block_n = stripe_block_sizes(block_q, block_n, q)
+    txT, d_pad = stripe_prepare_train(train_x, block_n)
+    qx = stripe_prepare_queries(test_x, block_q, d_pad)
+    out = knn_stripe_classify(
+        jnp.asarray(txT), jnp.asarray(train_y), jnp.asarray(qx),
+        jnp.asarray(train_x.shape[0], jnp.int32), k=k, num_classes=num_classes,
+        block_q=block_q, block_n=block_n, d_true=train_x.shape[1],
+        interpret=interpret, precision=precision,
+    )
+    return np.asarray(out)[:q]
+
+
 def predict_pallas(
     train_x: np.ndarray,
     train_y: np.ndarray,

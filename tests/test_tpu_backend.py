@@ -183,3 +183,38 @@ class TestQueryBatching:
                   "--platform", "cpu"], stdout=out)
         assert rc == 0
         assert "80 test instances" in out.getvalue()
+
+
+class TestEngineDispatch:
+    def test_stripe_engine_matches_oracle_off_tpu(self, rng):
+        # engine="stripe" forces the lane-striped Pallas kernel (interpreted
+        # on CPU), exercising the same dispatch the TPU auto path takes.
+        from knn_tpu.backends.oracle import knn_oracle
+        from knn_tpu.backends.tpu import predict_arrays
+
+        train_x = rng.integers(0, 4, (200, 6)).astype(np.float32)
+        train_y = rng.integers(0, 5, 200).astype(np.int32)
+        test_x = np.concatenate(
+            [train_x[:20], rng.integers(0, 4, (21, 6)).astype(np.float32)]
+        )
+        want = knn_oracle(train_x, train_y, test_x, 3, 5)
+        got = predict_arrays(train_x, train_y, test_x, 3, 5, engine="stripe")
+        np.testing.assert_array_equal(got, want)
+
+    def test_unknown_engine_rejected(self, rng):
+        from knn_tpu.backends.tpu import predict_arrays
+
+        with pytest.raises(ValueError, match="unknown engine"):
+            predict_arrays(
+                np.zeros((4, 2), np.float32), np.zeros(4, np.int32),
+                np.zeros((2, 2), np.float32), 1, 2, engine="Stripe",
+            )
+
+    def test_empty_query_set(self):
+        from knn_tpu.backends.tpu import predict_arrays
+
+        out = predict_arrays(
+            np.zeros((4, 2), np.float32), np.zeros(4, np.int32),
+            np.zeros((0, 2), np.float32), 1, 2,
+        )
+        assert out.shape == (0,) and out.dtype == np.int32
