@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu test bench parity clean
+.PHONY: all native main multi-thread mpi tpu test bench parity device-parity clean
 
 all: native main multi-thread mpi tpu
 
@@ -49,6 +49,9 @@ bench:
 
 parity:
 	python3 scripts/parity_report.py
+
+device-parity:
+	python3 scripts/device_parity_sweep.py
 
 clean:
 	rm -rf $(LIB_DIR) main multi-thread mpi tpu build/fixtures

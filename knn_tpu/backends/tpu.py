@@ -299,6 +299,20 @@ def predict_arrays(
         return np.empty(0, np.int32)
     if query_batch is not None and query_batch < 1:
         raise ValueError(f"query_batch must be >= 1, got {query_batch}")
+    if engine == "stripe":
+        # Forced stripe: reject options the kernel cannot honor rather than
+        # silently computing something else; its host entry chunks queries
+        # itself (query_batch caps the chunk size).
+        if metric != "euclidean":
+            raise ValueError("the stripe engine implements euclidean only")
+        if approx or force_tiled:
+            raise ValueError("engine='stripe' is incompatible with approx/force_tiled")
+        from knn_tpu.ops.pallas_knn import stripe_classify_arrays
+
+        return stripe_classify_arrays(
+            train_x, train_y, test_x, k, num_classes, precision=precision,
+            max_rows=query_batch,
+        )
     if query_batch is not None and q > query_batch:
         return _predict_query_batched(
             train_x, train_y, test_x, k, num_classes,
@@ -307,7 +321,7 @@ def predict_arrays(
         )
     # Same eligibility rule as predict_pallas's engine auto-selection
     # (docs/KERNELS.md): exact, narrow features, small k.
-    if engine == "stripe" or (
+    if (
         engine == "auto"
         and not approx
         and not force_tiled

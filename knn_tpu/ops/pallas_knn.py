@@ -390,13 +390,17 @@ def stripe_prepare_queries(
 
 
 def stripe_block_sizes(
-    block_q: Optional[int], block_n: Optional[int], q: int
+    block_q: Optional[int], block_n: Optional[int], q: int, k: int = 5
 ) -> Tuple[int, int]:
     """Resolve stripe block sizes: defaults tuned on v5e (448, 2048), block_n
     rounded to the 128-lane multiple the kernel requires, block_q clipped so
-    one tile covers small query sets."""
+    one tile covers small query sets and scaled down with ``k`` so the
+    candidate scratch (``2 x [block_q, 128k]``) stays within VMEM."""
     block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
-    block_q = min(block_q or 448, ((q + 7) // 8) * 8)
+    if block_q is None:
+        # scratch bytes ~= block_q * 128k * 8; keep under ~3.5 MB.
+        block_q = min(448, max(8, (3_500_000 // (128 * k * 8)) // 8 * 8))
+    block_q = min(block_q, ((q + 7) // 8) * 8)
     return block_q, block_n
 
 
@@ -413,7 +417,7 @@ def stripe_candidates_arrays(
     train transposition, returns unpadded ``([Q,k] dists, [Q,k] indices)``."""
     n, d_true = train_x.shape
     q = test_x.shape[0]
-    block_q, block_n = stripe_block_sizes(block_q, block_n, q)
+    block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
     txT, d_pad = stripe_prepare_train(train_x, block_n)
     qx = stripe_prepare_queries(test_x, block_q, d_pad)
     d, idx = knn_pallas_stripe_candidates(
@@ -468,25 +472,54 @@ def stripe_classify_arrays(
     block_q: Optional[int] = None,
     block_n: Optional[int] = None,
     interpret: Optional[bool] = None,
+    max_rows: Optional[int] = None,
 ) -> np.ndarray:
-    """Host entry for a full stripe-kernel classify: resolves block sizes,
-    lays out the inputs, runs the fused classify jit, trims padding. The
-    single definition of the stripe host plumbing (the tpu backend's auto
-    dispatch and the bench share it). ``interpret`` defaults to on for
-    non-TPU platforms so the same path is testable on CPU."""
+    """Host entry for a full stripe-kernel classify: resolves k-aware block
+    sizes, lays out the inputs, runs the fused classify jit in bounded
+    chunks, trims padding — the single definition of the stripe host
+    dispatch (the tpu backend routes here; the bench scripts drive the raw
+    jit directly for pipelined timing). ``interpret`` defaults to on for
+    non-TPU platforms so the same path is testable on CPU; ``max_rows``
+    caps the per-call query rows (e.g. a caller's query_batch)."""
+    if precision not in ("exact", "fast", "bf16"):
+        raise ValueError(
+            f"unknown precision {precision!r}; choose exact, fast, or bf16"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     q = test_x.shape[0]
-    block_q, block_n = stripe_block_sizes(block_q, block_n, q)
+    if q == 0:
+        return np.empty(0, np.int32)
+    block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
     txT, d_pad = stripe_prepare_train(train_x, block_n)
-    qx = stripe_prepare_queries(test_x, block_q, d_pad)
-    out = knn_stripe_classify(
-        jnp.asarray(txT), jnp.asarray(train_y), jnp.asarray(qx),
-        jnp.asarray(train_x.shape[0], jnp.int32), k=k, num_classes=num_classes,
-        block_q=block_q, block_n=block_n, d_true=train_x.shape[1],
-        interpret=interpret, precision=precision,
-    )
-    return np.asarray(out)[:q]
+    tyj = jnp.asarray(train_y)
+    txTj = jnp.asarray(txT)
+    nv = jnp.asarray(train_x.shape[0], jnp.int32)
+    # Chunk calls so each [rows, 128k] candidate buffer stays small: XLA can
+    # place the kernel outputs in VMEM (observed at k>8), and an unchunked
+    # [Q_pad, 128k] output there blows the scoped limit.
+    auto_rows = max(block_q, (4 << 20) // (128 * k * 8) // block_q * block_q)
+    rows = min(auto_rows, max(block_q, max_rows)) if max_rows else auto_rows
+    window = 4  # in-flight dispatches: pipelines compute, bounds residency
+    pending, sizes, results = [], [], []
+
+    def drain_one():
+        results.append(np.asarray(pending.pop(0))[: sizes.pop(0)])
+
+    for s0 in range(0, q, rows):
+        chunk = test_x[s0 : s0 + rows]
+        qx = stripe_prepare_queries(chunk, block_q, d_pad)
+        pending.append(knn_stripe_classify(
+            txTj, tyj, jnp.asarray(qx), nv, k=k, num_classes=num_classes,
+            block_q=block_q, block_n=block_n, d_true=train_x.shape[1],
+            interpret=interpret, precision=precision,
+        ))
+        sizes.append(chunk.shape[0])
+        if len(pending) > window:
+            drain_one()
+    while pending:
+        drain_one()
+    return np.concatenate(results)
 
 
 def predict_pallas(

@@ -1,0 +1,76 @@
+"""Randomized prediction-parity sweep on the real device.
+
+The CPU test suite runs the Pallas kernels in interpret mode; this script
+hammers the actual Mosaic-compiled kernels (and the XLA paths) with random
+problems — integer grids for tie density, random shapes straddling every
+padding boundary, k up to the stripe limit — and asserts bit-exact prediction
+equality against the NumPy oracle.
+
+Usage: python scripts/device_parity_sweep.py [trials]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(trials: int = 30) -> int:
+    import jax
+
+    from knn_tpu.backends.oracle import knn_oracle
+    from knn_tpu.backends.tpu import predict_arrays
+    from knn_tpu.ops.pallas_knn import predict_pallas
+
+    print(f"device: {jax.devices()[0].device_kind}", file=sys.stderr)
+    rng = np.random.default_rng(20260730)
+    failures = 0
+    for t in range(trials):
+        n = int(rng.integers(3, 6000))
+        q = int(rng.integers(1, 700))
+        d = int(rng.integers(1, 33))
+        k = int(rng.integers(1, min(n, 16) + 1))
+        c = int(rng.integers(2, 11))
+        hi = int(rng.integers(2, 6))  # small grid => dist==0 ties abound
+        train_x = rng.integers(0, hi, (n, d)).astype(np.float32)
+        train_y = rng.integers(0, c, n).astype(np.int32)
+        dup = min(q // 2, n)
+        test_x = np.concatenate([
+            train_x[rng.choice(n, dup, replace=False)] if dup else
+            np.empty((0, d), np.float32),
+            rng.integers(0, hi, (q - dup, d)).astype(np.float32),
+        ])
+        want = knn_oracle(train_x, train_y, test_x, k, c)
+
+        paths = {
+            "tpu-auto": lambda: predict_arrays(train_x, train_y, test_x, k, c),
+            "tpu-xla": lambda: predict_arrays(
+                train_x, train_y, test_x, k, c, engine="xla"),
+            "tpu-tiled": lambda: predict_arrays(
+                train_x, train_y, test_x, k, c, force_tiled=True,
+                query_tile=64, train_tile=256, engine="xla"),
+            "pallas-merge": lambda: predict_pallas(
+                train_x, train_y, test_x, k, c, engine="merge",
+                block_q=64, block_n=256, interpret=False),
+        }
+        for name, fn in paths.items():
+            got = fn()
+            if not np.array_equal(got, want):
+                failures += 1
+                bad = int((got != want).sum())
+                print(f"FAIL trial {t} [{name}]: n={n} q={q} d={d} k={k} "
+                      f"c={c} hi={hi} ({bad}/{q} mismatches)")
+        if (t + 1) % 10 == 0:
+            print(f"{t + 1}/{trials} trials clean", file=sys.stderr)
+    print("device parity sweep:",
+          f"{trials} trials x {len(paths)} paths",
+          "ALL EXACT" if failures == 0 else f"{failures} FAILURES")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 30))
