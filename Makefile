@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu test bench parity device-parity clean
+.PHONY: all native main multi-thread mpi tpu test bench parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu
 
@@ -52,6 +52,9 @@ parity:
 
 device-parity:
 	python3 scripts/device_parity_sweep.py
+
+ref-diff:
+	python3 scripts/reference_differential.py
 
 clean:
 	rm -rf $(LIB_DIR) main multi-thread mpi tpu build/fixtures

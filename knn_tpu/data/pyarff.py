@@ -9,7 +9,11 @@ Dialect (SURVEY.md §3.4, libarff/arff_parser.cpp:23-153, arff_lexer.cpp:60-203)
   (arff_parser.cpp:69-119). INTEGER is additionally accepted as numeric.
 - ``%``-comment lines (arff_lexer.cpp:60-78).
 - Single- or double-quoted values, which may contain spaces/commas
-  (arff_lexer.cpp:159-188).
+  (arff_lexer.cpp:159-188). Deliberate deviation: the reference's instance
+  reader silently drops every data row containing a quoted value (the
+  STRING-typed token breaks its row loop — verified against the built
+  reference binary, which reports 0 rows for ``'1','2'``); here quoted data
+  cells parse normally, with quoted content preserved verbatim.
 - ``?`` denotes a missing value (arff_parser.cpp:139-141) → NaN.
 - A partial row at EOF is discarded (arff_parser.cpp:130-133,149-151).
 - Sparse ARFF (``{index value, ...}`` rows) is NOT supported, matching the
@@ -113,46 +117,60 @@ class ArffError(ValueError):
 
 
 def _split_csv(line: str, path: str, lineno: int) -> list:
-    """Split a row on commas, honoring single/double quotes. Quoted content is
-    preserved verbatim (the reference lexer copies chars between quotes as-is,
-    arff_lexer.cpp:159-188 — ``' '`` is the one-space token, not empty); only
-    *unquoted* edge whitespace is trimmed."""
-    out = []
+    """Tokenize a data/nominal segment the way the reference lexer does:
+    unquoted whitespace and commas BOTH end a token (next_token skips
+    whitespace between tokens, arff_lexer.cpp:93-97; a comma terminates
+    ``_read_str``, :190), so ``1 2`` and ``1,2`` are the same two tokens and
+    several rows may share one physical line. Quoted content is preserved
+    verbatim (``' '`` is the one-space token, not empty). A comma with no
+    token since the previous comma yields an empty cell, which callers
+    reject — the reference silently truncates the dataset there
+    (arff_lexer.cpp:125-127), a defect replaced with a located error. A
+    comma directly after its token is that token's terminator, so a single
+    trailing comma is absorbed (``1,2,`` tokenizes like ``1,2``)."""
+    out: list = []
     buf: list = []
+    active = False            # a token is in progress
+    token_since_comma = False  # a completed token awaits its comma
     quote = None
-    first_q = None  # index range [first_q, last_q) of quoted chars in buf
-    last_q = 0
 
     def flush():
-        nonlocal buf, first_q, last_q
-        start, end = 0, len(buf)
-        fq = first_q if first_q is not None else end
-        while start < end and start < fq and buf[start] in " \t":
-            start += 1
-        while end > start and end > last_q and buf[end - 1] in " \t":
-            end -= 1
-        out.append("".join(buf[start:end]))
+        nonlocal buf, active, token_since_comma
+        out.append("".join(buf))
         buf = []
-        first_q, last_q = None, 0
+        active = False
+        token_since_comma = True
 
     for ch in line:
         if quote is not None:
             if ch == quote:
                 quote = None
             else:
-                if first_q is None:
-                    first_q = len(buf)
                 buf.append(ch)
-                last_q = len(buf)
-        elif ch in ("'", '"'):
+            continue
+        if ch in ("'", '"'):
             quote = ch
-        elif ch == ",":
-            flush()
-        else:
-            buf.append(ch)
+            active = True
+            continue
+        if ch in " \t":
+            if active:
+                flush()
+            continue
+        if ch == ",":
+            if active:
+                flush()
+                token_since_comma = False  # comma terminated its own token
+            elif token_since_comma:
+                token_since_comma = False  # separator for the flushed token
+            else:
+                out.append("")  # ",," or leading comma: empty cell
+            continue
+        active = True
+        buf.append(ch)
     if quote is not None:
         raise ArffError(path, lineno, "unterminated quoted value")
-    flush()
+    if active:
+        flush()
     return out
 
 
@@ -186,8 +204,6 @@ def _parse_attribute(rest: str, path: str, lineno: int) -> Attribute:
         # empty nominal set (reference: BRKT_CLOSE immediately ends the
         # value loop).
         values = [] if inner.strip(_WS) == "" else _split_csv(inner, path, lineno)
-        if values and values[-1] == "" and inner.rstrip(" \t").endswith(","):
-            values.pop()
         if any(v == "" for v in values):
             raise ArffError(path, lineno, "empty value in nominal list")
         return Attribute(name, "nominal", values)
@@ -237,8 +253,14 @@ def parse_arff_lines(
     pending: list = []  # cells carried across physical lines (multi-line rows)
 
     for lineno, raw in enumerate(lines, start=1):
+        # '%' starts a comment only at the true line start (the reference
+        # lexer skips comments only when '%' is the first character after a
+        # newline, arff_lexer.cpp:60-78); an indented or trailing '%' is
+        # DATA and typically a located type error downstream.
+        if raw.startswith("%"):
+            continue
         line = raw.strip(_WS)
-        if not line or line.startswith("%"):
+        if not line:
             continue
         if not in_data and line.startswith("@"):
             # ASCII space/tab separates the keyword — same set as the
@@ -272,36 +294,24 @@ def parse_arff_lines(
         if line.startswith("{"):
             raise ArffError(path, lineno, "sparse ARFF rows are not supported")
         cells = _split_csv(line, path, lineno)
-        # A *trailing* comma is absorbed — the reference lexer stops a token
-        # on the comma and next_token's unconditional advance consumes it
-        # (arff_lexer.cpp:93,190) — so "1,2," tokenizes exactly like "1,2"
-        # (commonly a row continued on the next physical line). But a comma
-        # at token-START position (a ",3" continuation line, or ",,"
-        # interior) makes _read_str return "" which lexes as a spurious
-        # END_OF_FILE (arff_lexer.cpp:125-127), silently truncating the
-        # dataset there — a defect we replace with a clean located error.
-        if cells and cells[-1] == "" and line.endswith(","):
-            cells.pop()
         if "" in cells:
             raise ArffError(path, lineno, "empty value in data row")
-        if pending:
-            cells = pending + cells
-            pending = []
-        # The reference's token-stream reader consumes exactly num_attributes
-        # tokens per instance regardless of line breaks (arff_parser.cpp:121-153);
-        # carry short rows forward rather than erroring immediately.
-        if len(cells) < len(attributes):
-            pending = cells
-            continue
-        if len(cells) > len(attributes):
-            raise ArffError(
-                path,
-                lineno,
-                f"row has {len(cells)} values but {len(attributes)} attributes declared",
-            )
-        rows.append(
-            [_cell_to_float(tok, attr, path, lineno) for tok, attr in zip(cells, attributes)]
-        )
+        # The reference's reader consumes exactly num_attributes tokens per
+        # instance from the @data token stream regardless of line breaks
+        # (arff_parser.cpp:121-153): rows may span physical lines AND several
+        # rows may share one line, so accumulate tokens and emit every full
+        # group of num_attributes.
+        pending.extend(cells)
+        d = len(attributes)
+        if len(pending) >= d:
+            off = 0
+            while len(pending) - off >= d:  # offset walk: no per-row reslice
+                rows.append(
+                    [_cell_to_float(tok, attr, path, lineno)
+                     for tok, attr in zip(pending[off : off + d], attributes)]
+                )
+                off += d
+            del pending[:off]
     # A partial row at EOF is discarded, matching arff_parser.cpp:130-133.
 
     if not attributes:

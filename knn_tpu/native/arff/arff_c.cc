@@ -63,50 +63,66 @@ std::string strip(const std::string& s) {
   return s.substr(b, e - b + 1);
 }
 
-// Split a line on commas honoring single/double quotes. Quoted content is
-// preserved verbatim (the reference lexer copies chars between quotes as-is,
-// arff_lexer.cpp:159-188 — "' '" is the one-space token, not empty); only
-// *unquoted* edge whitespace is trimmed.
+// Tokenize a data/nominal segment the way the reference lexer does:
+// unquoted whitespace and commas BOTH end a token (next_token skips
+// whitespace between tokens, arff_lexer.cpp:93-97; a comma terminates
+// _read_str, :190), so "1 2" and "1,2" are the same two tokens and several
+// rows may share one physical line. Quoted content is preserved verbatim.
+// A comma with no token since the previous comma yields an empty cell,
+// which callers reject — the reference silently truncates the dataset
+// there (arff_lexer.cpp:125-127), a defect replaced with a located error.
+// A comma directly after its token is that token's terminator, so a single
+// trailing comma is absorbed ("1,2," tokenizes like "1,2").
 bool split_csv(const std::string& line, std::vector<std::string>& out,
                ParseState& st) {
   out.clear();
   std::string buf;
+  bool active = false;             // a token is in progress
+  bool token_since_comma = false;  // a completed token awaits its comma
   char quote = 0;
-  size_t first_q = std::string::npos;  // [first_q, last_q) = quoted chars
-  size_t last_q = 0;
   auto flush = [&]() {
-    size_t b = 0, e = buf.size();
-    size_t fq = first_q == std::string::npos ? e : first_q;
-    while (b < e && b < fq && (buf[b] == ' ' || buf[b] == '\t')) ++b;
-    while (e > b && e > last_q && (buf[e - 1] == ' ' || buf[e - 1] == '\t'))
-      --e;
-    out.push_back(buf.substr(b, e - b));
+    out.push_back(buf);
     buf.clear();
-    first_q = std::string::npos;
-    last_q = 0;
+    active = false;
+    token_since_comma = true;
   };
   for (char ch : line) {
     if (quote) {
       if (ch == quote) {
         quote = 0;
       } else {
-        if (first_q == std::string::npos) first_q = buf.size();
         buf.push_back(ch);
-        last_q = buf.size();
       }
-    } else if (ch == '\'' || ch == '"') {
-      quote = ch;
-    } else if (ch == ',') {
-      flush();
-    } else {
-      buf.push_back(ch);
+      continue;
     }
+    if (ch == '\'' || ch == '"') {
+      quote = ch;
+      active = true;
+      continue;
+    }
+    if (ch == ' ' || ch == '\t') {
+      if (active) flush();
+      continue;
+    }
+    if (ch == ',') {
+      if (active) {
+        flush();
+        token_since_comma = false;  // comma terminated its own token
+      } else if (token_since_comma) {
+        token_since_comma = false;  // separator for the flushed token
+      } else {
+        out.push_back("");  // ",," or leading comma: empty cell
+      }
+      continue;
+    }
+    active = true;
+    buf.push_back(ch);
   }
   if (quote) {
     fail(st, "unterminated quoted value");
     return false;
   }
-  flush();
+  if (active) flush();
   return true;
 }
 
@@ -156,9 +172,6 @@ bool parse_attribute(const std::string& rest_in, ParseState& st) {
     if (!strip(inner).empty()) {
       if (!split_csv(inner, vals, st)) return false;
       size_t lp = inner.find_last_not_of(" \t");
-      if (!vals.empty() && vals.back().empty() && lp != std::string::npos &&
-          inner[lp] == ',')
-        vals.pop_back();
       for (const std::string& v : vals)
         if (v.empty()) {
           fail(st, "empty value in nominal list");
@@ -224,8 +237,11 @@ bool parse_buffer(const std::string& data, ParseState& st) {
                                               : data.substr(pos, nl - pos);
     pos = nl == std::string::npos ? data.size() + 1 : nl + 1;
     st.line++;
+    // '%' comments only at the true line start (arff_lexer.cpp:60-78);
+    // indented/trailing '%' is data and errors downstream on typed attrs.
+    if (!raw.empty() && raw[0] == '%') continue;
     std::string line = strip(raw);
-    if (line.empty() || line[0] == '%') continue;
+    if (line.empty()) continue;
     if (!in_data && line[0] == '@') {
       size_t sp = line.find_first_of(" \t");
       std::string word = sp == std::string::npos ? line : line.substr(0, sp);
@@ -259,41 +275,29 @@ bool parse_buffer(const std::string& data, ParseState& st) {
       return false;
     }
     if (!split_csv(line, cells, st)) return false;
-    // A *trailing* comma is absorbed — the reference lexer stops a token on
-    // the comma and next_token's unconditional advance consumes it
-    // (arff_lexer.cpp:93,190) — so "1,2," tokenizes like "1,2" (commonly a
-    // row continued on the next physical line). But a comma at token-START
-    // position (a ",3" continuation line, or ",," interior) makes _read_str
-    // return "" which lexes as a spurious END_OF_FILE
-    // (arff_lexer.cpp:125-127), silently truncating the dataset there — a
-    // defect replaced here with a clean located error.
-    if (!cells.empty() && cells.back().empty() && line.back() == ',')
-      cells.pop_back();
     for (const std::string& c : cells)
       if (c.empty()) {
         fail(st, "empty value in data row");
         return false;
       }
-    if (!pending.empty()) {
-      pending.insert(pending.end(), cells.begin(), cells.end());
-      cells.swap(pending);
-      pending.clear();
-    }
+    // The reference's reader consumes exactly num_attributes tokens per
+    // instance from the @data token stream regardless of line breaks
+    // (arff_parser.cpp:121-153): rows may span physical lines AND several
+    // rows may share one line, so accumulate tokens and emit every full
+    // group of num_attributes.
+    pending.insert(pending.end(), cells.begin(), cells.end());
     size_t d = st.attrs.size();
-    if (cells.size() < d) {
-      pending = cells;  // short row: carry forward (token-stream semantics)
-      continue;
+    size_t off = 0;  // offset walk: one erase per line, not per row
+    while (pending.size() - off >= d) {
+      for (size_t j = 0; j < d; ++j) {
+        float v;
+        if (!cell_to_float(pending[off + j], st.attrs[j], &v, st))
+          return false;
+        st.cells.push_back(v);
+      }
+      off += d;
     }
-    if (cells.size() > d) {
-      fail(st, "row has " + std::to_string(cells.size()) + " values but " +
-                   std::to_string(d) + " attributes declared");
-      return false;
-    }
-    for (size_t j = 0; j < d; ++j) {
-      float v;
-      if (!cell_to_float(cells[j], st.attrs[j], &v, st)) return false;
-      st.cells.push_back(v);
-    }
+    if (off) pending.erase(pending.begin(), pending.begin() + off);
   }
   // A partial row at EOF is discarded (arff_parser.cpp:130-133).
   if (st.attrs.empty()) {

@@ -1,0 +1,163 @@
+"""Differential harness against the REAL reference binary.
+
+Builds the reference's serial backend from the read-only checkout
+(/root/reference, or KNN_REFERENCE_DIR) into build/ref/, generates random
+ARFF train/test pairs — comma-, whitespace-, and multi-line-tokenized, with
+duplicate rows for dist==0 ties — and compares the complete canonical output
+line (instance counts AND accuracy) of the reference against this
+framework's oracle backend on the same files (the oracle is itself pinned
+prediction-equal to every other backend by tests/ and make parity, so its
+parity here transfers).
+
+This validates the two things file-level tests cannot: that the parser
+dialect matches the reference parser's on real inputs, and that the KNN
+contract (tie semantics included) matches the reference kernel's.
+
+Usage: python scripts/reference_differential.py [trials]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+REF_DIR = Path(os.environ.get("KNN_REFERENCE_DIR", "/root/reference"))
+REF_BIN = REPO / "build" / "ref" / "main"
+
+
+def build_reference() -> bool:
+    if REF_BIN.exists():
+        return True
+    if not (REF_DIR / "main.cpp").exists():
+        print("reference sources unavailable; skipping", file=sys.stderr)
+        return False
+    REF_BIN.parent.mkdir(parents=True, exist_ok=True)
+    srcs = [str(REF_DIR / "main.cpp")] + [
+        str(p) for p in sorted((REF_DIR / "libarff").glob("*.cpp"))
+    ]
+    proc = subprocess.run(
+        ["g++", "-O2", "-o", str(REF_BIN), *srcs, f"-I{REF_DIR}/libarff"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        print(f"reference build failed:\n{proc.stderr[:500]}", file=sys.stderr)
+        return False
+    return True
+
+
+def random_arff_pair(rng) -> tuple:
+    d = int(rng.integers(1, 8))  # features (class col added on top)
+    c = int(rng.integers(2, 6))
+    n = int(rng.integers(c, 200))
+    q = int(rng.integers(1, 40))
+    hi = int(rng.integers(2, 5))
+
+    def header():
+        lines = [f"@relation r{int(rng.integers(1e6))}"]
+        for j in range(d):
+            lines.append(f"@attribute a{j} NUMERIC")
+        lines.append("@attribute class NUMERIC")
+        lines.append("@data")
+        return lines
+
+    def rows(mat, labels):
+        out = []
+        i = 0
+        while i < len(mat):
+            cells = [fmt(v) for v in mat[i]] + [str(int(labels[i]))]
+            style = rng.random()
+            if style < 0.5:
+                out.append(",".join(cells))
+            elif style < 0.7:
+                out.append(" ".join(cells))  # whitespace-separated
+            elif style < 0.85 and len(cells) > 1:
+                cut = int(rng.integers(1, len(cells)))
+                out.append(",".join(cells[:cut]) + ",")  # row spans lines
+                out.append(",".join(cells[cut:]))
+            elif i + 1 < len(mat):
+                nxt = [fmt(v) for v in mat[i + 1]] + [str(int(labels[i + 1]))]
+                out.append(",".join(cells) + " " + ",".join(nxt))  # 2 rows/line
+                i += 1
+            else:
+                out.append(",".join(cells))
+            i += 1
+        return out
+
+    def fmt(v):
+        return str(int(v)) if float(v).is_integer() else f"{v:.6g}"
+
+    train_x = rng.integers(0, hi, (n, d)).astype(np.float32)
+    train_y = np.concatenate([np.arange(c), rng.integers(0, c, n - c)])
+    dup = min(q // 2, n)
+    test_x = np.concatenate([
+        train_x[rng.choice(n, dup, replace=False)] if dup else
+        np.empty((0, d), np.float32),
+        rng.integers(0, hi, (q - dup, d)).astype(np.float32),
+    ])
+    test_y = rng.integers(0, c, q)
+    train = "\n".join(header() + rows(train_x, train_y)) + "\n"
+    test = "\n".join(header() + rows(test_x, test_y)) + "\n"
+    return train, test, n, q
+
+
+_LINE = re.compile(
+    r"The (\d+)-NN classifier for (\d+) test instances on (\d+) train "
+    r"instances required \d+ ms CPU time. Accuracy was ([0-9.]+)"
+)
+
+
+def canonical(out: str):
+    m = _LINE.search(out)
+    return m.groups() if m else None
+
+
+def main(trials: int = 40) -> int:
+    if not build_reference():
+        return 0
+    rng = np.random.default_rng(314159)
+    failures = 0
+    for t in range(trials):
+        train_body, test_body, n, q = random_arff_pair(rng)
+        k = int(rng.integers(1, min(n, 8) + 1))
+        with tempfile.TemporaryDirectory(dir=REPO / "build") as td:
+            tr, te = Path(td) / "train.arff", Path(td) / "test.arff"
+            tr.write_text(train_body)
+            te.write_text(test_body)
+            ref = subprocess.run(
+                [str(REF_BIN), str(tr), str(te), str(k)],
+                capture_output=True, text=True, timeout=120,
+            )
+            ours = subprocess.run(
+                [sys.executable, "-m", "knn_tpu.cli", str(tr), str(te), str(k),
+                 "--backend", "oracle"],
+                capture_output=True, text=True, timeout=300, cwd=REPO,
+            )
+            a, b = canonical(ref.stdout), canonical(ours.stdout)
+            if a is None or b is None or a[:3] != b[:3] or a[3] != b[3]:
+                failures += 1
+                print(f"FAIL trial {t} (k={k}, n={n}, q={q}):")
+                print(f"  reference: {ref.stdout.strip()[:100]} "
+                      f"(rc={ref.returncode})")
+                print(f"  ours:      {ours.stdout.strip()[:100]} "
+                      f"(rc={ours.returncode})")
+                if failures > 3:
+                    break
+        if (t + 1) % 10 == 0:
+            print(f"{t + 1}/{trials} trials identical", file=sys.stderr)
+    print("reference differential:",
+          "ALL IDENTICAL" if failures == 0 else f"{failures} DIVERGENCES",
+          f"({trials} random dataset pairs, counts + accuracy)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 40))

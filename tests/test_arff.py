@@ -103,11 +103,49 @@ class TestDialect:
                 "@data\nabc,0\n"
             )
 
-    def test_too_many_values(self):
-        with pytest.raises(pyarff.ArffError, match="3 values"):
+    def test_extra_token_carries_into_next_row(self):
+        # The @data section is a token stream (arff_parser.cpp:121-153):
+        # "1,2,3" with two attributes is row (1,2) plus a pending token that
+        # the next line completes — or that EOF discards.
+        ds = parse(
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,2,3\n"
+        )
+        np.testing.assert_array_equal(ds.features, [[1.0]])
+        np.testing.assert_array_equal(ds.labels, [2])
+        ds = parse(
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,2,3\n4\n"
+        )
+        np.testing.assert_array_equal(ds.features, [[1.0], [3.0]])
+        np.testing.assert_array_equal(ds.labels, [2, 4])
+
+    def test_whitespace_separates_tokens(self):
+        # The reference lexer treats unquoted whitespace exactly like a comma
+        # separator (next_token skips it between tokens): "1 2" is a 2-value
+        # row and "1,2 3,4" is TWO rows on one line.
+        ds = parse(
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1 2\n"
+        )
+        np.testing.assert_array_equal(ds.features, [[1.0]])
+        np.testing.assert_array_equal(ds.labels, [2])
+        ds = parse(
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,2 3,4\n"
+        )
+        np.testing.assert_array_equal(ds.features, [[1.0], [3.0]])
+        np.testing.assert_array_equal(ds.labels, [2, 4])
+
+    def test_indented_percent_is_data_not_comment(self):
+        # '%' starts a comment only at the true line start
+        # (arff_lexer.cpp:60-78); indented it is a data token, which fails
+        # numeric conversion with a located error (the reference throws a
+        # type error for the same input).
+        with pytest.raises(pyarff.ArffError):
             parse(
                 "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
-                "@data\n1,2,3\n"
+                "@data\n % not a comment\n1,2\n"
             )
 
     def test_unknown_nominal_value(self):
