@@ -303,14 +303,14 @@ def parse_arff_lines(
         # group of num_attributes.
         pending.extend(cells)
         d = len(attributes)
-        if len(pending) >= d:
-            off = 0
-            while len(pending) - off >= d:  # offset walk: no per-row reslice
-                rows.append(
-                    [_cell_to_float(tok, attr, path, lineno)
-                     for tok, attr in zip(pending[off : off + d], attributes)]
-                )
-                off += d
+        off = 0
+        while len(pending) - off >= d:
+            rows.append(
+                [_cell_to_float(tok, attr, path, lineno)
+                 for tok, attr in zip(pending[off : off + d], attributes)]
+            )
+            off += d
+        if off:  # consume emitted rows once per line, like the C++ twin
             del pending[:off]
     # A partial row at EOF is discarded, matching arff_parser.cpp:130-133.
 

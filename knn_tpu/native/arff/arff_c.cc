@@ -171,7 +171,6 @@ bool parse_attribute(const std::string& rest_in, ParseState& st) {
     // (reference: BRKT_CLOSE immediately ends the value loop).
     if (!strip(inner).empty()) {
       if (!split_csv(inner, vals, st)) return false;
-      size_t lp = inner.find_last_not_of(" \t");
       for (const std::string& v : vals)
         if (v.empty()) {
           fail(st, "empty value in nominal list");

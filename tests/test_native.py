@@ -123,12 +123,21 @@ def _random_arff(rng) -> str:
                 v = vals[int(rng.integers(0, len(vals)))]
                 cells.append(f"'{v}'" if " " in v else v)
         cells.append(str(int(rng.integers(0, 4))))
-        if len(cells) > 2 and rng.random() < 0.2:  # split row across lines
+        style = rng.random()
+        if len(cells) > 2 and style < 0.2:  # split row across lines
             cut = int(rng.integers(1, len(cells)))
             # Trailing comma continues the row (reference-valid; a LEADING
             # comma on the continuation line truncates the reference and is
             # a located error here — covered in the malformed cases).
             lines.append(",".join(cells[:cut]) + ",")
+            lines.append(",".join(cells[cut:]))
+        elif style < 0.3:
+            # Whitespace separates tokens exactly like commas (token-stream
+            # dialect) — but quoted cells must keep their own quoting.
+            lines.append(" ".join(cells))
+        elif style < 0.4 and len(cells) > 1:
+            cut = int(rng.integers(1, len(cells)))
+            lines.append(",".join(cells[:cut]))  # row continues with NO comma
             lines.append(",".join(cells[cut:]))
         else:
             lines.append(",".join(cells))
