@@ -7,9 +7,19 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu test bench parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test bench parity device-parity ref-diff clean
 
-all: native main multi-thread mpi tpu
+all: native main multi-thread mpi tpu datasets
+
+# Synthetic fixture ladder with the reference datasets' shape characteristics
+# (SURVEY.md §2.4) — generated, not copied, so a standalone checkout has
+# runnable data for the README quick start.
+FIXTURES := $(foreach s,small medium large,$(foreach t,train test,datasets/$(s)-$(t).arff))
+
+datasets: $(FIXTURES)
+
+$(FIXTURES) &: scripts/make_fixtures.py
+	python3 scripts/make_fixtures.py datasets
 
 native: $(LIB_DIR)/libknn_arff.so $(LIB_DIR)/libknn_runtime.so
 
@@ -58,3 +68,5 @@ ref-diff:
 
 clean:
 	rm -rf $(LIB_DIR) main multi-thread mpi tpu build/fixtures
+	rm -f $(FIXTURES)
+	-rmdir datasets 2>/dev/null

@@ -120,6 +120,16 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         if fallback is None:
             print(f"error: backend '{backend_name}' unavailable", file=sys.stderr)
             return 1
+        reason = (
+            "native runtime unavailable (run `make native`)"
+            if backend_name.startswith("native")
+            else "backend not registered on this install"
+        )
+        print(
+            f"warning: backend '{backend_name}' unavailable — {reason}; "
+            f"falling back to '{fallback}'",
+            file=sys.stderr,
+        )
         backend_name = fallback
 
     try:

@@ -70,6 +70,25 @@ class TestCli:
         rec = json.loads(lines[-1])
         assert rec["k"] == 1 and rec["num_test"] == 80
 
+    def test_fallback_warns_on_stderr(self, paths, capsys, monkeypatch):
+        # VERDICT r1 #5: a persona whose backend is unavailable must say so on
+        # stderr (and still exit 0 with the canonical line), not silently swap.
+        import knn_tpu.backends as B
+
+        real = B.available_backends()
+        monkeypatch.setattr(
+            B, "available_backends", lambda: [b for b in real if b != "native"]
+        )
+        out = io.StringIO()
+        assert run([paths[0], paths[1], "1", "--persona", "main"], stdout=out) == 0
+        err = capsys.readouterr().err
+        assert "warning:" in err and "'native'" in err and "'oracle'" in err
+        assert LINE_RE.match(out.getvalue().strip())
+
+    def test_unknown_backend_clean_error(self, paths, capsys):
+        assert run([paths[0], paths[1], "1", "--backend", "no-such"]) == 1
+        assert "unavailable" in capsys.readouterr().err
+
     def test_missing_file_clean_error(self, capsys):
         assert run(["/nope/train.arff", "/nope/test.arff", "1"]) == 1
         assert "error:" in capsys.readouterr().err
