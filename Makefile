@@ -13,13 +13,13 @@ all: native main multi-thread mpi tpu datasets
 
 # Synthetic fixture ladder with the reference datasets' shape characteristics
 # (SURVEY.md §2.4) — generated, not copied, so a standalone checkout has
-# runnable data for the README quick start.
+# runnable data for the README quick start. Freshness lives in the script
+# (--if-stale: regenerate only when a file is missing or older than the
+# generator) so this works on any make and is parallel-safe.
 FIXTURES := $(foreach s,small medium large,$(foreach t,train test,datasets/$(s)-$(t).arff))
 
-datasets: $(FIXTURES)
-
-$(FIXTURES) &: scripts/make_fixtures.py
-	python3 scripts/make_fixtures.py datasets
+datasets:
+	python3 scripts/make_fixtures.py --if-stale datasets
 
 native: $(LIB_DIR)/libknn_arff.so $(LIB_DIR)/libknn_runtime.so
 

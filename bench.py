@@ -1,17 +1,28 @@
-"""Benchmark: the BASELINE.json headline metric.
+"""Benchmark: the BASELINE.json headline metric plus the secondary configs.
 
-Classifies large-test.arff (1,718 queries) against large-train.arff (30,803
-rows, 11 features) at k=5 on the available accelerator and reports steady-state
-query throughput vs the measured reference baseline (serial C++ at -O0:
-138.6 q/s, 12,398 ms — BASELINE.md).
+The default run classifies large-test.arff (1,718 queries) against
+large-train.arff (30,803 rows, 11 features) at k=5 on the available
+accelerator, then also runs the secondary configs (mnist / xl / ingest /
+sharded) and prints ONE JSON line — the headline record with every secondary
+config embedded under ``"configs"`` so each round's BENCH_r*.json proves all
+claims (VERDICT r1 #7):
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "queries/sec", "vs_baseline": N}
-Diagnostics go to stderr.
+  {"metric": "large_k5_query_throughput", "value": N, "unit": "queries/sec",
+   "vs_baseline": N, ..., "configs": {"mnist784": {...}, "xl": {...},
+   "ingest": {...}, "sharded": {...}, "kneighbors": {...}}}
 
-``python bench.py --config mnist`` instead runs the BASELINE.json config-5
-shape (65,536 x 784 synthetic train set, 2,048 queries, k=5) through the
-Pallas kernel (fast/MXU distance form) and reports q/s + achieved Tflop/s.
+Diagnostics go to stderr. ``--config
+mnist|xl|ingest|sharded|kneighbors|headline`` runs a single config and
+prints just its record:
+
+- mnist      — BASELINE.json config-5 shape (65,536 x 784 synthetic, 2,048
+               queries, k=5) through the Pallas kernel (MXU distance form).
+- xl         — ~1M train rows, k=10, lane-striped kernel.
+- ingest     — ARFF parse throughput (native C++ + pure-Python parsers).
+- sharded    — the distributed (shard_map) query-sharded path routed through
+               the stripe kernel on a 1-device mesh: proves the multi-chip
+               code path runs at single-chip headline throughput per chip.
+- kneighbors — model retrieval API wall latency per candidate engine.
 """
 
 from __future__ import annotations
@@ -139,20 +150,16 @@ def bench_mnist():
     bf16_step, _ = _pipelined_slope(step_bf16, bufs, 10, 40)
     log(f"bf16 form: {bf16_step*1e3:.2f} ms/step "
         f"({q/bf16_step:.0f} q/s, {2*q*n*d/bf16_step/1e12:.0f} Tflop/s)")
-    print(
-        json.dumps(
-            {
-                "metric": "mnist784_k5_query_throughput",
-                "value": round(qps, 1),
-                "unit": "queries/sec",
-                "vs_baseline": None,
-                "tflops": round(tflops, 1),
-                "step_ms": round(per_step * 1e3, 3),
-                "bf16_qps": round(q / bf16_step, 1),
-                "bf16_tflops": round(2 * q * n * d / bf16_step / 1e12, 1),
-            }
-        )
-    )
+    return {
+        "metric": "mnist784_k5_query_throughput",
+        "value": round(qps, 1),
+        "unit": "queries/sec",
+        "vs_baseline": None,
+        "tflops": round(tflops, 1),
+        "step_ms": round(per_step * 1e3, 3),
+        "bf16_qps": round(q / bf16_step, 1),
+        "bf16_tflops": round(2 * q * n * d / bf16_step / 1e12, 1),
+    }
 
 
 def bench_xl():
@@ -205,20 +212,16 @@ def bench_xl():
     qps = test.num_instances / per_step
     dist_rate = test.num_instances * n / per_step
     log(f"{per_step*1e3:.2f} ms/step, ~{sync*1e3:.0f} ms sync overhead")
-    print(
-        json.dumps(
-            {
-                "metric": "xl_1M_k10_query_throughput",
-                "value": round(qps, 1),
-                "unit": "queries/sec",
-                "vs_baseline": None,
-                "train_rows": int(n),
-                "dist_evals_per_sec": round(dist_rate / 1e9, 1),
-                "dist_unit": "Gdist/s",
-                "step_ms": round(per_step * 1e3, 3),
-            }
-        )
-    )
+    return {
+        "metric": "xl_1M_k10_query_throughput",
+        "value": round(qps, 1),
+        "unit": "queries/sec",
+        "vs_baseline": None,
+        "train_rows": int(n),
+        "dist_evals_per_sec": round(dist_rate / 1e9, 1),
+        "dist_unit": "Gdist/s",
+        "step_ms": round(per_step * 1e3, 3),
+    }
 
 
 def bench_ingest():
@@ -266,17 +269,106 @@ def bench_ingest():
     results["python_mb_per_s"] = round(size_mb / t_py, 1)
     log(f"python parser: {t_py*1e3:.1f} ms ({size_mb/t_py:.0f} MB/s)")
 
-    print(json.dumps({
+    return {
         "metric": "arff_ingest_throughput",
         "value": results.get("native_mb_per_s", results["python_mb_per_s"]),
         "unit": "MB/s",
         "vs_baseline": None,
         "file_mb": round(size_mb, 2),
         **results,
-    }))
+    }
 
 
-def main():
+def bench_sharded():
+    """The distributed (shard_map) path on one chip: query-sharded over a
+    1-device mesh, per-shard candidates from the lane-striped Pallas kernel
+    (VERDICT r1 #1 — the mpi.cpp replacement at headline-kernel throughput).
+    On a pod the same jitted fn spans the full mesh; per-chip throughput is
+    what this measures."""
+    import jax
+    import jax.numpy as jnp
+
+    from knn_tpu.ops.pallas_knn import (
+        stripe_prepare_queries, stripe_prepare_train,
+    )
+    from knn_tpu.parallel.mesh import make_mesh
+    from knn_tpu.parallel.query_sharded import build_query_sharded_stripe_fn
+    from knn_tpu.utils.evaluate import accuracy, confusion_matrix
+
+    train, test, is_reference = load_large()
+    n, d_true = train.features.shape
+    q = test.num_instances
+    block_q, block_n = 896, 2048  # headline tuning (1,718 -> 2 blocks of 896)
+    txT_h, d_pad = stripe_prepare_train(train.features, block_n)
+    mesh = make_mesh(1, axis_names=("q",))
+    fn = build_query_sharded_stripe_fn(
+        mesh, K, train.num_classes, "exact", block_q, block_n, d_true,
+        interpret=False,
+    )
+    txT = jnp.asarray(txT_h)
+    ty = jnp.asarray(np.pad(train.labels, (0, txT_h.shape[1] - n)))
+    nv = jnp.asarray(n, jnp.int32)
+    bufs = [
+        jnp.asarray(stripe_prepare_queries(
+            test.features + np.float32(i) * 1e-7, block_q, d_pad))
+        for i in range(8)
+    ]
+    jax.block_until_ready(bufs)
+
+    def step(qb):
+        return fn(txT, ty, qb, nv)
+
+    t0 = time.monotonic()
+    preds = np.asarray(step(bufs[0]))[:q]
+    log(f"sharded compile+first run: {time.monotonic() - t0:.2f}s")
+    acc = accuracy(confusion_matrix(preds, test.labels, test.num_classes))
+    per_step, sync = _pipelined_slope(step, bufs, 50, 200)
+    qps = q / per_step
+    log(f"sharded (1-dev mesh, stripe engine): {per_step*1e3:.3f} ms/step "
+        f"({qps:.0f} q/s), accuracy {acc:.4f}")
+    return {
+        "metric": "large_k5_sharded_query_throughput",
+        "value": round(qps, 1),
+        "unit": "queries/sec",
+        "vs_baseline": round(qps / BASELINE_QPS, 1),
+        "accuracy": round(acc, 4),
+        "step_ms": round(per_step * 1e3, 3),
+        "mesh": "1-device shard_map, stripe engine",
+    }
+
+
+def bench_kneighbors():
+    """Model retrieval API (models.kneighbors) end-to-end wall time per call —
+    host padding + transfer + kernel + fetch — for each candidate engine.
+    Proves VERDICT r1 #6: retrieval rides the stripe kernel on TPU (engine
+    auto) instead of being pinned to the slower XLA scan. Wall numbers
+    include the fixed per-call host sync (~tens of ms on a tunneled device),
+    so they are API latencies, not kernel throughput."""
+    from knn_tpu.models.knn import _kneighbors_arrays
+
+    train, test, _ = load_large()
+    q = test.num_instances
+    results = {}
+    for engine in ("auto", "xla"):
+        _kneighbors_arrays(train.features, test.features, K, engine=engine)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.monotonic()
+            _kneighbors_arrays(train.features, test.features, K, engine=engine)
+            best = min(best, time.monotonic() - t0)
+        results[engine] = best
+        log(f"kneighbors[{engine}]: {best*1e3:.1f} ms/call ({q/best:.0f} q/s wall)")
+    return {
+        "metric": "large_k5_kneighbors_wall_throughput",
+        "value": round(q / results["auto"], 1),
+        "unit": "queries/sec",
+        "vs_baseline": None,
+        "auto_ms_per_call": round(results["auto"] * 1e3, 1),
+        "xla_ms_per_call": round(results["xla"] * 1e3, 1),
+    }
+
+
+def bench_headline():
     import jax
     import jax.numpy as jnp
 
@@ -374,29 +466,50 @@ def main():
     log(f"approx top-k: {approx_step*1e3:.3f} ms/step "
         f"({approx_qps:.0f} q/s), accuracy {approx_acc:.4f}")
 
-    print(
-        json.dumps(
-            {
-                "metric": "large_k5_query_throughput",
-                "value": round(qps, 1),
-                "unit": "queries/sec",
-                "vs_baseline": round(qps / BASELINE_QPS, 1),
-                "accuracy": round(acc, 4),
-                "step_ms": round(per_step * 1e3, 3),
-                "sync_overhead_ms": round(roundtrip * 1e3, 1),
-                "approx_topk_qps": round(approx_qps, 1),
-                "approx_topk_accuracy": round(approx_acc, 4),
-            }
-        )
-    )
+    return {
+        "metric": "large_k5_query_throughput",
+        "value": round(qps, 1),
+        "unit": "queries/sec",
+        "vs_baseline": round(qps / BASELINE_QPS, 1),
+        "accuracy": round(acc, 4),
+        "step_ms": round(per_step * 1e3, 3),
+        "sync_overhead_ms": round(roundtrip * 1e3, 1),
+        "approx_topk_qps": round(approx_qps, 1),
+        "approx_topk_accuracy": round(approx_acc, 4),
+    }
+
+
+_SECONDARY_CONFIGS = {
+    "mnist784": bench_mnist,
+    "xl": bench_xl,
+    "ingest": bench_ingest,
+    "sharded": bench_sharded,
+    "kneighbors": bench_kneighbors,
+}
+
+
+def main():
+    """Default run: headline + every secondary config, ONE JSON line."""
+    record = bench_headline()
+    configs = {}
+    for name, fn in _SECONDARY_CONFIGS.items():
+        try:
+            configs[name] = fn()
+        except Exception as e:  # a secondary config must not sink the headline
+            log(f"config {name} FAILED: {type(e).__name__}: {e}")
+            configs[name] = {"error": f"{type(e).__name__}: {e}"}
+    record["configs"] = configs
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    if "--config" in sys.argv and "mnist" in sys.argv:
-        bench_mnist()
-    elif "--config" in sys.argv and "xl" in sys.argv:
-        bench_xl()
-    elif "--config" in sys.argv and "ingest" in sys.argv:
-        bench_ingest()
+    if "--config" in sys.argv:
+        fns = dict(_SECONDARY_CONFIGS, headline=bench_headline, mnist=bench_mnist)
+        idx = sys.argv.index("--config") + 1
+        name = sys.argv[idx] if idx < len(sys.argv) else None
+        if name not in fns:
+            log(f"usage: bench.py [--config {'|'.join(sorted(fns))}]")
+            sys.exit(2)
+        print(json.dumps(fns[name]()))
     else:
         main()

@@ -319,17 +319,16 @@ def predict_arrays(
             precision=precision, query_tile=query_tile, train_tile=train_tile,
             force_tiled=force_tiled, approx=approx, query_batch=query_batch,
         )
-    # Same eligibility rule as predict_pallas's engine auto-selection
-    # (docs/KERNELS.md): exact, narrow features, small k.
+    # Shared auto-engine rule (ops/pallas_knn.py::stripe_auto_eligible):
+    # exact euclidean, narrow features, small k, real TPU.
+    from knn_tpu.ops.pallas_knn import stripe_auto_eligible
+
     if (
         engine == "auto"
         and not approx
         and not force_tiled
         and metric == "euclidean"
-        and precision == "exact"
-        and train_x.shape[1] <= 64
-        and k <= 16
-        and jax.default_backend() == "tpu"
+        and stripe_auto_eligible(precision, train_x.shape[1], k)
     ):
         from knn_tpu.ops.pallas_knn import stripe_classify_arrays
 

@@ -67,6 +67,15 @@ def build_parser() -> argparse.ArgumentParser:
         "bf16 (bfloat16 MXU operands, tpu-pallas only), "
         "auto (defer to the backend's default)",
     )
+    p.add_argument(
+        "--engine",
+        choices=["auto", "stripe", "xla", "full", "tiled"],
+        default="auto",
+        help="candidate kernel for the tpu/sharded backends: auto (stripe on "
+        "real TPU for exact narrow-feature problems), stripe (lane-striped "
+        "Pallas kernel), xla (tiled scan); full/tiled are tpu-ring-only "
+        "per-step scorers",
+    )
     p.add_argument("--query-tile", type=int, default=256)
     p.add_argument("--train-tile", type=int, default=2048)
     p.add_argument("--query-batch", type=int, default=None,
@@ -150,6 +159,8 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         opts["query_batch"] = args.query_batch
     if args.precision != "auto":
         opts["precision"] = args.precision
+    if args.engine != "auto":
+        opts["engine"] = args.engine
     if args.approx:
         opts["approx"] = True
     if args.threads is not None:

@@ -12,6 +12,17 @@ place of softmax accumulation. Because the merge keys on (distance,
 global-index), tie semantics are preserved even though shards arrive in
 rotated (non-index) order — the case positional tie-breaking would get wrong
 (SURVEY.md §7 hard part (b)).
+
+Per-step scoring engines (VERDICT r1 #1/#3):
+
+- ``full``   — materialize the whole ``[q_local, shard_rows]`` distance block.
+  Fastest at fixture scale; memory O(q_local · N/P).
+- ``tiled``  — the XLA tiled candidate scan (backends/tpu.py::
+  forward_candidates_core): per-step memory O(query_tile · train_tile), so
+  the ring holds xl-scale shards (~1M rows) without blowing HBM.
+- ``stripe`` — the lane-striped Pallas kernel (ops/pallas_knn.py), the
+  single-chip headline kernel; the ring rotates the *transposed* ``[D_pad,
+  shard_rows]`` shard so each step feeds the kernel its native layout.
 """
 
 from __future__ import annotations
@@ -26,6 +37,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from knn_tpu.backends import register
+from knn_tpu.backends.tpu import forward_candidates_core
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.ops.distance import _DIST_FNS
 from knn_tpu.ops.topk import merge_topk_labeled
@@ -33,6 +45,33 @@ from knn_tpu.ops.vote import vote
 from knn_tpu.parallel.mesh import make_mesh
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
+# [q_local, shard_rows] cells above which ``engine="auto"`` abandons the
+# full-matrix per-step scorer for the tiled one (same ballpark as the
+# single-device full-matrix limit in backends/tpu.py).
+_FULL_RING_CELL_LIMIT = 16 * 1024 * 1024
+
+
+def _resolve_ring_engine(
+    engine: str, precision: str, d: int, k: int, q_local: int, shard_rows: int
+) -> str:
+    if engine == "xla":
+        # The name the other sharded backends use for their XLA scorer; keep
+        # --engine xla working uniformly across backends.
+        engine = "tiled"
+    if engine not in ("auto", "full", "tiled", "stripe"):
+        raise ValueError(
+            f"unknown ring engine {engine!r}; choose 'auto', 'full', "
+            f"'tiled' (alias 'xla'), or 'stripe'"
+        )
+    if engine != "auto":
+        return engine
+    from knn_tpu.ops.pallas_knn import stripe_auto_eligible
+
+    if stripe_auto_eligible(precision, d, k):
+        return "stripe"
+    if q_local * shard_rows <= _FULL_RING_CELL_LIMIT:
+        return "full"
+    return "tiled"
 
 
 def build_ring_fn(
@@ -41,63 +80,92 @@ def build_ring_fn(
     num_classes: int,
     precision: str = "exact",
     axis: str = "r",
+    engine: str = "full",
+    query_tile: int = 128,
+    train_tile: int = 1024,
+    block_q: int = 448,
+    block_n: int = 2048,
+    d_true: Optional[int] = None,
+    interpret: bool = False,
 ):
-    """fn(train_x, train_y, test_x, n_train_valid) -> preds; train and test
-    both sharded over ``axis``."""
+    """fn(train, train_y, test_x, n_train_valid) -> preds; train and test both
+    sharded over ``axis``. For ``engine="stripe"`` the train argument is the
+    TRANSPOSED ``[D_pad, N_pad]`` matrix sharded over its column axis;
+    otherwise it is the usual ``[N_pad, D]`` rows."""
     n_dev = mesh.shape[axis]
-    dist_fn = _DIST_FNS[precision]
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
-    def per_shard(train_x, train_y, test_block, n_valid):
-        shard_rows = train_x.shape[0]
-        kk = min(k, shard_rows)
+    def per_shard(train_shard, train_y, test_block, n_valid):
+        shard_rows = train_shard.shape[1 if engine == "stripe" else 0]
         my = lax.axis_index(axis)
 
-        def score_and_merge(run, cur_x, cur_y, owner):
-            """Fold the currently-held shard into the running candidates."""
-            run_d, run_i, run_l = run
+        def score(cur_t, cur_y, owner):
+            """One shard's candidate triple, with global indices."""
             base = (owner * shard_rows).astype(jnp.int32)
-            d = dist_fn(test_block, cur_x)  # [q_local, shard_rows]
-            local_valid = jnp.clip(n_valid - owner * shard_rows, 0, shard_rows)
-            d = jnp.where(jnp.arange(shard_rows)[None, :] < local_valid, d, jnp.inf)
-            neg, li = lax.top_k(-d, kk)
-            return merge_topk_labeled(
-                run_d, run_i, run_l,
-                -neg, (li + base).astype(jnp.int32), cur_y[li],
-                k,
+            local_valid = jnp.clip(n_valid - base, 0, shard_rows)
+            if engine == "stripe":
+                from knn_tpu.ops.pallas_knn import stripe_candidates_core
+
+                return stripe_candidates_core(
+                    cur_t, cur_y, test_block, local_valid, k,
+                    block_q=block_q, block_n=block_n,
+                    d_true=d_true if d_true is not None else cur_t.shape[0],
+                    precision=precision, interpret=interpret, index_base=base,
+                )
+            if engine == "tiled":
+                return forward_candidates_core(
+                    cur_t, cur_y, test_block, local_valid,
+                    k=k, precision=precision,
+                    query_tile=query_tile,
+                    train_tile=min(train_tile, shard_rows),
+                    index_base=base,
+                )
+            # full: one [q_local, shard_rows] distance block per step.
+            kk = min(k, shard_rows)
+            d = _DIST_FNS[precision](test_block, cur_t)
+            d = jnp.where(
+                jnp.arange(shard_rows)[None, :] < local_valid, d, jnp.inf
             )
+            neg, li = lax.top_k(-d, kk)
+            return -neg, (li + base).astype(jnp.int32), cur_y[li]
+
+        def score_and_merge(run, cur_t, cur_y, owner):
+            run_d, run_i, run_l = run
+            s_d, s_i, s_l = score(cur_t, cur_y, owner)
+            return merge_topk_labeled(run_d, run_i, run_l, s_d, s_i, s_l, k)
 
         q_local = test_block.shape[0]
         run = (
-            jnp.full((q_local, k), jnp.inf, train_x.dtype),
+            jnp.full((q_local, k), jnp.inf, jnp.float32),
             jnp.full((q_local, k), jnp.iinfo(jnp.int32).max, jnp.int32),
             jnp.zeros((q_local, k), train_y.dtype),
         )
         # Step 0: score the resident shard; steps 1..P-1: rotate, then score —
         # so only P-1 ppermute rounds cross the wire.
-        run = score_and_merge(run, train_x, train_y, my)
+        run = score_and_merge(run, train_shard, train_y, my)
 
         def step(carry, s):
-            cur_x, cur_y, run_d, run_i, run_l = carry
-            cur_x = lax.ppermute(cur_x, axis, perm)
+            cur_t, cur_y, run_d, run_i, run_l = carry
+            cur_t = lax.ppermute(cur_t, axis, perm)
             cur_y = lax.ppermute(cur_y, axis, perm)
             # After s hops we hold the shard that started at device my - s.
             owner = (my - s) % n_dev
-            run = score_and_merge((run_d, run_i, run_l), cur_x, cur_y, owner)
-            return (cur_x, cur_y) + run, None
+            run = score_and_merge((run_d, run_i, run_l), cur_t, cur_y, owner)
+            return (cur_t, cur_y) + run, None
 
         if n_dev > 1:
             (_, _, _, _, run_l), _ = lax.scan(
-                step, (train_x, train_y) + run, jnp.arange(1, n_dev)
+                step, (train_shard, train_y) + run, jnp.arange(1, n_dev)
             )
         else:
             run_l = run[2]
         return vote(run_l, num_classes)
 
+    train_spec = P(None, axis) if engine == "stripe" else P(axis)
     sharded = jax.shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P()),
+        in_specs=(train_spec, P(axis), P(axis), P()),
         out_specs=P(axis),
         check_vma=False,
     )
@@ -105,11 +173,18 @@ def build_ring_fn(
 
 
 @functools.lru_cache(maxsize=None)
-def _cached_fn(n_dev, k, num_classes, precision):
+def _cached_fn(
+    n_dev, k, num_classes, precision, engine, query_tile, train_tile,
+    block_q, block_n, d_true, interpret,
+):
     # Cache the jitted shard_map closure so repeat predicts (and --warmup)
     # reuse XLA's compile cache instead of retracing a fresh closure.
     mesh = make_mesh(n_dev, axis_names=("r",))
-    return build_ring_fn(mesh, k, num_classes, precision)
+    return build_ring_fn(
+        mesh, k, num_classes, precision,
+        engine=engine, query_tile=query_tile, train_tile=train_tile,
+        block_q=block_q, block_n=block_n, d_true=d_true, interpret=interpret,
+    )
 
 
 def predict_ring(
@@ -120,16 +195,56 @@ def predict_ring(
     num_classes: int,
     num_devices: Optional[int] = None,
     precision: str = "exact",
+    engine: str = "auto",
+    query_tile: int = 128,
+    train_tile: int = 1024,
+    interpret: Optional[bool] = None,
 ) -> np.ndarray:
     n_dev = num_devices or len(jax.devices())
-    q = test_x.shape[0]
-    tx, _ = pad_axis_to_multiple(train_x, n_dev, axis=0)
-    ty, _ = pad_axis_to_multiple(train_y, n_dev, axis=0)
-    qx, _ = pad_axis_to_multiple(test_x, n_dev, axis=0)
-    fn = _cached_fn(n_dev, k, num_classes, precision)
+    q, n, d = test_x.shape[0], train_x.shape[0], train_x.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    engine = _resolve_ring_engine(
+        engine, precision, d, k, -(-q // n_dev), -(-n // n_dev)
+    )
+
+    if engine == "stripe":
+        from knn_tpu.ops.pallas_knn import stripe_prepare_sharded
+
+        txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
+            train_x, train_y, test_x, k, n_dev, n_dev
+        )
+        fn = _cached_fn(
+            n_dev, k, num_classes, precision, "stripe", query_tile,
+            train_tile, block_q, block_n, d, interpret,
+        )
+        out = fn(
+            jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
+            jnp.asarray(n, jnp.int32),
+        )
+        return np.asarray(out)[:q]
+
+    if engine == "tiled":
+        shard_quota = -(-n // n_dev)  # ceil train rows per shard
+        train_tile = max(min(train_tile, shard_quota), 1)
+        shard_rows = -(-shard_quota // train_tile) * train_tile
+        q_quota = -(-q // n_dev)  # ceil queries per shard
+        query_tile = max(8, min(query_tile, -(-q_quota // 8) * 8))
+        q_local = -(-q_quota // query_tile) * query_tile
+        tx, _ = pad_axis_to_multiple(train_x, shard_rows * n_dev, axis=0)
+        ty, _ = pad_axis_to_multiple(train_y, shard_rows * n_dev, axis=0)
+        qx, _ = pad_axis_to_multiple(test_x, q_local * n_dev, axis=0)
+    else:  # full
+        tx, _ = pad_axis_to_multiple(train_x, n_dev, axis=0)
+        ty, _ = pad_axis_to_multiple(train_y, n_dev, axis=0)
+        qx, _ = pad_axis_to_multiple(test_x, n_dev, axis=0)
+    fn = _cached_fn(
+        n_dev, k, num_classes, precision, engine, query_tile, train_tile,
+        448, 2048, d, interpret,
+    )
     out = fn(
         jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
-        jnp.asarray(train_x.shape[0], jnp.int32),
+        jnp.asarray(n, jnp.int32),
     )
     return np.asarray(out)[:q]
 
@@ -142,13 +257,19 @@ def predict(
     num_devices: Optional[int] = None,
     precision: str = "exact",
     metric: str = "euclidean",
+    engine: str = "auto",
+    query_tile: int = 128,
+    train_tile: int = 1024,
     **_unused,
 ) -> np.ndarray:
     from knn_tpu.ops.distance import resolve_form
 
     precision = resolve_form(precision, metric)
+    if metric != "euclidean" and engine == "stripe":
+        raise ValueError("the stripe engine implements euclidean only")
     train.validate_for_knn(k, test)
     return predict_ring(
         train.features, train.labels, test.features, k, train.num_classes,
-        num_devices=num_devices, precision=precision,
+        num_devices=num_devices, precision=precision, engine=engine,
+        query_tile=query_tile, train_tile=train_tile,
     )

@@ -25,6 +25,8 @@ def main(trials: int = 30) -> int:
     from knn_tpu.backends.oracle import knn_oracle
     from knn_tpu.backends.tpu import predict_arrays
     from knn_tpu.ops.pallas_knn import predict_pallas
+    from knn_tpu.parallel.query_sharded import predict_query_sharded
+    from knn_tpu.parallel.train_sharded import predict_train_sharded
 
     print(f"device: {jax.devices()[0].device_kind}", file=sys.stderr)
     rng = np.random.default_rng(20260730)
@@ -56,6 +58,28 @@ def main(trials: int = 30) -> int:
             "pallas-merge": lambda: predict_pallas(
                 train_x, train_y, test_x, k, c, engine="merge",
                 block_q=64, block_n=256, interpret=False),
+            # Mosaic-compiled stripe kernel in its fast/bf16 MXU branches
+            # (ADVICE r1: these lower differently from the exact branch and
+            # were previously hardware-untested). On these small-integer
+            # grids every term of |q|^2 - 2 q.t + |t|^2 is exactly
+            # representable (values < 2^8 even in bf16, f32 accumulation),
+            # so prediction equality is exact here too.
+            "stripe-fast": lambda: predict_pallas(
+                train_x, train_y, test_x, k, c, engine="stripe",
+                precision="fast", interpret=False),
+            "stripe-bf16": lambda: predict_pallas(
+                train_x, train_y, test_x, k, c, engine="stripe",
+                precision="bf16", interpret=False),
+            # Stripe kernel composed with shard_map on a 1-device mesh — the
+            # real-chip compile check for the distributed stripe routing
+            # (VERDICT r1 #1); the multi-device behavior is covered by the
+            # CPU-mesh tests and dryrun_multichip.
+            "qs-1dev-stripe": lambda: predict_query_sharded(
+                train_x, train_y, test_x, k, c, num_devices=1,
+                engine="stripe", interpret=False),
+            "ts-1dev-stripe": lambda: predict_train_sharded(
+                train_x, train_y, test_x, k, c, mesh_shape=(1, 1),
+                engine="stripe", interpret=False),
         }
         for name, fn in paths.items():
             got = fn()

@@ -54,8 +54,25 @@ def make(size: str, out_dir: Path, seed: int = 0) -> None:
     write_arff(out_dir / f"{size}-test.arff", tx, ty, f"{size}-test")
 
 
+def all_paths(out_dir: Path):
+    return [
+        out_dir / f"{size}-{part}.arff" for size in SIZES
+        for part in ("train", "test")
+    ]
+
+
 def main():
-    out = Path(sys.argv[1]) if len(sys.argv) > 1 else Path("build/fixtures")
+    args = [a for a in sys.argv[1:] if a != "--if-stale"]
+    if_stale = "--if-stale" in sys.argv[1:]
+    out = Path(args[0]) if args else Path("build/fixtures")
+    if if_stale:
+        script_mtime = Path(__file__).stat().st_mtime
+        if all(
+            p.exists() and p.stat().st_mtime >= script_mtime
+            for p in all_paths(out)
+        ):
+            print(f"fixtures in {out} are up to date")
+            return
     for size in SIZES:
         make(size, out)
     print(f"fixtures written to {out}")

@@ -1,0 +1,91 @@
+"""Model retrieval honors engine selection (VERDICT r1 #6): ``kneighbors`` /
+``predict_proba`` / weighted vote / regression route through the same engine
+knob as ``predict``, and every engine returns identical (distance, index)
+candidates on tie-dense problems."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor, _kneighbors_arrays
+
+
+def _tie_problem(rng, n=400, q=50, d=5, c=6):
+    train_x = rng.integers(0, 4, (n, d)).astype(np.float32)  # grid → ties
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.integers(0, 4, (q - q // 2, d)).astype(np.float32)]
+    )
+    return train_x, train_y, test_x, c
+
+
+class TestKneighborsEngines:
+    @pytest.mark.parametrize("k", [1, 5, 12])
+    def test_stripe_matches_xla(self, rng, k):
+        train_x, train_y, test_x, _ = _tie_problem(rng)
+        d_x, i_x = _kneighbors_arrays(train_x, test_x, k, engine="xla")
+        d_s, i_s = _kneighbors_arrays(train_x, test_x, k, engine="stripe")
+        np.testing.assert_array_equal(i_s, i_x)
+        np.testing.assert_array_equal(d_s, d_x)
+
+    def test_candidates_match_brute_force(self, rng):
+        train_x, _, test_x, _ = _tie_problem(rng, n=120, q=16)
+        k = 7
+        for engine in ("xla", "stripe"):
+            d, i = _kneighbors_arrays(train_x, test_x, k, engine=engine)
+            for row in range(test_x.shape[0]):
+                full = ((test_x[row][None, :] - train_x) ** 2).sum(-1)
+                order = np.lexsort((np.arange(len(full)), full))[:k]
+                np.testing.assert_array_equal(i[row], order, err_msg=engine)
+
+    def test_unknown_engine_rejected(self, rng):
+        train_x, _, test_x, _ = _tie_problem(rng, n=32, q=4)
+        with pytest.raises(ValueError, match="engine"):
+            _kneighbors_arrays(train_x, test_x, 3, engine="warp")
+
+    def test_stripe_rejects_non_euclidean(self, rng):
+        train_x, _, test_x, _ = _tie_problem(rng, n=32, q=4)
+        with pytest.raises(ValueError, match="euclidean"):
+            _kneighbors_arrays(
+                train_x, test_x, 3, metric="manhattan", engine="stripe"
+            )
+
+
+class TestModelEngineRouting:
+    def test_classifier_kneighbors_engine_opt(self, rng):
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        m_x = KNNClassifier(k=5, engine="xla").fit(train)
+        m_s = KNNClassifier(k=5, engine="stripe").fit(train)
+        d_x, i_x = m_x.kneighbors(test)
+        d_s, i_s = m_s.kneighbors(test)
+        np.testing.assert_array_equal(i_s, i_x)
+        np.testing.assert_array_equal(d_s, d_x)
+
+    def test_weighted_vote_accepts_engine(self, rng):
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        want = KNNClassifier(k=5, weights="distance").fit(train).predict(test)
+        got = (
+            KNNClassifier(k=5, weights="distance", engine="stripe")
+            .fit(train).predict(test)
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_weighted_vote_still_rejects_other_opts(self):
+        with pytest.raises(ValueError, match="engine"):
+            KNNClassifier(k=5, weights="distance", query_tile=64)
+
+    def test_regressor_engine_parity(self, rng):
+        train_x, _, test_x, _ = _tie_problem(rng)
+        targets = rng.normal(size=len(train_x)).astype(np.float32)
+        train = Dataset(
+            train_x, np.zeros(len(train_x), np.int32), raw_targets=targets
+        )
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        p_x = KNNRegressor(k=5, weights="distance", engine="xla").fit(train).predict(test)
+        p_s = KNNRegressor(k=5, weights="distance", engine="stripe").fit(train).predict(test)
+        np.testing.assert_array_equal(p_s, p_x)

@@ -134,6 +134,76 @@ class TestRing:
         np.testing.assert_array_equal(got, want)
 
 
+class TestStripeEngine:
+    """VERDICT r1 #1: every distributed path can obtain per-shard candidates
+    from the lane-striped Pallas kernel (interpret mode on the CPU mesh) and
+    must stay prediction-exact vs the oracle."""
+
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_query_sharded_stripe(self, problem, k):
+        train_x, train_y, test_x, c = problem
+        got = predict_query_sharded(
+            train_x, train_y, test_x, k, c, engine="stripe"
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, k))
+
+    @pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (1, 8)])
+    def test_train_sharded_stripe(self, problem, mesh_shape):
+        train_x, train_y, test_x, c = problem
+        got = predict_train_sharded(
+            train_x, train_y, test_x, 5, c,
+            mesh_shape=mesh_shape, engine="stripe",
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, 5))
+
+    def test_train_sharded_stripe_cross_shard_ties(self):
+        # All train rows identical: the k lowest *global* indices must win
+        # regardless of which shard (and stripe lane) they live in.
+        train_x = np.ones((64, 3), np.float32)
+        train_y = np.arange(64, dtype=np.int32) % 7
+        test_x = np.ones((8, 3), np.float32)
+        want = knn_oracle(train_x, train_y, test_x, 5, 7)
+        got = predict_train_sharded(
+            train_x, train_y, test_x, 5, 7, mesh_shape=(1, 8), engine="stripe"
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_ring_stripe(self, problem):
+        train_x, train_y, test_x, c = problem
+        got = predict_ring(train_x, train_y, test_x, 5, c, engine="stripe")
+        np.testing.assert_array_equal(got, oracle_preds(problem, 5))
+
+    def test_ring_tiled(self, problem):
+        train_x, train_y, test_x, c = problem
+        got = predict_ring(
+            train_x, train_y, test_x, 5, c,
+            engine="tiled", query_tile=16, train_tile=64,
+        )
+        np.testing.assert_array_equal(got, oracle_preds(problem, 5))
+
+
+class TestRingXl:
+    def test_ring_tiled_xl_without_full_matrix(self):
+        # VERDICT r1 #3: an xl-shaped problem — >=1M padded train rows over 8
+        # devices — must pass through the ring without materializing the
+        # per-shard [q_local, N/P] distance matrix (tiled engine: per-step
+        # memory is O(query_tile x train_tile)).
+        rng = np.random.default_rng(11)
+        n, q, d, c, k = 1_050_000, 48, 4, 6, 5
+        train_x = rng.integers(0, 64, (n, d)).astype(np.float32)
+        train_y = rng.integers(0, c, n).astype(np.int32)
+        test_x = np.concatenate(
+            [train_x[rng.choice(n, q // 2, replace=False)],
+             rng.integers(0, 64, (q - q // 2, d)).astype(np.float32)]
+        )
+        want = knn_oracle(train_x, train_y, test_x, k, c)
+        got = predict_ring(
+            train_x, train_y, test_x, k, c,
+            engine="tiled", query_tile=8, train_tile=4096,
+        )
+        np.testing.assert_array_equal(got, want)
+
+
 class TestFixtureParity:
     """Small reference fixture through every distributed path."""
 
