@@ -34,11 +34,8 @@ def predict(
     if metric != "euclidean":
         raise ValueError("the pallas kernels implement euclidean only")
     train.validate_for_knn(k, test)
-    if precision == "auto":
-        # The exact form unrolls the feature axis on the VPU — right for the
-        # narrow parity datasets, pathological for wide features where the
-        # single-matmul form is the point of this kernel.
-        precision = "exact" if train.features.shape[1] <= 128 else "fast"
+    # precision="auto" resolves inside predict_pallas (exact for narrow
+    # features, fast for wide — ops/pallas_knn._resolve_stripe_precision).
     return predict_pallas(
         train.features, train.labels, test.features, k, train.num_classes,
         block_q=block_q, block_n=block_n, interpret=interpret,

@@ -26,10 +26,11 @@ from knn_tpu.data.dataset import Attribute, Dataset
 from knn_tpu.data import pyarff
 
 _CACHE_ENV = "KNN_TPU_ARFF_CACHE"
-# Bumped when the cached array schema changes (v2: + raw_targets), so caches
+# Bumped when the cached array schema changes (v2: + raw_targets; v3:
+# + Attribute.string_values for interned STRING/DATE columns), so caches
 # written by older code are simply never found rather than silently read
 # without the newer fields.
-_CACHE_SCHEMA = 2
+_CACHE_SCHEMA = 3
 
 
 def _cache_path(path: str) -> Optional[Path]:
@@ -52,7 +53,10 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
     if cache is not None and cache.exists():
         with np.load(cache, allow_pickle=False) as z:
             attrs = [
-                Attribute(a["name"], a["type"], a.get("nominal_values"))
+                Attribute(
+                    a["name"], a["type"], a.get("nominal_values"),
+                    a.get("string_values"),
+                )
                 for a in json.loads(str(z["attributes"]))
             ]
             return Dataset(
@@ -85,7 +89,12 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
             relation=ds.relation,
             attributes=json.dumps(
                 [
-                    {"name": a.name, "type": a.type, "nominal_values": a.nominal_values}
+                    {
+                        "name": a.name,
+                        "type": a.type,
+                        "nominal_values": a.nominal_values,
+                        "string_values": a.string_values,
+                    }
                     for a in ds.attributes
                 ]
             ),
@@ -93,10 +102,25 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
     return ds
 
 
+def _quote(value: str) -> str:
+    """Quote with whichever quote char the value doesn't contain — neither
+    our parsers nor the reference lexer support backslash escapes, so a
+    value containing BOTH quote chars is unrepresentable in the dialect."""
+    if "'" not in value:
+        return "'" + value + "'"
+    if '"' not in value:
+        return '"' + value + '"'
+    raise ValueError(
+        f"value {value!r} contains both quote characters and cannot be "
+        f"represented in the ARFF dialect (no escape syntax exists)"
+    )
+
+
 def _quote_if_needed(name: str) -> str:
-    if name and not any(c.isspace() for c in name) and "," not in name:
+    if name and not any(c.isspace() for c in name) and "," not in name \
+            and "'" not in name and '"' not in name:
         return name
-    return "'" + name.replace("'", "\\'") + "'"
+    return _quote(name)
 
 
 def write_arff(ds: Dataset, path: str) -> None:
@@ -131,6 +155,10 @@ def write_arff(ds: Dataset, path: str) -> None:
             return "?"
         if a.type == "nominal" and a.nominal_values:
             return str(a.nominal_values[int(value)])
+        if a.type in ("string", "date") and a.string_values:
+            # Interned code -> original value, quoted so embedded
+            # spaces/commas survive the round trip.
+            return _quote(str(a.string_values[int(value)]))
         f = float(value)
         return str(int(f)) if f.is_integer() else repr(f)
 

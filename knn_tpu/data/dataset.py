@@ -19,11 +19,20 @@ import numpy as np
 @dataclasses.dataclass
 class Attribute:
     """Attribute metadata (name + type), the analogue of libarff's ArffAttr
-    (arff_attr.h:17-49). ``nominal_values`` is set only for ``{a,b,c}`` attrs."""
+    (arff_attr.h:17-49). ``nominal_values`` is set only for ``{a,b,c}`` attrs.
+
+    ``string_values`` is the interned-value table for STRING/DATE attributes:
+    data cells of these types are stored in the dense matrix as float32 codes
+    indexing this first-seen-ordered table (the reference keeps them as
+    heap strings per cell, arff_value.cpp:33-48, and only fails when its KNN
+    kernel tries to read one as float, arff_value.cpp:121 — so files with
+    string columns LOAD there and must load here; the numeric-only
+    requirement is deferred to predict time, Dataset.validate_for_knn)."""
 
     name: str
     type: str  # "numeric" | "string" | "date" | "nominal"
     nominal_values: Optional[list] = None
+    string_values: Optional[list] = None
 
 
 @dataclasses.dataclass
@@ -96,7 +105,24 @@ class Dataset:
         return int(self.labels.max()) + 1
 
     def validate_for_knn(self, k: int, other: Optional["Dataset"] = None) -> None:
-        """Checks the reference leaves as UB (SURVEY.md §3.5.5)."""
+        """Checks the reference leaves as UB (SURVEY.md §3.5.5), plus the
+        deferred numeric-only requirement: STRING/DATE columns parse into
+        interned codes at load time (matching the reference parser, which
+        accepts them, arff_parser.cpp:145-147), but a distance over interned
+        codes is meaningless, so *feature* columns of those types are
+        rejected here — where the reference instead aborts mid-KNN
+        (arff_value.cpp:121). A string-typed *class* column is allowed: the
+        interned codes are well-defined class ids (a framework extension;
+        the reference aborts on the label cast, main.cpp:57)."""
+        for ds in (self, other) if other is not None else (self,):
+            for a in list(ds.attributes)[: ds.num_features]:
+                if a.type in ("string", "date"):
+                    raise ValueError(
+                        f"attribute '{a.name}' of type {a.type} is not "
+                        f"numeric; KNN distances need numeric feature "
+                        f"columns (string/date columns load as interned "
+                        f"codes but cannot be compared)"
+                    )
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         if k > self.num_instances:

@@ -18,8 +18,21 @@ Dialect (SURVEY.md §3.4, libarff/arff_parser.cpp:23-153, arff_lexer.cpp:60-203)
 - A partial row at EOF is discarded (arff_parser.cpp:130-133,149-151).
 - Sparse ARFF (``{index value, ...}`` rows) is NOT supported, matching the
   reference.
+- STRING/DATE data cells parse into per-attribute interned float32 codes
+  (first-seen order, table on ``Attribute.string_values``). The reference
+  stores them as heap strings (arff_value.cpp:33-48) and only fails when KNN
+  reads one as float (arff_value.cpp:121), so such files LOAD there; here the
+  numeric-only requirement is deferred to ``Dataset.validate_for_knn``.
+- Deliberate deviation: the reference lexer lets a quoted value span physical
+  lines (``_read_str`` reads to the matching quote through newlines,
+  arff_lexer.cpp:159-188); both parsers here are line-based and raise
+  ``unterminated quoted value`` instead. (Exotic: the reference drops quoted
+  @data rows anyway, so this only matters for nominal declarations split
+  across lines.)
 
-Errors carry ``file:line`` context like libarff's THROW (arff_utils.cpp:8-20).
+Errors carry ``file:line`` context like libarff's THROW (arff_utils.cpp:8-20);
+tokens carried across physical lines by multi-line rows are reported with the
+line they appeared on, not the line that completed the row.
 
 This is the fallback/oracle implementation; the production path is the native
 C++ parser in ``knn_tpu/native/arff`` (bound via ctypes in
@@ -218,7 +231,7 @@ def _parse_attribute(rest: str, path: str, lineno: int) -> Attribute:
 
 
 def _cell_to_float(
-    tok: str, attr: Attribute, path: str, lineno: int
+    tok: str, attr: Attribute, intern: dict, path: str, lineno: int
 ) -> float:
     if tok == "?":
         return math.nan
@@ -230,11 +243,9 @@ def _cell_to_float(
                 path, lineno, f"value '{tok}' not in nominal set for '{attr.name}'"
             ) from None
     if attr.type in ("string", "date"):
-        # The reference stores these as strings; they cannot participate in the
-        # numeric distance. We reject them in feature columns at load time.
-        raise ArffError(
-            path, lineno, f"attribute '{attr.name}' of type {attr.type} is not numeric"
-        )
+        # Intern in first-seen order (module docstring): the cell stores the
+        # code; the table lands on attr.string_values after the parse.
+        return float(intern.setdefault(tok, len(intern)))
     try:
         return _strtof(tok)
     except ValueError:
@@ -248,9 +259,12 @@ def parse_arff_lines(
 ) -> Dataset:
     relation = ""
     attributes: list = []
+    interns: list = []  # per-attribute first-seen intern maps (string/date)
     rows: list = []
     in_data = False
-    pending: list = []  # cells carried across physical lines (multi-line rows)
+    # (cell, lineno) pairs carried across physical lines (multi-line rows);
+    # carrying the lineno keeps error locations on the token's own line.
+    pending: list = []
 
     for lineno, raw in enumerate(lines, start=1):
         # '%' starts a comment only at the true line start (the reference
@@ -282,6 +296,7 @@ def parse_arff_lines(
                     relation = relation[1:-1]
             elif key == "@attribute":
                 attributes.append(_parse_attribute(rest, path, lineno))
+                interns.append({})
             elif key == "@data":
                 if not attributes:
                     raise ArffError(path, lineno, "@data before any @attribute")
@@ -301,13 +316,14 @@ def parse_arff_lines(
         # (arff_parser.cpp:121-153): rows may span physical lines AND several
         # rows may share one line, so accumulate tokens and emit every full
         # group of num_attributes.
-        pending.extend(cells)
+        pending.extend((tok, lineno) for tok in cells)
         d = len(attributes)
         off = 0
         while len(pending) - off >= d:
             rows.append(
-                [_cell_to_float(tok, attr, path, lineno)
-                 for tok, attr in zip(pending[off : off + d], attributes)]
+                [_cell_to_float(tok, attr, intern, path, tok_line)
+                 for (tok, tok_line), attr, intern in zip(
+                     pending[off : off + d], attributes, interns)]
             )
             off += d
         if off:  # consume emitted rows once per line, like the C++ twin
@@ -316,6 +332,9 @@ def parse_arff_lines(
 
     if not attributes:
         raise ArffError(path, 0, "no @attribute declarations found")
+    for attr, intern in zip(attributes, interns):
+        if attr.type in ("string", "date"):
+            attr.string_values = list(intern)  # insertion order = code order
 
     d = len(attributes)
     if rows:

@@ -202,8 +202,15 @@ class KNNClassifier:
         train.validate_for_knn(self.k, test)
         return _kneighbors_arrays(
             train.features, test.features, self.k, metric=self.metric,
-            engine=self.backend_opts.get("engine", "auto"),
+            engine=self._retrieval_engine(),
         )
+
+    def _retrieval_engine(self) -> str:
+        """The backend ``engine`` opt translated for the candidate kernel:
+        ring-only per-step scorers ('full'/'tiled') have no retrieval
+        counterpart, so they defer to auto selection."""
+        engine = self.backend_opts.get("engine", "auto")
+        return "auto" if engine in ("full", "tiled") else engine
 
     def radius_neighbors(
         self, test: Dataset, radius: float, max_neighbors: int = 128
@@ -214,7 +221,7 @@ class KNNClassifier:
         train.validate_for_knn(1, test)
         return radius_neighbors_arrays(
             train.features, test.features, radius, max_neighbors, self.metric,
-            engine=self.backend_opts.get("engine", "auto"),
+            engine=self._retrieval_engine(),
         )
 
     def predict_proba(self, test: Dataset) -> np.ndarray:

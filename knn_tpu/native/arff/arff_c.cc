@@ -13,8 +13,13 @@
 // attribute types, single/double-quoted values, '?' missing -> NaN, rows may
 // span physical lines (the token-stream reader consumes exactly
 // num_attributes values per instance, arff_parser.cpp:121-153), a partial row
-// at EOF is discarded, sparse rows are rejected. Errors carry file:line
-// context like libarff's THROW (arff_utils.cpp:8-20).
+// at EOF is discarded, sparse rows are rejected. STRING/DATE data cells
+// intern to first-seen float32 codes (tables exported per attribute).
+// Deliberate deviation (shared with the Python twin, see pyarff docstring):
+// a quoted value may NOT span physical lines here, where the reference's
+// _read_str reads through newlines (arff_lexer.cpp:159-188). Errors carry
+// file:line context like libarff's THROW (arff_utils.cpp:8-20), citing the
+// token's own line for multi-line rows.
 //
 // C ABI only — bound from Python via ctypes (no pybind11 in this image).
 
@@ -25,6 +30,8 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <unordered_map>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -33,6 +40,13 @@ struct Attr {
   std::string name;
   std::string type;  // "numeric" | "string" | "date" | "nominal"
   std::vector<std::string> nominal;
+  // STRING/DATE cell interning (first-seen order): the dense matrix stores
+  // the code, `interned` is the code->value table. The reference keeps heap
+  // strings per cell (arff_value.cpp:33-48) and only fails when its KNN
+  // kernel reads one as float (arff_value.cpp:121) — so these files LOAD
+  // there and must load here; the numeric-only check moves to predict time.
+  std::vector<std::string> interned;
+  std::unordered_map<std::string, int> intern_idx;
 };
 
 struct ParseState {
@@ -196,7 +210,7 @@ bool parse_attribute(const std::string& rest_in, ParseState& st) {
   return true;
 }
 
-bool cell_to_float(const std::string& tok, const Attr& attr, float* out,
+bool cell_to_float(const std::string& tok, Attr& attr, float* out,
                    ParseState& st) {
   if (tok == "?") {
     *out = NAN;
@@ -212,9 +226,10 @@ bool cell_to_float(const std::string& tok, const Attr& attr, float* out,
     return false;
   }
   if (attr.type == "string" || attr.type == "date") {
-    fail(st, "attribute '" + attr.name + "' of type " + attr.type +
-                 " is not numeric");
-    return false;
+    auto ins = attr.intern_idx.emplace(tok, (int)attr.interned.size());
+    if (ins.second) attr.interned.push_back(tok);
+    *out = (float)ins.first->second;
+    return true;
   }
   char* endp = nullptr;
   *out = strtof(tok.c_str(), &endp);
@@ -228,7 +243,9 @@ bool cell_to_float(const std::string& tok, const Attr& attr, float* out,
 bool parse_buffer(const std::string& data, ParseState& st) {
   size_t pos = 0;
   bool in_data = false;
-  std::vector<std::string> pending;  // cells carried across physical lines
+  // (cell, lineno) carried across physical lines (multi-line rows); the
+  // lineno keeps error locations on the token's own line.
+  std::vector<std::pair<std::string, int>> pending;
   std::vector<std::string> cells;
   while (pos <= data.size()) {
     size_t nl = data.find('\n', pos);
@@ -284,18 +301,21 @@ bool parse_buffer(const std::string& data, ParseState& st) {
     // (arff_parser.cpp:121-153): rows may span physical lines AND several
     // rows may share one line, so accumulate tokens and emit every full
     // group of num_attributes.
-    pending.insert(pending.end(), cells.begin(), cells.end());
+    for (const std::string& c : cells) pending.emplace_back(c, st.line);
     size_t d = st.attrs.size();
     size_t off = 0;  // offset walk: one erase per line, not per row
+    int cur_line = st.line;
     while (pending.size() - off >= d) {
       for (size_t j = 0; j < d; ++j) {
         float v;
-        if (!cell_to_float(pending[off + j], st.attrs[j], &v, st))
+        st.line = pending[off + j].second;  // cite the token's own line
+        if (!cell_to_float(pending[off + j].first, st.attrs[j], &v, st))
           return false;
         st.cells.push_back(v);
       }
       off += d;
     }
+    st.line = cur_line;
     if (off) pending.erase(pending.begin(), pending.begin() + off);
   }
   // A partial row at EOF is discarded (arff_parser.cpp:130-133).
@@ -423,6 +443,16 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
         if (v) j += ",";
         j += "\"";
         json_escape(st.attrs[a].nominal[v], j);
+        j += "\"";
+      }
+      j += "]";
+    }
+    if (st.attrs[a].type == "string" || st.attrs[a].type == "date") {
+      j += ",\"string_values\":[";
+      for (size_t v = 0; v < st.attrs[a].interned.size(); ++v) {
+        if (v) j += ",";
+        j += "\"";
+        json_escape(st.attrs[a].interned[v], j);
         j += "\"";
       }
       j += "]";

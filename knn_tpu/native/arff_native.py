@@ -72,7 +72,10 @@ def parse(path: str) -> Dataset:
         raw_targets = np.ctypeslib.as_array(res.raw_targets, shape=(n,)).copy() \
             if n else np.zeros((n,), np.float32)
         attrs = [
-            Attribute(a["name"], a["type"], a.get("nominal_values"))
+            Attribute(
+                a["name"], a["type"], a.get("nominal_values"),
+                a.get("string_values"),
+            )
             for a in json.loads(res.attrs_json.decode() if res.attrs_json else "[]")
         ]
         return Dataset(

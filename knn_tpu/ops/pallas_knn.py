@@ -365,6 +365,19 @@ def knn_pallas_stripe_candidates(
     return _merge_topk_rounds(cand_d, cand_i, k)
 
 
+def _resolve_stripe_precision(precision: str, d: int) -> str:
+    """One contract for the stripe host entries (ADVICE r1): ``auto``
+    resolves the same way backends/pallas.py does — exact for narrow
+    features, fast for wide — instead of being rejected as unknown."""
+    if precision == "auto":
+        return "exact" if d <= 128 else "fast"
+    if precision not in ("exact", "fast", "bf16"):
+        raise ValueError(
+            f"unknown precision {precision!r}; choose auto, exact, fast, or bf16"
+        )
+    return precision
+
+
 def stripe_auto_eligible(precision: str, d: int, k: int) -> bool:
     """THE auto-engine rule, shared by every dispatch point (single-device
     backend, kneighbors, the three distributed paths): route to the
@@ -510,6 +523,7 @@ def stripe_candidates_arrays(
         interpret = jax.default_backend() != "tpu"
     n, d_true = train_x.shape
     q = test_x.shape[0]
+    precision = _resolve_stripe_precision(precision, d_true)
     block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
     txT, d_pad = stripe_prepare_train(train_x, block_n)
     qx = stripe_prepare_queries(test_x, block_q, d_pad)
@@ -573,11 +587,10 @@ def stripe_classify_arrays(
     dispatch (the tpu backend routes here; the bench scripts drive the raw
     jit directly for pipelined timing). ``interpret`` defaults to on for
     non-TPU platforms so the same path is testable on CPU; ``max_rows``
-    caps the per-call query rows (e.g. a caller's query_batch)."""
-    if precision not in ("exact", "fast", "bf16"):
-        raise ValueError(
-            f"unknown precision {precision!r}; choose exact, fast, or bf16"
-        )
+    caps the per-call query rows (e.g. a caller's query_batch).
+    ``precision="auto"`` resolves like backends/pallas.py: exact for narrow
+    features (the stripe kernel's home turf), fast for wide."""
+    precision = _resolve_stripe_precision(precision, train_x.shape[1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     q = test_x.shape[0]
@@ -641,16 +654,12 @@ def predict_pallas(
         interpret = jax.default_backend() != "tpu"
     n, q = train_x.shape[0], test_x.shape[0]
     d_true = train_x.shape[1]
+    precision = _resolve_stripe_precision(precision, d_true)
     if engine == "auto":
         engine = (
             "stripe"
             if precision == "exact" and d_true <= 64 and k <= 16
             else "merge"
-        )
-
-    if precision not in ("exact", "fast", "bf16"):
-        raise ValueError(
-            f"unknown precision {precision!r}; choose exact, fast, or bf16"
         )
     if engine == "stripe":
         _, idx = stripe_candidates_arrays(

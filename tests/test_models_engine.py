@@ -64,6 +64,19 @@ class TestModelEngineRouting:
         np.testing.assert_array_equal(i_s, i_x)
         np.testing.assert_array_equal(d_s, d_x)
 
+    def test_ring_engine_opt_does_not_break_retrieval(self, rng):
+        # engine='tiled'/'full' are ring-only per-step scorers; model
+        # retrieval must translate them to auto, not crash.
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        m = KNNClassifier(k=5, backend="tpu-ring", engine="tiled").fit(train)
+        want = KNNClassifier(k=5).fit(train)
+        np.testing.assert_array_equal(
+            m.kneighbors(test)[1], want.kneighbors(test)[1]
+        )
+        assert m.predict_proba(test).shape == (len(test_x), train.num_classes)
+
     def test_weighted_vote_accepts_engine(self, rng):
         train_x, train_y, test_x, c = _tie_problem(rng)
         train = Dataset(train_x, train_y)

@@ -1,0 +1,174 @@
+"""STRING/DATE data-cell ingest (VERDICT r1 #2).
+
+The reference's instance reader accepts string-valued tokens in data rows
+(libarff/arff_parser.cpp:145-147, string ctor arff_value.cpp:33-48) and only
+fails when the KNN kernel reads one as float (arff_value.cpp:121 —
+"operator float cannot work on type 'STRING'!"). So a file with STRING/DATE
+columns must LOAD here too: cells intern to first-seen float32 codes with the
+table on ``Attribute.string_values``, and the numeric-only requirement is
+deferred to ``Dataset.validate_for_knn``.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from knn_tpu.data import pyarff
+from knn_tpu.data.arff import load_arff, write_arff
+from knn_tpu.data.dataset import Dataset
+
+STRING_FILE = """@relation logs
+@attribute host STRING
+@attribute latency NUMERIC
+@attribute when DATE
+@attribute class NUMERIC
+@data
+web1,1.5,2021-01-01,0
+web2,2.5,2021-01-02,1
+web1,3.5,2021-01-01,0
+'web 3',4.5,2021-01-03,1
+"""
+
+
+@pytest.fixture()
+def native_arff():
+    return pytest.importorskip(
+        "knn_tpu.native.arff_native",
+        reason="native arff lib not built (run `make native`)",
+    )
+
+
+def parse_py(text: str):
+    return pyarff.parse_arff_lines(text.splitlines(), path="<test>")
+
+
+class TestStringIngest:
+    def test_string_cells_intern_first_seen(self):
+        ds = parse_py(STRING_FILE)
+        # host codes: web1=0, web2=1, 'web 3'=2 (first-seen order).
+        np.testing.assert_array_equal(ds.features[:, 0], [0, 1, 0, 2])
+        assert ds.attributes[0].string_values == ["web1", "web2", "web 3"]
+        # date codes likewise.
+        np.testing.assert_array_equal(ds.features[:, 2], [0, 1, 0, 2])
+        assert ds.attributes[2].string_values == [
+            "2021-01-01", "2021-01-02", "2021-01-03",
+        ]
+        # numeric column untouched.
+        np.testing.assert_array_equal(ds.features[:, 1], [1.5, 2.5, 3.5, 4.5])
+        np.testing.assert_array_equal(ds.labels, [0, 1, 0, 1])
+
+    def test_missing_string_cell_is_nan(self):
+        ds = parse_py(
+            "@relation r\n@attribute s STRING\n@attribute class NUMERIC\n"
+            "@data\n?,0\nx,1\n"
+        )
+        assert math.isnan(ds.features[0, 0])
+        assert ds.features[1, 0] == 0.0
+        assert ds.attributes[0].string_values == ["x"]
+
+    def test_string_class_column_classifies_by_code(self):
+        # Framework extension: interned codes are well-defined class ids
+        # (the reference aborts on the label cast, main.cpp:57).
+        ds = parse_py(
+            "@relation r\n@attribute x NUMERIC\n@attribute label STRING\n"
+            "@data\n1,cat\n2,dog\n3,cat\n"
+        )
+        np.testing.assert_array_equal(ds.labels, [0, 1, 0])
+        assert ds.num_classes == 2
+        assert ds.attributes[1].string_values == ["cat", "dog"]
+        ds.validate_for_knn(1)  # string CLASS is fine; features are numeric
+
+    def test_predict_rejects_string_features(self):
+        ds = parse_py(STRING_FILE)
+        with pytest.raises(ValueError, match="'host' of type string"):
+            ds.validate_for_knn(1)
+
+    def test_predict_rejects_date_features(self):
+        ds = parse_py(
+            "@relation r\n@attribute d DATE\n@attribute class NUMERIC\n"
+            "@data\n2020-01-01,0\n"
+        )
+        with pytest.raises(ValueError, match="'d' of type date"):
+            ds.validate_for_knn(1)
+
+    def test_cli_clean_error_on_string_features(self, tmp_path, capsys):
+        from knn_tpu.cli import run
+
+        p = tmp_path / "s.arff"
+        p.write_text(STRING_FILE)
+        assert run([str(p), str(p), "1", "--backend", "oracle"]) == 1
+        err = capsys.readouterr().err
+        assert "error:" in err and "host" in err
+
+    def test_native_parser_parity(self, native_arff, tmp_path):
+        p = tmp_path / "s.arff"
+        p.write_text(STRING_FILE)
+        nat = native_arff.parse(str(p))
+        py = pyarff.parse_arff_file(str(p))
+        np.testing.assert_array_equal(nat.features, py.features)
+        np.testing.assert_array_equal(nat.labels, py.labels)
+        assert [a.string_values for a in nat.attributes] == [
+            a.string_values for a in py.attributes
+        ]
+
+    def test_write_arff_roundtrip(self, tmp_path):
+        ds = parse_py(STRING_FILE)
+        out = tmp_path / "rt.arff"
+        write_arff(ds, str(out))
+        back = load_arff(str(out), use_native=False)
+        np.testing.assert_array_equal(back.features, ds.features)
+        np.testing.assert_array_equal(back.labels, ds.labels)
+        assert [a.string_values for a in back.attributes] == [
+            a.string_values for a in ds.attributes
+        ]
+
+    def test_write_arff_roundtrips_apostrophes(self, tmp_path):
+        # Neither parser dialect has backslash escapes; the writer must pick
+        # the other quote char for values containing one.
+        ds = parse_py(
+            '@relation r\n@attribute who STRING\n@attribute class NUMERIC\n'
+            '@data\n"O\'Brien",0\nplain,1\n'
+        )
+        assert ds.attributes[0].string_values == ["O'Brien", "plain"]
+        out = tmp_path / "apos.arff"
+        write_arff(ds, str(out))
+        back = load_arff(str(out), use_native=False)
+        assert back.attributes[0].string_values == ["O'Brien", "plain"]
+        np.testing.assert_array_equal(back.features, ds.features)
+
+    def test_write_arff_rejects_unrepresentable_value(self, tmp_path):
+        # Adjacent quoted runs concatenate into one token, so "a'b"'c"d'
+        # yields a value holding BOTH quote chars — representable on input,
+        # not on output (the dialect has no escape syntax).
+        ds = parse_py(
+            "@relation r\n@attribute who STRING\n@attribute class NUMERIC\n"
+            "@data\n\"a'b\"'c\"d',0\n"
+        )
+        assert ds.attributes[0].string_values == ["a'bc\"d"]
+        with pytest.raises(ValueError, match="both quote"):
+            write_arff(ds, str(tmp_path / "nope.arff"))
+
+    def test_multiline_row_error_cites_token_line(self):
+        # ADVICE r1: a bad value carried from line N must be reported on
+        # line N, not on the line that completed the row group.
+        text = (
+            "@relation r\n@attribute x NUMERIC\n@attribute y NUMERIC\n"
+            "@attribute class NUMERIC\n@data\n"
+            "1,bogus,\n"   # line 6: the bad token
+            "0\n"          # line 7: completes the row
+        )
+        with pytest.raises(pyarff.ArffError) as ei:
+            parse_py(text)
+        assert "<test>:6:" in str(ei.value)
+
+    def test_cache_roundtrips_string_tables(self, tmp_path, monkeypatch):
+        p = tmp_path / "s.arff"
+        p.write_text(STRING_FILE)
+        monkeypatch.setenv("KNN_TPU_ARFF_CACHE", str(tmp_path / "cache"))
+        first = load_arff(str(p), use_native=False)
+        cached = load_arff(str(p), use_native=False)  # hits the npz cache
+        np.testing.assert_array_equal(cached.features, first.features)
+        assert [a.string_values for a in cached.attributes] == [
+            a.string_values for a in first.attributes
+        ]
