@@ -117,7 +117,11 @@ def _quote(value: str) -> str:
 
 
 def _quote_if_needed(name: str) -> str:
-    if name and not any(c.isspace() for c in name) and "," not in name \
+    # Leading %, { or @ must be quoted: a bare value opening a data line
+    # re-reads as a comment, a sparse row, or a header directive (r2 review —
+    # '%pct,0' written unquoted silently drops the row as a comment).
+    if name and name[0] not in "%{@" \
+            and not any(c.isspace() for c in name) and "," not in name \
             and "'" not in name and '"' not in name:
         return name
     return _quote(name)
@@ -144,9 +148,22 @@ def write_arff(ds: Dataset, path: str) -> None:
             f"columns + 1 class column"
         )
 
+    def data_value(raw: str) -> str:
+        # A value equal to "?" cannot round-trip: the dialect strips quotes
+        # before the missing-value check (both our parsers and the reference
+        # lexer, arff_lexer.cpp:159-188), so even '?' reads back as missing.
+        # Raise like _quote's both-quotes case rather than silently writing
+        # a cell that re-ingests as NaN and shifts every later intern code.
+        if raw == "?":
+            raise ValueError(
+                'the value "?" cannot be represented in the ARFF dialect: '
+                "quoted or not, it parses back as a missing value"
+            )
+        return _quote_if_needed(raw)
+
     def attr_line(a: Attribute) -> str:
         if a.type == "nominal":
-            vals = ",".join(a.nominal_values or [])
+            vals = ",".join(data_value(v) for v in (a.nominal_values or []))
             return f"@attribute {_quote_if_needed(a.name)} {{{vals}}}"
         return f"@attribute {_quote_if_needed(a.name)} {a.type.upper()}"
 
@@ -154,11 +171,12 @@ def write_arff(ds: Dataset, path: str) -> None:
         if np.isnan(value):
             return "?"
         if a.type == "nominal" and a.nominal_values:
-            return str(a.nominal_values[int(value)])
+            # Quote when needed so values with spaces/commas survive.
+            return data_value(str(a.nominal_values[int(value)]))
         if a.type in ("string", "date") and a.string_values:
             # Interned code -> original value, quoted so embedded
             # spaces/commas survive the round trip.
-            return _quote(str(a.string_values[int(value)]))
+            return data_value(str(a.string_values[int(value)]))
         f = float(value)
         return str(int(f)) if f.is_integer() else repr(f)
 

@@ -190,6 +190,7 @@ def knn_pallas_candidates(
 def _knn_stripe_kernel(
     n_valid_ref, q_ref, tT_ref, out_d_ref, out_i_ref, cand_d_ref, cand_i_ref,
     *, k: int, block_n: int, d_true: int, n_tiles: int, precision: str = "exact",
+    lite_retire: bool = False,
 ):
     """Lane-striped KNN tile kernel (exact subtraction-form distance by
     default; ``precision="fast"/"bf16"`` swaps in the MXU matmul expansion).
@@ -240,15 +241,23 @@ def _knn_stripe_kernel(
             preferred_element_type=jnp.float32,
         )
         d_full = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+        d_full = jnp.where(jnp.isnan(d_full), jnp.inf, d_full)
+        chunk_d = [d_full[:, c * lanes : (c + 1) * lanes] for c in range(g)]
     else:
-        # Exact subtraction-form distance for the whole tile, accumulated over
-        # feature planes in source order: [BQ,1] lane-broadcast minus [1,BN]
-        # sublane-broadcast per feature.
-        d_full = jnp.zeros((bq, block_n), jnp.float32)
-        for f in range(d_true):
-            diff = q[:, f : f + 1] - tT_ref[f, :].reshape(1, block_n)
-            d_full = d_full + diff * diff
-    d_full = jnp.where(jnp.isnan(d_full), jnp.inf, d_full)
+        # Exact subtraction-form distance, accumulated over feature planes in
+        # source order: [BQ,1] lane-broadcast minus [1,128] sublane-broadcast
+        # per feature. Computed PER 128-LANE CHUNK (same element order, so
+        # bit-identical to a whole-tile accumulation) — a single [BQ, BN]
+        # accumulator is ~3.7 MB of extra Mosaic stack at the default blocks,
+        # which together with the lite rounds' longer-lived index planes
+        # pushes past the 16 MB scoped-VMEM limit.
+        chunk_d = []
+        for c in range(g):
+            dc = jnp.zeros((bq, lanes), jnp.float32)
+            for f in range(d_true):
+                diff = q[:, f : f + 1] - tT_ref[f, c * lanes : (c + 1) * lanes].reshape(1, lanes)
+                dc = dc + diff * diff
+            chunk_d.append(jnp.where(jnp.isnan(dc), jnp.inf, dc))
 
     # Selection planes: the g tile chunks plus the k running candidate levels.
     # Index planes stay [BQ, 128] (a [BQ, BN] iota next to the broadcast
@@ -259,9 +268,7 @@ def _knn_stripe_kernel(
     for c in range(g):
         gcol = i128 + (j * block_n + c * lanes)
         valid = gcol < nv
-        d_planes.append(
-            jnp.where(valid, d_full[:, c * lanes : (c + 1) * lanes], jnp.inf)
-        )
+        d_planes.append(jnp.where(valid, chunk_d[c], jnp.inf))
         i_planes.append(jnp.where(valid, gcol, _INT_MAX))
     d_planes += [cand_d_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
     i_planes += [cand_i_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
@@ -285,7 +292,30 @@ def _knn_stripe_kernel(
             for p in range(len(d_planes)):
                 taken = i_planes[p] == m_i
                 d_planes[p] = jnp.where(taken, jnp.inf, d_planes[p])
-                i_planes[p] = jnp.where(taken, _INT_MAX, i_planes[p])
+                if not lite_retire:
+                    # Index retirement only matters once a round's minimum is
+                    # +inf: the index pass then re-selects the smallest
+                    # already-taken STALE index instead of INT_MAX, so deeper
+                    # levels hold duplicate (inf, i) pairs — and a retired
+                    # finite element's index can be smaller than the lane's
+                    # true minimum inf-distance index, hijacking the inf tail
+                    # (e.g. finite rows 0 and 128 in one lane, the rest NaN,
+                    # k=3: the lite rounds emit [0, 128, 0] where full
+                    # retirement emits the correct [0, 128, 1]).
+                    #
+                    # lite_retire is therefore only set when the caller
+                    # guarantees every VALID element's distance is finite
+                    # (host gate: stripe_inputs_finite — no NaN and no f32
+                    # overflow). Then a lane's inf levels are reached only
+                    # after its valid elements are exhausted, the duplicates
+                    # carry (inf, i) keys, and the final merge never looks at
+                    # them: with k <= n all-finite valid elements, the union
+                    # of per-lane lists holds >= k finite candidates, so all
+                    # k extraction rounds of _merge_topk_rounds extract at
+                    # m < inf. Skipping the write is one fewer VPU op per
+                    # plane per round — ~16% off the whole headline step on
+                    # v5e (VERDICT r1 #8, scripts/tune_stripe_selection.py).
+                    i_planes[p] = jnp.where(taken, _INT_MAX, i_planes[p])
 
     @pl.when(j == n_tiles - 1)
     def _writeback():
@@ -295,7 +325,10 @@ def _knn_stripe_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "block_q", "block_n", "interpret", "d_true", "precision"),
+    static_argnames=(
+        "k", "block_q", "block_n", "interpret", "d_true", "precision",
+        "assume_finite",
+    ),
 )
 def knn_pallas_stripe_candidates(
     train_xT: jnp.ndarray,
@@ -307,11 +340,15 @@ def knn_pallas_stripe_candidates(
     interpret: bool = False,
     d_true: Optional[int] = None,
     precision: str = "exact",
+    assume_finite: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Lane-striped kernel entry. ``train_xT`` is the TRANSPOSED train
     matrix ``[D_pad, N_pad]`` (N padded to ``block_n``, D padded to a sublane
     multiple); ``test_x`` is ``[Q_pad, D_pad]``. Returns ``([Q,k] dists,
-    [Q,k] int32 global indices)`` sorted ascending by (distance, index)."""
+    [Q,k] int32 global indices)`` sorted ascending by (distance, index).
+    ``assume_finite`` — set ONLY when :func:`stripe_inputs_finite` holds for
+    the unpadded inputs — selects the cheaper index-retirement-free selection
+    rounds (see the exactness argument in _knn_stripe_kernel)."""
     d_pad, n_pad = train_xT.shape
     q_pad = test_x.shape[0]
     assert n_pad % block_n == 0 and q_pad % block_q == 0 and block_n % 128 == 0
@@ -325,6 +362,7 @@ def knn_pallas_stripe_candidates(
         d_true=d_true if d_true is not None else d_pad,
         n_tiles=grid[1],
         precision=precision,
+        lite_retire=assume_finite,
     )
     cand_d, cand_i = pl.pallas_call(
         kernel,
@@ -376,6 +414,33 @@ def _resolve_stripe_precision(precision: str, d: int) -> str:
             f"unknown precision {precision!r}; choose auto, exact, fast, or bf16"
         )
     return precision
+
+
+def stripe_inputs_finite(*arrays: np.ndarray) -> bool:
+    """Host-side gate for the kernel's ``assume_finite`` fast path: True when
+    every array is NaN/inf-free AND small enough in magnitude that no squared
+    euclidean distance can overflow f32 to +inf. Under that guarantee every
+    valid element's distance is finite, so the selection rounds may skip
+    index retirement (see _knn_stripe_kernel). The scan is a few hundred
+    microseconds on the headline config — noise next to one kernel step."""
+    limit = None
+    for a in arrays:
+        if a.size == 0:
+            continue
+        if limit is None:
+            # |q_f - t_f|^2 summed over d features stays < FLT_MAX when every
+            # value's magnitude is below sqrt(FLT_MAX / (4 d)); the extra
+            # factor of 2 is headroom for f32 accumulation rounding, which
+            # can carry a sum sitting exactly at the bound past FLT_MAX
+            # (r2 review — reproduced at d=784 with values at the unpadded
+            # limit). Rounding inflates a d-term sum by at most
+            # (1 + 2^-24)^d, so 2x slack holds for any representable d.
+            d = a.shape[-1] if a.ndim > 1 else 1
+            limit = float(np.sqrt(np.finfo(np.float32).max / (8.0 * max(d, 1))))
+        m = float(np.max(np.abs(a), initial=0.0))  # NaN propagates -> not finite
+        if not np.isfinite(m) or m >= limit:
+            return False
+    return True
 
 
 def stripe_auto_eligible(precision: str, d: int, k: int) -> bool:
@@ -440,6 +505,7 @@ def stripe_candidates_core(
     precision: str = "exact",
     interpret: bool = False,
     index_base: "int | jnp.ndarray" = 0,
+    assume_finite: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Label-carrying candidate triple from the lane-striped kernel, for use
     *inside* jit/shard_map (device arrays in, device arrays out, no host
@@ -459,7 +525,7 @@ def stripe_candidates_core(
     d, li = knn_pallas_stripe_candidates(
         train_xT, test_x, n_valid, k,
         block_q=block_q, block_n=block_n, interpret=interpret,
-        d_true=d_true, precision=precision,
+        d_true=d_true, precision=precision, assume_finite=assume_finite,
     )
     safe = jnp.minimum(li, train_y.shape[0] - 1)
     lbl = train_y[safe]
@@ -531,6 +597,7 @@ def stripe_candidates_arrays(
         jnp.asarray(txT), jnp.asarray(qx), n, k,
         block_q=block_q, block_n=block_n, interpret=interpret, d_true=d_true,
         precision=precision,
+        assume_finite=stripe_inputs_finite(train_x, test_x),
     )
     return np.asarray(d)[:q], np.asarray(idx)[:q]
 
@@ -539,7 +606,7 @@ def stripe_candidates_arrays(
     jax.jit,
     static_argnames=(
         "k", "num_classes", "block_q", "block_n", "d_true", "interpret",
-        "precision",
+        "precision", "assume_finite",
     ),
 )
 def knn_stripe_classify(
@@ -554,6 +621,7 @@ def knn_stripe_classify(
     d_true: Optional[int] = None,
     interpret: bool = False,
     precision: str = "exact",
+    assume_finite: bool = False,
 ) -> jnp.ndarray:
     """One-dispatch classify on pre-padded device arrays: stripe kernel +
     lexicographic merge + vote, fused under a single jit. The headline exact
@@ -563,7 +631,7 @@ def knn_stripe_classify(
     _, idx = knn_pallas_stripe_candidates(
         train_xT, test_x, n_valid, k,
         block_q=block_q, block_n=block_n, interpret=interpret, d_true=d_true,
-        precision=precision,
+        precision=precision, assume_finite=assume_finite,
     )
     safe = jnp.minimum(idx, train_y.shape[0] - 1)
     return vote(train_y[safe], num_classes)
@@ -596,6 +664,7 @@ def stripe_classify_arrays(
     q = test_x.shape[0]
     if q == 0:
         return np.empty(0, np.int32)
+    assume_finite = stripe_inputs_finite(train_x, test_x)
     block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
     txT, d_pad = stripe_prepare_train(train_x, block_n)
     tyj = jnp.asarray(train_y)
@@ -619,6 +688,7 @@ def stripe_classify_arrays(
             txTj, tyj, jnp.asarray(qx), nv, k=k, num_classes=num_classes,
             block_q=block_q, block_n=block_n, d_true=train_x.shape[1],
             interpret=interpret, precision=precision,
+            assume_finite=assume_finite,
         ))
         sizes.append(chunk.shape[0])
         if len(pending) > window:

@@ -87,6 +87,7 @@ def build_ring_fn(
     block_n: int = 2048,
     d_true: Optional[int] = None,
     interpret: bool = False,
+    assume_finite: bool = False,
 ):
     """fn(train, train_y, test_x, n_train_valid) -> preds; train and test both
     sharded over ``axis``. For ``engine="stripe"`` the train argument is the
@@ -111,6 +112,7 @@ def build_ring_fn(
                     block_q=block_q, block_n=block_n,
                     d_true=d_true if d_true is not None else cur_t.shape[0],
                     precision=precision, interpret=interpret, index_base=base,
+                    assume_finite=assume_finite,
                 )
             if engine == "tiled":
                 return forward_candidates_core(
@@ -175,7 +177,7 @@ def build_ring_fn(
 @functools.lru_cache(maxsize=None)
 def _cached_fn(
     n_dev, k, num_classes, precision, engine, query_tile, train_tile,
-    block_q, block_n, d_true, interpret,
+    block_q, block_n, d_true, interpret, assume_finite=False,
 ):
     # Cache the jitted shard_map closure so repeat predicts (and --warmup)
     # reuse XLA's compile cache instead of retracing a fresh closure.
@@ -184,6 +186,7 @@ def _cached_fn(
         mesh, k, num_classes, precision,
         engine=engine, query_tile=query_tile, train_tile=train_tile,
         block_q=block_q, block_n=block_n, d_true=d_true, interpret=interpret,
+        assume_finite=assume_finite,
     )
 
 
@@ -209,7 +212,9 @@ def predict_ring(
     )
 
     if engine == "stripe":
-        from knn_tpu.ops.pallas_knn import stripe_prepare_sharded
+        from knn_tpu.ops.pallas_knn import (
+            stripe_inputs_finite, stripe_prepare_sharded,
+        )
 
         txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
             train_x, train_y, test_x, k, n_dev, n_dev
@@ -217,6 +222,7 @@ def predict_ring(
         fn = _cached_fn(
             n_dev, k, num_classes, precision, "stripe", query_tile,
             train_tile, block_q, block_n, d, interpret,
+            stripe_inputs_finite(train_x, test_x),
         )
         out = fn(
             jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),

@@ -113,6 +113,7 @@ def build_train_sharded_stripe_fn(
     interpret: bool,
     q_axis: Optional[str] = "q",
     t_axis: str = "t",
+    assume_finite: bool = False,
 ):
     """Stripe-engine variant of :func:`build_train_sharded_fn`: per-shard
     candidates come from the lane-striped Pallas kernel (the single-chip
@@ -137,6 +138,7 @@ def build_train_sharded_stripe_fn(
             train_xT, train_y, test_block, local_valid, k,
             block_q=block_q, block_n=block_n, d_true=d_true,
             precision=precision, interpret=interpret, index_base=base,
+            assume_finite=assume_finite,
         )
         all_d = lax.all_gather(d, t_axis, axis=1, tiled=True)
         all_i = lax.all_gather(gi, t_axis, axis=1, tiled=True)
@@ -167,11 +169,13 @@ def _cached_fn(n_q, n_t, k, num_classes, precision, query_tile, train_tile):
 
 @functools.lru_cache(maxsize=None)
 def _cached_stripe_fn(
-    n_q, n_t, k, num_classes, precision, block_q, block_n, d_true, interpret
+    n_q, n_t, k, num_classes, precision, block_q, block_n, d_true, interpret,
+    assume_finite,
 ):
     mesh = make_mesh_2d(n_q, n_t)
     return build_train_sharded_stripe_fn(
-        mesh, k, num_classes, precision, block_q, block_n, d_true, interpret
+        mesh, k, num_classes, precision, block_q, block_n, d_true, interpret,
+        assume_finite=assume_finite,
     )
 
 
@@ -179,7 +183,7 @@ def _predict_train_sharded_stripe(
     train_x, train_y, test_x, k, num_classes, n_q, n_t, precision,
     block_q=None, block_n=None, interpret=None,
 ):
-    from knn_tpu.ops.pallas_knn import stripe_prepare_sharded
+    from knn_tpu.ops.pallas_knn import stripe_inputs_finite, stripe_prepare_sharded
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -190,7 +194,7 @@ def _predict_train_sharded_stripe(
     )
     fn = _cached_stripe_fn(
         n_q, n_t, k, num_classes, precision, block_q, block_n,
-        train_x.shape[1], interpret,
+        train_x.shape[1], interpret, stripe_inputs_finite(train_x, test_x),
     )
     out = fn(
         jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),

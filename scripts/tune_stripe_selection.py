@@ -1,0 +1,238 @@
+"""Sweep selection-phase variants of the lane-striped kernel (VERDICT r1 #8).
+
+The headline step spends roughly half its time in the per-tile selection
+rounds (k rounds x (g+k) planes x ~6 elementwise ops). This probe measures,
+on the real device, (a) the distance-only floor — what a zero-cost selection
+would give, (b) the current round structure, (c) a cheaper-retirement round
+structure, across block-size configs, so the winning variant can be promoted
+into ops/pallas_knn.py with evidence.
+
+Usage: python scripts/tune_stripe_selection.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import _pipelined_slope, load_large
+
+K = 5
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def make_variant_kernel(sel_mode: str):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(
+        n_valid_ref, q_ref, tT_ref, out_d_ref, out_i_ref, cand_d_ref,
+        cand_i_ref, *, k, block_n, d_true, n_tiles,
+    ):
+        j = pl.program_id(1)
+        lanes = 128
+
+        @pl.when(j == 0)
+        def _init():
+            cand_d_ref[:] = jnp.full(cand_d_ref.shape, jnp.inf, jnp.float32)
+            cand_i_ref[:] = jnp.full(cand_i_ref.shape, _INT_MAX, jnp.int32)
+
+        q = q_ref[:]
+        nv = n_valid_ref[0]
+        bq = q.shape[0]
+        g = block_n // lanes
+
+        d_full = jnp.zeros((bq, block_n), jnp.float32)
+        for f in range(d_true):
+            diff = q[:, f : f + 1] - tT_ref[f, :].reshape(1, block_n)
+            d_full = d_full + diff * diff
+        d_full = jnp.where(jnp.isnan(d_full), jnp.inf, d_full)
+
+        i128 = jax.lax.broadcasted_iota(jnp.int32, (bq, lanes), 1)
+        d_planes, i_planes = [], []
+        for c in range(g):
+            gcol = i128 + (j * block_n + c * lanes)
+            valid = gcol < nv
+            d_planes.append(
+                jnp.where(valid, d_full[:, c * lanes : (c + 1) * lanes], jnp.inf)
+            )
+            i_planes.append(jnp.where(valid, gcol, _INT_MAX))
+
+        if sel_mode == "nosel":
+            # Floor: fold everything into level 0 with a plain min — no
+            # correct selection, just the cheapest possible accumulator
+            # keeping the same memory traffic.
+            m = cand_d_ref[:, :lanes]
+            for p in d_planes:
+                m = jnp.minimum(m, p)
+            cand_d_ref[:, :lanes] = m
+        elif sel_mode == "current":
+            d_planes += [cand_d_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
+            i_planes += [cand_i_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
+            for level in range(k):
+                m_d = d_planes[0]
+                for p in range(1, len(d_planes)):
+                    m_d = jnp.minimum(m_d, d_planes[p])
+                m_i = _INT_MAX * jnp.ones_like(i_planes[0])
+                for p in range(len(d_planes)):
+                    m_i = jnp.minimum(
+                        m_i, jnp.where(d_planes[p] == m_d, i_planes[p], _INT_MAX)
+                    )
+                cand_d_ref[:, level * lanes : (level + 1) * lanes] = m_d
+                cand_i_ref[:, level * lanes : (level + 1) * lanes] = m_i
+                if level + 1 < k:
+                    for p in range(len(d_planes)):
+                        taken = i_planes[p] == m_i
+                        d_planes[p] = jnp.where(taken, jnp.inf, d_planes[p])
+                        i_planes[p] = jnp.where(taken, _INT_MAX, i_planes[p])
+        elif sel_mode == "lite":
+            # Drop the index-retirement write: once an element's distance is
+            # +inf it can only be re-selected in a round whose min is +inf,
+            # which (given >= k valid candidates overall) only produces
+            # duplicate (inf, i) pairs that can never win the final XLA
+            # merge. Saves one where per plane per round.
+            d_planes += [cand_d_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
+            i_planes += [cand_i_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
+            for level in range(k):
+                m_d = d_planes[0]
+                for p in range(1, len(d_planes)):
+                    m_d = jnp.minimum(m_d, d_planes[p])
+                m_i = _INT_MAX * jnp.ones_like(i_planes[0])
+                for p in range(len(d_planes)):
+                    m_i = jnp.minimum(
+                        m_i, jnp.where(d_planes[p] == m_d, i_planes[p], _INT_MAX)
+                    )
+                cand_d_ref[:, level * lanes : (level + 1) * lanes] = m_d
+                cand_i_ref[:, level * lanes : (level + 1) * lanes] = m_i
+                if level + 1 < k:
+                    for p in range(len(d_planes)):
+                        taken = i_planes[p] == m_i
+                        d_planes[p] = jnp.where(taken, jnp.inf, d_planes[p])
+        else:
+            raise ValueError(sel_mode)
+
+        @pl.when(j == n_tiles - 1)
+        def _writeback():
+            out_d_ref[:] = cand_d_ref[:]
+            out_i_ref[:] = cand_i_ref[:]
+
+    return kernel
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("k", "block_q", "block_n", "d_true", "sel_mode"),
+)
+def stripe_variant(train_xT, test_x, n_valid, k, block_q, block_n, d_true, sel_mode):
+    """Variant kernel + the final 128k -> k merge fused in one jit (matching
+    real usage — returning the raw [Q, 128k] candidate buffers as jit outputs
+    makes XLA stack-allocate them in VMEM and OOM at headline block sizes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from knn_tpu.ops.pallas_knn import _merge_topk_rounds
+
+    d_pad, n_pad = train_xT.shape
+    q_pad = test_x.shape[0]
+    grid = (q_pad // block_q, n_pad // block_n)
+    kernel = functools.partial(
+        make_variant_kernel(sel_mode), k=k, block_n=block_n, d_true=d_true,
+        n_tiles=grid[1],
+    )
+    cd, ci = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_q, test_x.shape[1]), lambda i, j, n_ref: (i, 0)),
+                pl.BlockSpec((d_pad, block_n), lambda i, j, n_ref: (0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_q, k * 128), lambda i, j, n_ref: (i, 0)),
+                pl.BlockSpec((block_q, k * 128), lambda i, j, n_ref: (i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, k * 128), jnp.float32),
+                pltpu.VMEM((block_q, k * 128), jnp.int32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, k * 128), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, k * 128), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=False,
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1), test_x, train_xT)
+    if sel_mode == "nosel":
+        return cd[:, :1], ci[:, :1]
+    return _merge_topk_rounds(cd, ci, k)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from knn_tpu.ops.pallas_knn import (
+        _merge_topk_rounds, stripe_prepare_queries, stripe_prepare_train,
+    )
+
+    train, test, _ = load_large()
+    n, d_true = train.features.shape
+    q = test.num_instances
+    print(f"device: {jax.devices()[0].device_kind}; "
+          f"{q} queries x {n} train x {d_true} feats, k={K}", file=sys.stderr)
+
+    # Reference candidates from the shipped kernel for parity checks.
+    from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
+
+    ref_d, ref_i = stripe_candidates_arrays(train.features, test.features, K)
+
+    configs = [(896, 2048), (864, 2048), (448, 4096), (432, 4096), (224, 8192)]
+    for block_q, block_n in configs:
+        txT, d_pad = stripe_prepare_train(train.features, block_n)
+        txj = jnp.asarray(txT)
+        bufs = [
+            jnp.asarray(stripe_prepare_queries(
+                test.features + np.float32(i) * 1e-7, block_q, d_pad))
+            for i in range(8)
+        ]
+        jax.block_until_ready(bufs)
+        nv = jnp.asarray(n, jnp.int32)
+        for mode in ("nosel", "current", "lite"):
+            def step(qb, mode=mode, bq=block_q, bn=block_n):
+                return stripe_variant(txj, qb, nv, K, bq, bn, d_true, mode)
+
+            try:
+                md, mi = step(bufs[0])
+                jax.block_until_ready((md, mi))
+            except Exception as e:
+                print(f"bq={block_q} bn={block_n} {mode:8s} FAILED: "
+                      f"{type(e).__name__}: {str(e)[:120]}")
+                continue
+            ok = "-"
+            if mode != "nosel":
+                ok = bool(
+                    np.array_equal(np.asarray(mi)[:q], ref_i)
+                    and np.allclose(np.asarray(md)[:q], ref_d)
+                )
+            per_step, _ = _pipelined_slope(
+                step, bufs, 50, 200, block_fn=jax.block_until_ready
+            )
+            print(f"bq={block_q} bn={block_n} {mode:8s} "
+                  f"{per_step*1e3:7.3f} ms/step  parity={ok}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

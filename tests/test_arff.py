@@ -233,6 +233,71 @@ class TestWriteArff:
         assert back.relation == "with space"
         assert back.attributes[1].nominal_values == ["red", "green"]
 
+    def test_roundtrip_spaced_nominal_and_string(self, tmp_path):
+        # Nominal/string values with embedded spaces must be quoted in both
+        # the declaration and the data cells or the whitespace tokenizer
+        # splits them on re-read (r2 review).
+        from knn_tpu.data.arff import load_arff, write_arff
+        from knn_tpu.data.dataset import Attribute, Dataset
+
+        ds = Dataset(
+            features=np.array([[0.0, 0.0], [1.0, 1.0]], np.float32),
+            labels=np.array([1, 2], np.int32),
+            attributes=[
+                Attribute("c", "nominal", ["dark red", "pale, blue"]),
+                Attribute("s", "string", string_values=["a b", "x"]),
+                Attribute("class", "numeric"),
+            ],
+        )
+        out = tmp_path / "rt.arff"
+        write_arff(ds, str(out))
+        back = load_arff(str(out))
+        np.testing.assert_array_equal(back.features, ds.features)
+        assert back.attributes[0].nominal_values == ["dark red", "pale, blue"]
+        assert back.attributes[1].string_values == ["a b", "x"]
+
+    def test_roundtrip_comment_and_sparse_lookalike_values(self, tmp_path):
+        # A bare first-column value starting with % re-reads as a comment
+        # (silently dropping the row) and one starting with { as a sparse
+        # row (hard error) — both must be quoted on write (r2 review).
+        from knn_tpu.data.arff import load_arff, write_arff
+        from knn_tpu.data.dataset import Attribute, Dataset
+
+        ds = Dataset(
+            features=np.array([[0.0], [1.0], [2.0]], np.float32),
+            labels=np.array([0, 1, 0], np.int32),
+            attributes=[
+                Attribute("s", "string", string_values=["%pct", "{brace", "@at"]),
+                Attribute("class", "numeric"),
+            ],
+        )
+        out = tmp_path / "rt.arff"
+        write_arff(ds, str(out))
+        back = load_arff(str(out))
+        np.testing.assert_array_equal(back.features, ds.features)
+        assert back.attributes[0].string_values == ["%pct", "{brace", "@at"]
+
+    def test_question_mark_value_unrepresentable(self, tmp_path):
+        # The dialect strips quotes before the missing-value check (same as
+        # the reference lexer), so a string/nominal value "?" cannot survive
+        # a round trip — write_arff must raise instead of silently writing a
+        # cell that re-ingests as NaN and shifts later intern codes
+        # (r2 review).
+        from knn_tpu.data.arff import write_arff
+        from knn_tpu.data.dataset import Attribute, Dataset
+
+        for attr in (
+            Attribute("s", "string", string_values=["?", "x"]),
+            Attribute("c", "nominal", ["?", "x"]),
+        ):
+            ds = Dataset(
+                features=np.array([[0.0], [1.0]], np.float32),
+                labels=np.array([0, 1], np.int32),
+                attributes=[attr, Attribute("class", "numeric")],
+            )
+            with pytest.raises(ValueError, match="missing value"):
+                write_arff(ds, str(tmp_path / "bad.arff"))
+
     def test_attr_mismatch_rejected(self, tmp_path):
         from knn_tpu.data.arff import write_arff
         from knn_tpu.data.dataset import Attribute, Dataset

@@ -102,6 +102,93 @@ class TestPallasKernel:
         )
         np.testing.assert_array_equal(got, want)
 
+    def test_lite_rounds_starved_lanes_match_brute_force(self, rng):
+        # Finite inputs pass the stripe_inputs_finite gate, enabling the
+        # index-retirement-free rounds: lanes whose stripe runs out of valid
+        # elements before level k re-select the same stale index with an
+        # (inf, i) key. With >= k finite candidates globally those
+        # duplicates must never surface: n=70 over 128 lanes starves every
+        # lane (0-1 valid elements each) at k=5.
+        from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
+
+        train_x = rng.integers(0, 5, (70, 4)).astype(np.float32)
+        test_x = rng.integers(0, 5, (9, 4)).astype(np.float32)
+        k = 5
+        d, i = stripe_candidates_arrays(
+            train_x, test_x, k, block_q=8, block_n=128, interpret=True
+        )
+        assert (i < 70).all() and np.isfinite(d).all()
+        bruteforce = ((test_x[:, None, :] - train_x[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(d, np.sort(bruteforce, axis=1)[:, :k], rtol=1e-5)
+
+    def test_same_lane_finite_rows_nan_rest_full_retirement(self):
+        # Regression (r2 review): with index retirement skipped, a retired
+        # finite element's STALE index can hijack the inf tail — finite rows
+        # 0 and 128 share a lane, everything else NaN, k=3 at the origin
+        # gives [0, 128, 0] under lite rounds instead of the correct
+        # [0, 128, 1]. stripe_inputs_finite must detect the NaNs and route
+        # to full retirement.
+        from knn_tpu.ops.pallas_knn import (
+            stripe_candidates_arrays, stripe_inputs_finite,
+        )
+
+        n, d, k = 140, 3, 3
+        train_x = np.full((n, d), np.nan, np.float32)
+        train_x[0] = 1.0
+        train_x[128] = 2.0  # same 128-lane as row 0
+        test_x = np.zeros((2, d), np.float32)
+        assert not stripe_inputs_finite(train_x, test_x)
+        dists, idx = stripe_candidates_arrays(
+            train_x, test_x, k, block_q=8, block_n=128, interpret=True
+        )
+        for qi in range(2):
+            np.testing.assert_array_equal(idx[qi], [0, 128, 1])
+            assert np.isinf(dists[qi][2])
+
+    def test_stripe_inputs_finite_gate(self):
+        from knn_tpu.ops.pallas_knn import stripe_inputs_finite
+
+        ok = np.ones((5, 4), np.float32)
+        assert stripe_inputs_finite(ok, ok)
+        bad = ok.copy()
+        bad[2, 1] = np.nan
+        assert not stripe_inputs_finite(ok, bad)
+        huge = ok * np.float32(1e19)  # squared distances overflow f32
+        assert not stripe_inputs_finite(huge, ok)
+        # Boundary: values at the no-rounding-headroom bound sqrt(FLT_MAX/4d)
+        # can overflow through f32 accumulation rounding at wide d — the gate
+        # must reject them (r2 review, reproduced at d=784).
+        d = 784
+        at_bound = np.full(
+            (4, d), np.sqrt(np.finfo(np.float32).max / (4 * d)), np.float32
+        )
+        assert not stripe_inputs_finite(at_bound, -at_bound)
+
+    def test_nan_heavy_inf_tail_is_index_ordered(self):
+        # NaN inputs fail the stripe_inputs_finite gate, so the kernel runs
+        # full index retirement and the inf tail must be the smallest
+        # NaN-row indices in index order, per the SURVEY.md §3.5.5 NaN
+        # policy: 300 rows over >2 lane planes, only two finite rows, k=5.
+        from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
+
+        n, d, k = 300, 3, 5
+        train_x = np.full((n, d), np.nan, np.float32)
+        train_x[10] = 7.0
+        train_x[200] = 1.0
+        test_x = np.zeros((3, d), np.float32)
+        test_x[2] = np.nan  # all-inf query row
+        dists, idx = stripe_candidates_arrays(
+            train_x, test_x, k, block_q=8, block_n=128, interpret=True
+        )
+        # Query 0/1 at origin: row 200 (d=3) before row 10 (d=147), then the
+        # smallest NaN-row indices 0, 1, 2 with +inf distance.
+        for qi in (0, 1):
+            np.testing.assert_array_equal(idx[qi], [200, 10, 0, 1, 2])
+            assert np.isinf(dists[qi][2:]).all()
+        # NaN query: everything inf; tail = indices 0..k-1.
+        np.testing.assert_array_equal(idx[2], [0, 1, 2, 3, 4])
+        assert np.isinf(dists[2]).all()
+
     @pytest.mark.parametrize("engine", ["stripe", "merge"])
     def test_engines_match_oracle(self, rng, engine):
         train_x, train_y, test_x, c = _int_grid_problem(rng, n=300, q=40, d=6)
