@@ -61,6 +61,30 @@ def build_reference() -> bool:
     return True
 
 
+def _run_reference(body: str) -> str:
+    """Write ``body`` to a temp ARFF and run the built reference binary on it
+    (train == test, k=1); returns combined stdout+stderr. The shared probe
+    protocol for the load-differential checks."""
+    with tempfile.TemporaryDirectory(dir=REPO / "build") as td:
+        p = Path(td) / "probe.arff"
+        p.write_text(body)
+        r = subprocess.run(
+            [str(REF_BIN), str(p), str(p), "1"],
+            capture_output=True, text=True, timeout=60,
+        )
+        return r.stdout + r.stderr
+
+
+def _load_ours(body: str):
+    """Write ``body`` to a temp ARFF and parse it with our loader."""
+    from knn_tpu.data.arff import load_arff
+
+    with tempfile.TemporaryDirectory(dir=REPO / "build") as td:
+        p = Path(td) / "probe.arff"
+        p.write_text(body)
+        return load_arff(str(p))
+
+
 def random_arff_pair(rng) -> tuple:
     d = int(rng.integers(1, 8))  # features (class col added on top)
     c = int(rng.integers(2, 6))
@@ -184,10 +208,113 @@ def string_load_differential() -> int:
     return 0
 
 
+def nominal_header_differential() -> int:
+    """VERDICT r1 weak #6: header-level differentials over NOMINAL attribute
+    declarations against the real binary. The reference cannot run KNN over
+    nominal features (operator float throws, arff_value.cpp:121), but its
+    header/data PARSING is still observable through which error it dies with.
+    Pinned classes (each probed against the built binary):
+
+    - bare ``{red,blue}`` declaration + declared data values: the reference
+      parses header AND data, dying only at the kernel's float conversion
+      (arff_value.cpp:121) — so our parser must load the same file (interned
+      nominal codes; classifying on them is a documented liberal extension,
+      PARITY.md).
+    - undeclared data value: the reference dies in add_instance set
+      validation (arff_data.cpp:148) — ours must reject with a located
+      parse error, same classification.
+    - QUOTED declaration value ``{'da rk',blue}``: the reference *lexer*
+      derails (consumes to EOF, parse abort at arff_parser.cpp:114) — ours
+      accepts quoted declaration values: deliberate liberal-superset
+      deviation, asserted here so a dialect regression is caught.
+    - unterminated value list: both sides reject at parse time.
+    """
+    def hdr(decl: str, *rows: str) -> str:
+        return "\n".join(
+            ["@relation n", decl, "@attribute x NUMERIC",
+             "@attribute class NUMERIC", "@data", *rows]
+        ) + "\n"
+
+    failures = 0
+
+    bare = hdr("@attribute color {red,blue}", "red,1,0", "blue,2,1")
+    if "operator float cannot work" not in _run_reference(bare):
+        print("FAIL nominal differential: reference did not reach the "
+              "conversion error on a bare declaration (parse regressed?)")
+        failures += 1
+    try:
+        ds = _load_ours(bare)
+        ok = (ds.attributes[0].nominal_values == ["red", "blue"]
+              and ds.features[:, 0].tolist() == [0.0, 1.0])
+    except Exception as e:
+        ds, ok = None, False
+        print(f"FAIL nominal differential: bare declaration rejected: {e}")
+    if ds is not None and not ok:
+        print(f"FAIL nominal differential: bad load of bare declaration "
+              f"({ds.attributes[0].nominal_values}, {ds.features[:, 0]})")
+    if not ok:
+        failures += 1
+
+    undecl = hdr("@attribute color {red,blue}", "purple,1,0")
+    if "not found" not in _run_reference(undecl):
+        print("FAIL nominal differential: reference accepted an undeclared "
+              "nominal value")
+        failures += 1
+    try:
+        _load_ours(undecl)
+        print("FAIL nominal differential: we accepted an undeclared "
+              "nominal value")
+        failures += 1
+    except Exception as e:
+        if "not in nominal set" not in str(e):
+            print(f"FAIL nominal differential: wrong undeclared-value error: {e}")
+            failures += 1
+
+    quoted = hdr("@attribute color {'da rk',blue}", "'da rk',1,0", "blue,2,1")
+    if "END_OF_FILE" not in _run_reference(quoted):
+        print("FAIL nominal differential: reference now parses quoted "
+              "declaration values — the pinned liberal-superset deviation "
+              "no longer holds (reference dialect changed?)")
+        failures += 1
+    try:
+        ds = _load_ours(quoted)  # liberal superset: must parse here
+        if ds.attributes[0].nominal_values != ["da rk", "blue"]:
+            print(f"FAIL nominal differential: quoted declaration mis-parsed "
+                  f"({ds.attributes[0].nominal_values})")
+            failures += 1
+    except Exception as e:
+        print(f"FAIL nominal differential: quoted declaration rejected: {e}")
+        failures += 1
+
+    unterm = hdr("@attribute color {red,blue", "red,1,0")
+    if "_read_attr" not in _run_reference(unterm):
+        print("FAIL nominal differential: reference accepted an "
+              "unterminated value list")
+        failures += 1
+    try:
+        _load_ours(unterm)
+        print("FAIL nominal differential: we accepted an unterminated "
+              "value list")
+        failures += 1
+    except Exception as e:
+        if "unterminated nominal" not in str(e):
+            print(f"FAIL nominal differential: wrong unterminated error: {e}")
+            failures += 1
+
+    if failures == 0:
+        print("nominal-header differential: bare/undeclared/quoted/"
+              "unterminated declaration classes all match the pinned "
+              "reference behaviors — OK")
+    return failures
+
+
 def main(trials: int = 40) -> int:
     if not build_reference():
         return 0
-    failures = string_load_differential()
+    # Load-differential (string/nominal) failures are tracked separately so
+    # they can't trip the random-trial abort below or inflate its summary.
+    load_failures = string_load_differential() + nominal_header_differential()
+    failures = 0
     rng = np.random.default_rng(314159)
     for t in range(trials):
         train_body, test_body, n, q = random_arff_pair(rng)
@@ -220,8 +347,10 @@ def main(trials: int = 40) -> int:
                   file=sys.stderr)
     print("reference differential:",
           "ALL IDENTICAL" if failures == 0 else f"{failures} DIVERGENCES",
-          f"({trials} random dataset pairs, counts + accuracy)")
-    return 1 if failures else 0
+          f"({trials} random dataset pairs, counts + accuracy)"
+          + ("" if load_failures == 0
+             else f"; {load_failures} load-differential failures above"))
+    return 1 if failures or load_failures else 0
 
 
 if __name__ == "__main__":
