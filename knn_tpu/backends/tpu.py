@@ -275,6 +275,7 @@ def predict_arrays(
     metric: str = "euclidean",
     query_batch: "int | None" = None,
     engine: str = "auto",
+    device_cache: "dict | None" = None,
 ) -> np.ndarray:
     """Host-side entry: pads, dispatches to the right compiled path, unpads.
     ``approx`` (full-matrix path only) uses TPU hardware approximate top-k.
@@ -287,7 +288,9 @@ def predict_arrays(
     on a real TPU to the lane-striped Pallas kernel (~2.5x the XLA
     formulations — docs/KERNELS.md); "stripe" forces that kernel (interpreted
     off-TPU, so it is testable anywhere); "xla" keeps the jit
-    full-matrix/tiled paths."""
+    full-matrix/tiled paths. ``device_cache`` (normally the train
+    ``Dataset.device_cache``) memoizes device-side train layouts on the
+    stripe paths so repeat predicts skip the host pad+transpose+upload."""
     if engine not in ("auto", "stripe", "xla"):
         raise ValueError(
             f"unknown engine {engine!r}; choose 'auto', 'stripe', or 'xla'"
@@ -311,16 +314,13 @@ def predict_arrays(
 
         return stripe_classify_arrays(
             train_x, train_y, test_x, k, num_classes, precision=precision,
-            max_rows=query_batch,
-        )
-    if query_batch is not None and q > query_batch:
-        return _predict_query_batched(
-            train_x, train_y, test_x, k, num_classes,
-            precision=precision, query_tile=query_tile, train_tile=train_tile,
-            force_tiled=force_tiled, approx=approx, query_batch=query_batch,
+            max_rows=query_batch, cache=device_cache,
         )
     # Shared auto-engine rule (ops/pallas_knn.py::stripe_auto_eligible):
-    # exact euclidean, narrow features, small k, real TPU.
+    # exact euclidean, narrow features, small k, real TPU. Checked BEFORE the
+    # query_batch streaming path — the stripe host entry chunks queries
+    # itself (max_rows), so batched callers keep the fast kernel and the
+    # device cache instead of silently downgrading to the XLA scan.
     from knn_tpu.ops.pallas_knn import stripe_auto_eligible
 
     if (
@@ -334,6 +334,13 @@ def predict_arrays(
 
         return stripe_classify_arrays(
             train_x, train_y, test_x, k, num_classes, precision=precision,
+            max_rows=query_batch, cache=device_cache,
+        )
+    if query_batch is not None and q > query_batch:
+        return _predict_query_batched(
+            train_x, train_y, test_x, k, num_classes,
+            precision=precision, query_tile=query_tile, train_tile=train_tile,
+            force_tiled=force_tiled, approx=approx, query_batch=query_batch,
         )
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
         out = knn_forward(
@@ -376,4 +383,5 @@ def predict(
         precision=precision, query_tile=query_tile, train_tile=train_tile,
         force_tiled=force_tiled, approx=approx, metric=metric,
         query_batch=query_batch, engine=engine,
+        device_cache=train.device_cache,
     )

@@ -54,6 +54,15 @@ class Dataset:
     relation: str = ""
     attributes: Sequence[Attribute] = dataclasses.field(default_factory=list)
     raw_targets: Optional[np.ndarray] = None
+    # Keyed device-side layouts of features/labels (e.g. the stripe kernel's
+    # transposed train matrix), populated lazily by the execution backends so
+    # repeat predict/kneighbors calls skip the host pad+transpose+upload.
+    # Tied to this object's arrays: mutating ``features``/``labels`` in place
+    # requires ``device_cache.clear()``; a freshly constructed/loaded Dataset
+    # starts empty.
+    device_cache: dict = dataclasses.field(
+        default_factory=dict, repr=False, compare=False
+    )
 
     def __post_init__(self):
         self.features = np.ascontiguousarray(self.features, dtype=np.float32)

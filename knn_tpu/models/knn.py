@@ -22,6 +22,7 @@ def _kneighbors_arrays(
     k: int,
     metric: str = "euclidean",
     engine: str = "auto",
+    cache: "dict | None" = None,
 ):
     """Shared retrieval core for both model families: ``(dists [Q,k],
     indices [Q,k])`` sorted by (distance, train index). Pure geometry — no
@@ -33,7 +34,9 @@ def _kneighbors_arrays(
     Pallas kernel — the same engine selection ``predict`` gets — so
     ``kneighbors``/``predict_proba``/regression run at the framework's own
     perf bar; ``xla`` keeps the tiled candidate scan; ``stripe`` forces the
-    kernel (interpret mode off-TPU)."""
+    kernel (interpret mode off-TPU). ``cache`` (normally the train
+    ``Dataset.device_cache``) memoizes the device-side train layout so
+    repeat retrievals skip the host pad/transpose/upload."""
     import jax.numpy as jnp
 
     from knn_tpu.backends.tpu import knn_forward_candidates
@@ -56,18 +59,33 @@ def _kneighbors_arrays(
             raise ValueError("the stripe engine implements euclidean only")
         from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
 
-        return stripe_candidates_arrays(train_x, test_x, k, precision="exact")
+        return stripe_candidates_arrays(
+            train_x, test_x, k, precision="exact", cache=cache
+        )
+    from knn_tpu.ops.pallas_knn import memo_device
+
     n, q = train_x.shape[0], test_x.shape[0]
     train_tile = max(min(2048, n), k)
-    tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
-    ty = np.zeros(tx.shape[0], np.int32)  # placeholder labels, unused
+
+    def make():
+        tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
+        # Placeholder labels: the candidate core wants them but pure
+        # retrieval never reads the gathered values.
+        return jnp.asarray(tx), jnp.asarray(np.zeros(tx.shape[0], np.int32))
+
+    txj, tyj = memo_device(cache, ("xla_candidates_train", train_tile), make)
     qx, _ = pad_axis_to_multiple(test_x, 128, axis=0)
+    import jax
+
     d, i, _ = knn_forward_candidates(
-        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+        txj, tyj, jnp.asarray(qx),
         jnp.asarray(n, jnp.int32),
         k=k, train_tile=train_tile, precision=form,
     )
-    return np.asarray(d)[:q], np.asarray(i)[:q]
+    # One batched fetch — two sequential np.asarray calls each pay a full
+    # device->host round trip (~100 ms on a tunneled device).
+    d_h, i_h = jax.device_get((d, i))
+    return d_h[:q], i_h[:q]
 
 
 def _inverse_distance_weights(dists: np.ndarray):
@@ -93,6 +111,7 @@ def radius_neighbors_arrays(
     max_neighbors: int = 128,
     metric: str = "euclidean",
     engine: str = "auto",
+    cache: "dict | None" = None,
 ):
     """All train rows within ``radius`` of each query, as fixed-shape masked
     arrays — the TPU-friendly formulation (variable-length results defeat
@@ -107,7 +126,9 @@ def radius_neighbors_arrays(
     """
     n = train_x.shape[0]
     m = min(max_neighbors, n)
-    d, i = _kneighbors_arrays(train_x, test_x, m, metric=metric, engine=engine)
+    d, i = _kneighbors_arrays(
+        train_x, test_x, m, metric=metric, engine=engine, cache=cache
+    )
     mask = d <= radius
     full = mask.all(axis=1)
     if m < n and bool(full.any()):
@@ -202,7 +223,7 @@ class KNNClassifier:
         train.validate_for_knn(self.k, test)
         return _kneighbors_arrays(
             train.features, test.features, self.k, metric=self.metric,
-            engine=self._retrieval_engine(),
+            engine=self._retrieval_engine(), cache=train.device_cache,
         )
 
     def _retrieval_engine(self) -> str:
@@ -221,7 +242,7 @@ class KNNClassifier:
         train.validate_for_knn(1, test)
         return radius_neighbors_arrays(
             train.features, test.features, radius, max_neighbors, self.metric,
-            engine=self._retrieval_engine(),
+            engine=self._retrieval_engine(), cache=train.device_cache,
         )
 
     def predict_proba(self, test: Dataset) -> np.ndarray:
@@ -315,7 +336,7 @@ class KNNRegressor:
         train = self._check_features(test)
         return radius_neighbors_arrays(
             train.features, test.features, radius, max_neighbors, self.metric,
-            engine=self.engine,
+            engine=self.engine, cache=train.device_cache,
         )
 
     def kneighbors(self, test: Dataset):
@@ -324,7 +345,7 @@ class KNNRegressor:
         train = self._check_features(test)
         return _kneighbors_arrays(
             train.features, test.features, self.k, metric=self.metric,
-            engine=self.engine,
+            engine=self.engine, cache=train.device_cache,
         )
 
     def predict(self, test: Dataset) -> np.ndarray:

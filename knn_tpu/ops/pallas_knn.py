@@ -572,6 +572,33 @@ def stripe_block_sizes(
     return block_q, block_n
 
 
+def memo_device(cache: Optional[dict], key: tuple, make):
+    """THE memoization idiom for ``Dataset.device_cache``: return the cached
+    entry for ``key``, else ``make()`` it (host layout + device upload) and
+    store it when a cache dict was supplied. One definition so future
+    invalidation-rule changes happen in one place."""
+    if cache is not None and key in cache:
+        return cache[key]
+    entry = make()
+    if cache is not None:
+        cache[key] = entry
+    return entry
+
+
+def _cached_stripe_train(
+    train_x: np.ndarray, block_n: int, cache: Optional[dict]
+) -> Tuple[jnp.ndarray, int, bool]:
+    """Device-resident transposed train layout, memoized in ``cache``
+    (normally ``Dataset.device_cache``) so repeat predict/kneighbors calls
+    skip the host pad+transpose+upload AND the finiteness scan. Returns
+    ``(train_xT device array, d_pad, train_finite)``."""
+    def make():
+        txT, d_pad = stripe_prepare_train(train_x, block_n)
+        return jnp.asarray(txT), d_pad, stripe_inputs_finite(train_x)
+
+    return memo_device(cache, ("stripe_train", block_n), make)
+
+
 def stripe_candidates_arrays(
     train_x: np.ndarray,
     test_x: np.ndarray,
@@ -580,26 +607,31 @@ def stripe_candidates_arrays(
     block_n: Optional[int] = None,
     interpret: Optional[bool] = None,
     precision: str = "exact",
+    cache: Optional[dict] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Host entry for the lane-striped kernel: handles padding and the [D, N]
     train transposition, returns unpadded ``([Q,k] dists, [Q,k] indices)``.
     ``interpret`` defaults to on for non-TPU platforms so the same path is
-    testable on CPU."""
+    testable on CPU. ``cache`` (a ``Dataset.device_cache`` dict) memoizes the
+    device-side train layout across calls."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n, d_true = train_x.shape
     q = test_x.shape[0]
     precision = _resolve_stripe_precision(precision, d_true)
     block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
-    txT, d_pad = stripe_prepare_train(train_x, block_n)
+    txTj, d_pad, train_finite = _cached_stripe_train(train_x, block_n, cache)
     qx = stripe_prepare_queries(test_x, block_q, d_pad)
     d, idx = knn_pallas_stripe_candidates(
-        jnp.asarray(txT), jnp.asarray(qx), n, k,
+        txTj, jnp.asarray(qx), n, k,
         block_q=block_q, block_n=block_n, interpret=interpret, d_true=d_true,
         precision=precision,
-        assume_finite=stripe_inputs_finite(train_x, test_x),
+        assume_finite=train_finite and stripe_inputs_finite(test_x),
     )
-    return np.asarray(d)[:q], np.asarray(idx)[:q]
+    # One batched fetch: two sequential np.asarray calls each pay a full
+    # device->host round trip (~100 ms on a tunneled device).
+    d_h, i_h = jax.device_get((d, idx))
+    return d_h[:q], i_h[:q]
 
 
 @functools.partial(
@@ -648,6 +680,7 @@ def stripe_classify_arrays(
     block_n: Optional[int] = None,
     interpret: Optional[bool] = None,
     max_rows: Optional[int] = None,
+    cache: Optional[dict] = None,
 ) -> np.ndarray:
     """Host entry for a full stripe-kernel classify: resolves k-aware block
     sizes, lays out the inputs, runs the fused classify jit in bounded
@@ -657,18 +690,21 @@ def stripe_classify_arrays(
     non-TPU platforms so the same path is testable on CPU; ``max_rows``
     caps the per-call query rows (e.g. a caller's query_batch).
     ``precision="auto"`` resolves like backends/pallas.py: exact for narrow
-    features (the stripe kernel's home turf), fast for wide."""
+    features (the stripe kernel's home turf), fast for wide. ``cache`` (a
+    ``Dataset.device_cache`` dict) memoizes the device-side train layout
+    across calls."""
     precision = _resolve_stripe_precision(precision, train_x.shape[1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     q = test_x.shape[0]
     if q == 0:
         return np.empty(0, np.int32)
-    assume_finite = stripe_inputs_finite(train_x, test_x)
     block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
-    txT, d_pad = stripe_prepare_train(train_x, block_n)
-    tyj = jnp.asarray(train_y)
-    txTj = jnp.asarray(txT)
+    txTj, d_pad, train_finite = _cached_stripe_train(train_x, block_n, cache)
+    assume_finite = train_finite and stripe_inputs_finite(test_x)
+    tyj = memo_device(
+        cache, ("stripe_labels",), lambda: jnp.asarray(train_y)
+    )
     nv = jnp.asarray(train_x.shape[0], jnp.int32)
     # Chunk calls so each [rows, 128k] candidate buffer stays small: XLA can
     # place the kernel outputs in VMEM (observed at k>8), and an unchunked

@@ -61,13 +61,23 @@ def build_reference() -> bool:
     return True
 
 
-def _run_reference(body: str) -> str:
-    """Write ``body`` to a temp ARFF and run the built reference binary on it
-    (train == test, k=1); returns combined stdout+stderr. The shared probe
-    protocol for the load-differential checks."""
+import contextlib
+
+
+@contextlib.contextmanager
+def _probe_file(body: str):
+    """Write ``body`` to a temp ARFF under build/ and yield its path — the
+    shared probe protocol for the load-differential checks."""
     with tempfile.TemporaryDirectory(dir=REPO / "build") as td:
         p = Path(td) / "probe.arff"
         p.write_text(body)
+        yield p
+
+
+def _run_reference(body: str) -> str:
+    """Run the built reference binary on ``body`` (train == test, k=1);
+    returns combined stdout+stderr."""
+    with _probe_file(body) as p:
         r = subprocess.run(
             [str(REF_BIN), str(p), str(p), "1"],
             capture_output=True, text=True, timeout=60,
@@ -76,12 +86,10 @@ def _run_reference(body: str) -> str:
 
 
 def _load_ours(body: str):
-    """Write ``body`` to a temp ARFF and parse it with our loader."""
+    """Parse ``body`` with our loader."""
     from knn_tpu.data.arff import load_arff
 
-    with tempfile.TemporaryDirectory(dir=REPO / "build") as td:
-        p = Path(td) / "probe.arff"
-        p.write_text(body)
+    with _probe_file(body) as p:
         return load_arff(str(p))
 
 

@@ -102,3 +102,51 @@ class TestModelEngineRouting:
         p_x = KNNRegressor(k=5, weights="distance", engine="xla").fit(train).predict(test)
         p_s = KNNRegressor(k=5, weights="distance", engine="stripe").fit(train).predict(test)
         np.testing.assert_array_equal(p_s, p_x)
+
+
+class TestDeviceCache:
+    """Dataset.device_cache: repeat retrieval/predict calls reuse the
+    device-side train layout instead of re-padding/re-uploading."""
+
+    @pytest.mark.parametrize("engine", ["stripe", "xla"])
+    def test_kneighbors_populates_and_reuses_cache(self, rng, engine):
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        m = KNNClassifier(k=5, engine=engine).fit(train)
+        d1, i1 = m.kneighbors(test)
+        assert train.device_cache, "first call must populate the cache"
+        snapshot = {k: v for k, v in train.device_cache.items()}
+        d2, i2 = m.kneighbors(test)
+        for k_ in snapshot:
+            assert train.device_cache[k_] is snapshot[k_], \
+                "second call must reuse the cached device arrays"
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_array_equal(d1, d2)
+
+    def test_backend_predict_uses_dataset_cache(self, rng):
+        from knn_tpu.backends import get_backend
+
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        fn = get_backend("tpu")
+        p1 = fn(train, test, 5, engine="stripe")
+        assert train.device_cache
+        p2 = fn(train, test, 5, engine="stripe")
+        np.testing.assert_array_equal(p1, p2)
+
+    def test_inplace_mutation_requires_clear(self, rng):
+        # The documented contract: in-place feature mutation must be followed
+        # by device_cache.clear(); after clearing, results reflect new data.
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x.copy(), train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        m = KNNClassifier(k=3, engine="stripe").fit(train)
+        m.kneighbors(test)  # populate
+        train.features[:] = np.flipud(train.features.copy())
+        train.device_cache.clear()
+        _, idx = m.kneighbors(test)
+        fresh = Dataset(train.features.copy(), train_y)
+        want = KNNClassifier(k=3, engine="stripe").fit(fresh).kneighbors(test)[1]
+        np.testing.assert_array_equal(idx, want)
