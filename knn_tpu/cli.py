@@ -85,6 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device count for sharded backends (default: all)")
     p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
                    help="force a JAX platform (e.g. cpu, tpu) before backend init")
+    p.add_argument(
+        "--sweep-k", default=None, metavar="K1,K2,...",
+        help="classify at every listed k from ONE shared candidate retrieval "
+        "(positional k is ignored): prints the canonical result line per k, "
+        "each reporting the total sweep time. Uses the retrieval engine "
+        "(--engine), not the persona backend; predictions per k are "
+        "identical to individual runs",
+    )
     p.add_argument("--json", action="store_true", help="emit structured JSON metrics")
     p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--warmup", action="store_true",
@@ -141,10 +149,21 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         )
         backend_name = fallback
 
+    sweep_ks = None
+    if args.sweep_k is not None:
+        try:
+            sweep_ks = sorted({int(s) for s in args.sweep_k.split(",") if s})
+            if not sweep_ks or sweep_ks[0] < 1:
+                raise ValueError
+        except ValueError:
+            print(f"error: --sweep-k wants positive integers, got "
+                  f"{args.sweep_k!r}", file=sys.stderr)
+            return 1
+
     try:
         train = load_arff(args.train)
         test = load_arff(args.test)
-        train.validate_for_knn(args.k, test)
+        train.validate_for_knn(max(sweep_ks) if sweep_ks else args.k, test)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
@@ -167,6 +186,54 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         opts["num_threads"] = args.threads
     if args.devices is not None:
         opts["num_devices"] = args.devices
+
+    if sweep_ks is not None:
+        from knn_tpu.models.knn import sweep_k
+
+        # Reject options the retrieval path cannot honor rather than
+        # silently computing something else (the backends' own rule,
+        # backends/tpu.py forced-stripe branch).
+        rejected = [
+            name for name, bad in (
+                ("--approx", args.approx),
+                ("--precision", args.precision not in ("exact", "auto")),
+                ("--query-batch", args.query_batch is not None),
+                ("--engine full/tiled", args.engine in ("full", "tiled")),
+            ) if bad
+        ]
+        if rejected:
+            print(
+                f"error: --sweep-k runs the exact candidate-retrieval path; "
+                f"incompatible with {', '.join(rejected)}",
+                file=sys.stderr,
+            )
+            return 1
+        engine = args.engine
+        try:
+            if args.warmup:
+                sweep_k(train, test, sweep_ks, metric=args.metric, engine=engine)
+            with maybe_profile(args.trace_dir):
+                with RegionTimer() as t:
+                    preds_by_k = sweep_k(
+                        train, test, sweep_ks, metric=args.metric, engine=engine
+                    )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        for k in sweep_ks:
+            acc = accuracy(confusion_matrix(
+                preds_by_k[k], test.labels, test.num_classes))
+            print(
+                result_line(k, test.num_instances, train.num_instances, t.ms, acc),
+                file=stdout,
+            )
+            if args.json:
+                print(
+                    result_json(k, test.num_instances, train.num_instances,
+                                t.ms, acc, f"sweep:{engine}"),
+                    file=stdout,
+                )
+        return 0
 
     fn = get_backend(backend_name)
     try:

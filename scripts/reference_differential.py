@@ -25,6 +25,7 @@ Usage: python scripts/reference_differential.py [trials]
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import subprocess
@@ -59,9 +60,6 @@ def build_reference() -> bool:
         print(f"reference build failed:\n{proc.stderr[:500]}", file=sys.stderr)
         return False
     return True
-
-
-import contextlib
 
 
 @contextlib.contextmanager

@@ -104,3 +104,35 @@ class TestCli:
         )
         assert run([str(bad), str(bad), "1"]) == 1
         assert "error:" in capsys.readouterr().err
+
+
+class TestSweepK:
+    def test_sweep_prints_one_line_per_k(self, paths):
+        out = io.StringIO()
+        assert run(
+            [paths[0], paths[1], "1", "--sweep-k", "5,1", "--engine", "xla"],
+            stdout=out,
+        ) == 0
+        lines = out.getvalue().strip().splitlines()
+        assert len(lines) == 2
+        for line, k in zip(lines, ("1", "5")):
+            m = LINE_RE.match(line)
+            assert m and m.group(1) == k, line
+        # Per-k accuracy must match an individual run at that k.
+        single = io.StringIO()
+        assert run([paths[0], paths[1], "5", "--backend", "oracle"], stdout=single) == 0
+        assert lines[1].split()[-1] == single.getvalue().strip().split()[-1]
+
+    def test_sweep_rejects_garbage(self, paths, capsys):
+        assert run([paths[0], paths[1], "1", "--sweep-k", "a,b"]) == 1
+        assert "positive integers" in capsys.readouterr().err
+
+    def test_sweep_rejects_k_over_n(self, paths, capsys):
+        assert run([paths[0], paths[1], "1", "--sweep-k", "1,100000"]) == 1
+        assert "error:" in capsys.readouterr().err
+
+    def test_sweep_rejects_incompatible_flags(self, paths, capsys):
+        for extra in (["--approx"], ["--precision", "fast"],
+                      ["--query-batch", "8"], ["--engine", "full"]):
+            assert run([paths[0], paths[1], "1", "--sweep-k", "1,5", *extra]) == 1
+            assert "incompatible" in capsys.readouterr().err

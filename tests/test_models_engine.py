@@ -150,3 +150,45 @@ class TestDeviceCache:
         fresh = Dataset(train.features.copy(), train_y)
         want = KNNClassifier(k=3, engine="stripe").fit(fresh).kneighbors(test)[1]
         np.testing.assert_array_equal(idx, want)
+
+
+class TestSweepK:
+    """sweep_k: every k's predictions from one shared retrieval must equal an
+    individual predict at that k (prefix-vote exactness under the
+    (distance, index) tie contract)."""
+
+    @pytest.mark.parametrize("engine", ["stripe", "xla"])
+    def test_matches_individual_predicts(self, rng, engine):
+        from knn_tpu.models.knn import sweep_k
+
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        ks = [1, 3, 7, 12]
+        got = sweep_k(train, test, ks, engine=engine)
+        assert sorted(got) == ks
+        for k in ks:
+            want = KNNClassifier(k=k, engine=engine).fit(train).predict(test)
+            np.testing.assert_array_equal(got[k], want)
+
+    def test_duplicate_and_unsorted_ks(self, rng):
+        from knn_tpu.models.knn import sweep_k
+
+        train_x, train_y, test_x, c = _tie_problem(rng, n=64, q=8)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        got = sweep_k(train, test, [5, 1, 5])
+        assert sorted(got) == [1, 5]
+
+    def test_rejects_bad_ks(self, rng):
+        from knn_tpu.models.knn import sweep_k
+
+        train_x, train_y, test_x, c = _tie_problem(rng, n=64, q=8)
+        train = Dataset(train_x, train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        with pytest.raises(ValueError):
+            sweep_k(train, test, [])
+        with pytest.raises(ValueError):
+            sweep_k(train, test, [0, 5])
+        with pytest.raises(ValueError):
+            sweep_k(train, test, [len(train_x) + 1])  # validate_for_knn
