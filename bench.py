@@ -118,36 +118,50 @@ def bench_mnist():
     tx, _ = pad_axis_to_multiple(train_x, 1024, axis=0)
     tx, _ = pad_axis_to_multiple(tx, 128, axis=1)
     txj = jnp.asarray(tx)
-    bufs = []
-    for i in range(4):
-        qp, _ = pad_axis_to_multiple(test_x + np.float32(i) * 1e-7, 256, axis=0)
-        qp, _ = pad_axis_to_multiple(qp, 128, axis=1)
-        bufs.append(jnp.asarray(qp))
-    jax.block_until_ready(bufs)
+    txb = jnp.asarray(tx, jnp.bfloat16)  # half the per-step HBM train stream
 
-    def make_step(precision):
+    # One DISTINCT query buffer per dispatch: the measurement layers can
+    # dedupe repeated (executable, inputs) executions, which silently
+    # collapses a repeat-buffer slope to enqueue cost (observed on v5e:
+    # a 3 ms kernel "measuring" 0.02 ms/step).
+    def make_bufs(bq, count):
+        out = []
+        for i in range(count):
+            qp, _ = pad_axis_to_multiple(test_x + np.float32(i) * 1e-6, bq, axis=0)
+            qp, _ = pad_axis_to_multiple(qp, 128, axis=1)
+            out.append(jnp.asarray(qp))
+        jax.block_until_ready(out)
+        return out
+
+    R_LO, R_HI = 10, 40
+    bufs = make_bufs(256, R_HI)
+
+    def make_step(precision, txop, bq):
         def step(qb):
             return knn_pallas_candidates(
-                txj, qb, n, k, block_q=256, block_n=1024, d_true=d,
+                txop, qb, n, k, block_q=bq, block_n=1024, d_true=d,
                 precision=precision,
             )
         return step
 
-    step = make_step("fast")
+    step = make_step("fast", txj, 256)
     t0 = time.monotonic()
     np.asarray(step(bufs[0])[0])
     log(f"compile+first run: {time.monotonic() - t0:.2f}s")
-    per_step, sync = _pipelined_slope(step, bufs, 10, 40)
+    per_step, sync = _pipelined_slope(step, bufs, R_LO, R_HI)
     qps = q / per_step
     tflops = 2 * q * n * d / per_step / 1e12
     log(f"f32 matmul form: {per_step*1e3:.2f} ms/step, "
         f"~{sync*1e3:.0f} ms sync overhead")
 
-    # bfloat16 MXU operands (f32 accumulation): 2x matmul throughput at ~3
-    # fewer mantissa digits in the cross term — the wide-feature speed knob.
-    step_bf16 = make_step("bf16")
-    np.asarray(step_bf16(bufs[0])[0])
-    bf16_step, _ = _pipelined_slope(step_bf16, bufs, 10, 40)
+    # bfloat16 MXU operands with the train operand STORED as bf16 (f32
+    # accumulation): halves the HBM train stream this config is bound by,
+    # and the freed VMEM fits a 2x query block (fewer re-streams) — the
+    # wide-feature speed knob. ~1.55x the f32 form on v5e.
+    bufs_bf16 = make_bufs(512, R_HI)
+    step_bf16 = make_step("bf16", txb, 512)
+    np.asarray(step_bf16(bufs_bf16[0])[0])
+    bf16_step, _ = _pipelined_slope(step_bf16, bufs_bf16, R_LO, R_HI)
     log(f"bf16 form: {bf16_step*1e3:.2f} ms/step "
         f"({q/bf16_step:.0f} q/s, {2*q*n*d/bf16_step/1e12:.0f} Tflop/s)")
     return {
@@ -194,7 +208,7 @@ def bench_xl():
     tyj = jnp.asarray(labels)
     nvalid = jnp.asarray(n, jnp.int32)
     bufs = []
-    for i in range(4):
+    for i in range(20):  # one distinct buffer per dispatch (dedupe-proof)
         bufs.append(jnp.asarray(stripe_prepare_queries(
             test.features + np.float32(i) * 1e-7, block_q, d_pad)))
     jax.block_until_ready(bufs)
@@ -319,7 +333,7 @@ def bench_sharded():
     bufs = [
         jnp.asarray(stripe_prepare_queries(
             test.features + np.float32(i) * 1e-7, block_q, d_pad))
-        for i in range(8)
+        for i in range(200)  # one distinct buffer per dispatch (dedupe-proof)
     ]
     jax.block_until_ready(bufs)
 
@@ -446,13 +460,13 @@ def bench_headline():
         jax.device_put(
             jnp.asarray(pad_queries(test.features + np.float32(i) * 1e-7)), dev
         )
-        for i in range(8)
+        for i in range(200)  # one distinct buffer per dispatch (dedupe-proof)
     ]
     # Unpadded variants for the XLA-formulation diagnostics (knn_forward needs
     # no query padding; timing it on padded rows would bias the comparison).
     qbufs_raw = [
         jax.device_put(jnp.asarray(test.features + np.float32(i) * 1e-7), dev)
-        for i in range(8)
+        for i in range(200)
     ]
     jax.block_until_ready(qbufs + qbufs_raw)
 

@@ -89,9 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--sweep-k", default=None, metavar="K1,K2,...",
         help="classify at every listed k from ONE shared candidate retrieval "
         "(positional k is ignored): prints the canonical result line per k, "
-        "each reporting the total sweep time. Uses the retrieval engine "
-        "(--engine), not the persona backend; predictions per k are "
-        "identical to individual runs",
+        "each reporting the total sweep time. Runs the exact retrieval path "
+        "with --engine auto/stripe/xla; options it cannot honor (--backend, "
+        "--approx, non-exact --precision, --query-batch, tile/thread/device "
+        "knobs) are rejected. Predictions per k are identical to individual "
+        "runs",
     )
     p.add_argument("--json", action="store_true", help="emit structured JSON metrics")
     p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
@@ -110,6 +112,44 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
     except SystemExit as e:
         return e.code if isinstance(e.code, int) else 2
 
+    # --sweep-k argument validation happens BEFORE any backend resolution or
+    # file loading: the sweep never touches a backend (so backend fallback
+    # warnings would mislead), and a flag error should not cost a
+    # multi-hundred-MB parse.
+    sweep_ks = None
+    if args.sweep_k is not None:
+        try:
+            sweep_ks = sorted({int(s) for s in args.sweep_k.split(",") if s})
+            if not sweep_ks or sweep_ks[0] < 1:
+                raise ValueError
+        except ValueError:
+            print(f"error: --sweep-k wants positive integers, got "
+                  f"{args.sweep_k!r}", file=sys.stderr)
+            return 1
+        # Reject options the retrieval path cannot honor rather than
+        # silently computing something else (the backends' own rule,
+        # backends/tpu.py forced-stripe branch).
+        rejected = [
+            name for name, bad in (
+                ("--backend", args.backend is not None),
+                ("--approx", args.approx),
+                ("--precision", args.precision not in ("exact", "auto")),
+                ("--query-batch", args.query_batch is not None),
+                ("--engine full/tiled", args.engine in ("full", "tiled")),
+                ("--threads", args.threads is not None),
+                ("--devices", args.devices is not None),
+                ("--query-tile", args.query_tile != 256),
+                ("--train-tile", args.train_tile != 2048),
+            ) if bad
+        ]
+        if rejected:
+            print(
+                f"error: --sweep-k runs the exact candidate-retrieval path; "
+                f"incompatible with {', '.join(rejected)}",
+                file=sys.stderr,
+            )
+            return 1
+
     if args.platform:
         import jax
 
@@ -120,6 +160,44 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
     from knn_tpu.parallel.mesh import maybe_init_distributed
 
     maybe_init_distributed()
+
+    if sweep_ks is not None:
+        from knn_tpu.models.knn import sweep_k
+
+        try:
+            train = load_arff(args.train)
+            test = load_arff(args.test)
+            train.validate_for_knn(max(sweep_ks), test)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        try:
+            if args.warmup:
+                sweep_k(train, test, sweep_ks, metric=args.metric,
+                        engine=args.engine)
+            with maybe_profile(args.trace_dir):
+                with RegionTimer() as t:
+                    preds_by_k = sweep_k(
+                        train, test, sweep_ks, metric=args.metric,
+                        engine=args.engine,
+                    )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        for k in sweep_ks:
+            acc = accuracy(confusion_matrix(
+                preds_by_k[k], test.labels, test.num_classes))
+            print(
+                result_line(k, test.num_instances, train.num_instances, t.ms, acc),
+                file=stdout,
+            )
+            if args.json:
+                print(
+                    result_json(k, test.num_instances, train.num_instances,
+                                t.ms, acc, f"sweep:{args.engine}"),
+                    file=stdout,
+                )
+        return 0
 
     backend_name = args.backend or _PERSONAS[args.persona][0]
     # Graceful degradation when the native runtime isn't built.
@@ -149,21 +227,10 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         )
         backend_name = fallback
 
-    sweep_ks = None
-    if args.sweep_k is not None:
-        try:
-            sweep_ks = sorted({int(s) for s in args.sweep_k.split(",") if s})
-            if not sweep_ks or sweep_ks[0] < 1:
-                raise ValueError
-        except ValueError:
-            print(f"error: --sweep-k wants positive integers, got "
-                  f"{args.sweep_k!r}", file=sys.stderr)
-            return 1
-
     try:
         train = load_arff(args.train)
         test = load_arff(args.test)
-        train.validate_for_knn(max(sweep_ks) if sweep_ks else args.k, test)
+        train.validate_for_knn(args.k, test)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
@@ -186,54 +253,6 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         opts["num_threads"] = args.threads
     if args.devices is not None:
         opts["num_devices"] = args.devices
-
-    if sweep_ks is not None:
-        from knn_tpu.models.knn import sweep_k
-
-        # Reject options the retrieval path cannot honor rather than
-        # silently computing something else (the backends' own rule,
-        # backends/tpu.py forced-stripe branch).
-        rejected = [
-            name for name, bad in (
-                ("--approx", args.approx),
-                ("--precision", args.precision not in ("exact", "auto")),
-                ("--query-batch", args.query_batch is not None),
-                ("--engine full/tiled", args.engine in ("full", "tiled")),
-            ) if bad
-        ]
-        if rejected:
-            print(
-                f"error: --sweep-k runs the exact candidate-retrieval path; "
-                f"incompatible with {', '.join(rejected)}",
-                file=sys.stderr,
-            )
-            return 1
-        engine = args.engine
-        try:
-            if args.warmup:
-                sweep_k(train, test, sweep_ks, metric=args.metric, engine=engine)
-            with maybe_profile(args.trace_dir):
-                with RegionTimer() as t:
-                    preds_by_k = sweep_k(
-                        train, test, sweep_ks, metric=args.metric, engine=engine
-                    )
-        except ValueError as e:
-            print(f"error: {e}", file=sys.stderr)
-            return 1
-        for k in sweep_ks:
-            acc = accuracy(confusion_matrix(
-                preds_by_k[k], test.labels, test.num_classes))
-            print(
-                result_line(k, test.num_instances, train.num_instances, t.ms, acc),
-                file=stdout,
-            )
-            if args.json:
-                print(
-                    result_json(k, test.num_instances, train.num_instances,
-                                t.ms, acc, f"sweep:{engine}"),
-                    file=stdout,
-                )
-        return 0
 
     fn = get_backend(backend_name)
     try:

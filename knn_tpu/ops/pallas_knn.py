@@ -82,16 +82,25 @@ def _knn_kernel(
         out_i_ref[:] = jnp.full(out_i_ref.shape, _INT_MAX, jnp.int32)
 
     q = q_ref[:]  # [BQ, D]
-    t = t_ref[:]  # [BN, D]
+    t = t_ref[:]  # [BN, D], bf16 when the host entry pre-cast the train set
     if precision in ("fast", "bf16"):
         # MXU distance block: |q|^2 - 2 q·t + |t|^2, clamped at 0. One matmul,
         # but catastrophic cancellation perturbs near-zero distances. "bf16"
         # additionally feeds the MXU bfloat16 operands (f32 accumulation) for
         # 2x matmul throughput at ~3 fewer mantissa digits in the cross term.
+        # This wide-feature config is HBM-bound on the train stream (the
+        # whole [N, D] matrix re-streams once per query tile), so the host
+        # entry stores the train operand AS bf16 — halving the stream is
+        # worth more than the matmul speedup itself; norms are accumulated
+        # in f32 from the same bf16 values the matmul consumes.
+        t32 = t.astype(jnp.float32)
         q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
-        t2 = jnp.sum(t * t, axis=1, keepdims=True).T  # [1, BN]
+        t2 = jnp.sum(t32 * t32, axis=1, keepdims=True).T  # [1, BN]
         if precision == "bf16":
-            q, t = q.astype(jnp.bfloat16), t.astype(jnp.bfloat16)
+            q = q.astype(jnp.bfloat16)
+            t = t if t.dtype == jnp.bfloat16 else t.astype(jnp.bfloat16)
+        else:
+            t = t32
         cross = jax.lax.dot_general(
             q, t,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -149,6 +158,11 @@ def knn_pallas_candidates(
     n_pad, d_feat = train_x.shape
     q_pad = test_x.shape[0]
     assert n_pad % block_n == 0 and q_pad % block_q == 0
+    # A bf16 train operand (half the HBM stream) is only meaningful to the
+    # bf16 distance form; the exact unroll and the f32 matmul need f32.
+    assert train_x.dtype == jnp.float32 or (
+        train_x.dtype == jnp.bfloat16 and precision == "bf16"
+    ), f"train dtype {train_x.dtype} requires precision='bf16'"
     grid = (q_pad // block_q, n_pad // block_n)
 
     kernel = functools.partial(
@@ -774,15 +788,22 @@ def predict_pallas(
             precision=precision,
         )
     elif engine == "merge":
-        block_q = block_q or 256
+        # bf16 halves the train block in VMEM, which is exactly what lets the
+        # bigger query block (fewer train re-streams) fit: (512, 1024) is the
+        # v5e sweet spot for the bf16 form, (256, 1024) for f32.
+        block_q = block_q or (512 if precision == "bf16" else 256)
         block_n = max(block_n or 1024, k)  # per-tile top-k needs k <= tile width
         tx, _ = pad_axis_to_multiple(train_x.astype(np.float32), block_n, axis=0)
         qx, _ = pad_axis_to_multiple(test_x.astype(np.float32), block_q, axis=0)
         tx, _ = pad_axis_to_multiple(tx, 128, axis=1)  # lane-align features
         qx, _ = pad_axis_to_multiple(qx, 128, axis=1)
+        # bf16 stores the train operand AS bf16: this wide-feature config is
+        # HBM-bound on the train stream (see _knn_kernel), so halving it is
+        # the actual speedup; the matmul consumes the same rounded values.
+        txj = jnp.asarray(tx, jnp.bfloat16 if precision == "bf16" else None)
 
         _, idx = knn_pallas_candidates(
-            jnp.asarray(tx), jnp.asarray(qx), n, k,
+            txj, jnp.asarray(qx), n, k,
             block_q=block_q, block_n=block_n, interpret=interpret,
             d_true=d_true, precision=precision,
         )

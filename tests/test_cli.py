@@ -133,6 +133,8 @@ class TestSweepK:
 
     def test_sweep_rejects_incompatible_flags(self, paths, capsys):
         for extra in (["--approx"], ["--precision", "fast"],
-                      ["--query-batch", "8"], ["--engine", "full"]):
-            assert run([paths[0], paths[1], "1", "--sweep-k", "1,5", *extra]) == 1
+                      ["--query-batch", "8"], ["--engine", "full"],
+                      ["--backend", "oracle"], ["--devices", "4"],
+                      ["--query-tile", "64"], ["4"]):
+            assert run([paths[0], paths[1], "1", *extra, "--sweep-k", "1,5"]) == 1
             assert "incompatible" in capsys.readouterr().err
