@@ -78,25 +78,14 @@ def init_from_env() -> bool:
     return True
 
 
-@functools.lru_cache(maxsize=None)
-def _cached_global_fn(k, num_classes, precision, query_tile, train_tile):
-    """Global mesh + jitted shard_map closure, cached so repeat predicts
-    (warmup, loops) reuse XLA's compile cache instead of retracing — the same
-    pattern as query_sharded._cached_fn."""
+def _global_fn_from_per_shard(per_shard):
+    """Global mesh + jitted shard_map closure over ALL processes' devices:
+    query-axis in_spec = MPI_Scatter; the replicated resharding constraint on
+    the output = MPI_Gatherv + broadcast, emitted by XLA over ICI/DCN."""
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from knn_tpu.backends.tpu import forward_tiled_core
-
     mesh = Mesh(np.array(jax.devices()), axis_names=("q",))
-
-    def per_shard(train_x, train_y, test_block, n_valid):
-        return forward_tiled_core(
-            train_x, train_y, test_block, n_valid,
-            k=k, num_classes=num_classes, precision=precision,
-            query_tile=query_tile, train_tile=train_tile,
-        )
-
     sharded = jax.shard_map(
         per_shard,
         mesh=mesh,
@@ -108,11 +97,45 @@ def _cached_global_fn(k, num_classes, precision, query_tile, train_tile):
     @jax.jit
     def fn(tx, ty, qx, nv):
         out = sharded(tx, ty, qx, nv)
-        # Reshard query-sharded -> replicated: the all-gather that plays
-        # MPI_Gatherv + broadcast, emitted by XLA over ICI/DCN.
         return jax.lax.with_sharding_constraint(out, NamedSharding(mesh, P()))
 
     return mesh, fn
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_global_fn(k, num_classes, precision, query_tile, train_tile):
+    """XLA tiled-scan engine, cached so repeat predicts (warmup, loops) reuse
+    XLA's compile cache instead of retracing — the same pattern as
+    query_sharded._cached_fn."""
+    from knn_tpu.backends.tpu import forward_tiled_core
+
+    def per_shard(train_x, train_y, test_block, n_valid):
+        return forward_tiled_core(
+            train_x, train_y, test_block, n_valid,
+            k=k, num_classes=num_classes, precision=precision,
+            query_tile=query_tile, train_tile=train_tile,
+        )
+
+    return _global_fn_from_per_shard(per_shard)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_global_stripe_fn(
+    k, num_classes, precision, block_q, block_n, d_true, interpret,
+    assume_finite,
+):
+    """Lane-striped Pallas engine for the multi-host path: each process's
+    devices classify their query shards with the single-chip headline kernel
+    over the replicated (transposed) train set — the full mpiexec replacement
+    at headline-kernel throughput per chip (VERDICT r1 #1 extended to
+    multi-controller). The per-shard body is shared with the
+    single-controller path (query_sharded.stripe_per_shard_classify)."""
+    from knn_tpu.parallel.query_sharded import stripe_per_shard_classify
+
+    return _global_fn_from_per_shard(stripe_per_shard_classify(
+        k, num_classes, precision, block_q, block_n, d_true, interpret,
+        assume_finite,
+    ))
 
 
 def predict_query_sharded_global(
@@ -124,27 +147,51 @@ def predict_query_sharded_global(
     precision: str = "exact",
     query_tile: int = 64,
     train_tile: int = 2048,
+    engine: str = "auto",
+    interpret: "bool | None" = None,
 ) -> np.ndarray:
     """Query-sharded classify over ALL devices of ALL processes.
 
     Call identically from every process with identical (replicated) host
-    arrays. Returns the full prediction vector on every process.
+    arrays. Returns the full prediction vector on every process. ``engine``
+    follows the shared rule (train_sharded.resolve_shard_engine): ``auto``
+    routes stripe-eligible problems to the lane-striped Pallas kernel.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from knn_tpu.parallel.train_sharded import resolve_shard_engine
     from knn_tpu.utils.padding import pad_axis_to_multiple
 
     q = test_x.shape[0]
     n = train_x.shape[0]
-    train_tile = max(min(train_tile, n), k)
-    mesh, fn = _cached_global_fn(k, num_classes, precision, query_tile, train_tile)
-    n_dev = mesh.devices.size
-    qx, _ = pad_axis_to_multiple(
-        test_x.astype(np.float32), n_dev * query_tile, axis=0
-    )
-    tx, _ = pad_axis_to_multiple(train_x.astype(np.float32), train_tile, axis=0)
-    ty, _ = pad_axis_to_multiple(train_y.astype(np.int32), train_tile, axis=0)
+    engine = resolve_shard_engine(engine, precision, train_x.shape[1], k)
+
+    if engine == "stripe":
+        from knn_tpu.parallel.query_sharded import stripe_query_sharded_prep
+
+        n_dev = len(jax.devices())
+        # n_t=1: train replicated (transposed for the kernel), queries split.
+        tx, ty, qx, block_q, block_n, interpret, assume_finite = (
+            stripe_query_sharded_prep(
+                train_x, train_y, test_x, k, n_dev, interpret,
+            )
+        )
+        mesh, fn = _cached_global_stripe_fn(
+            k, num_classes, precision, block_q, block_n, train_x.shape[1],
+            interpret, assume_finite,
+        )
+    else:
+        train_tile = max(min(train_tile, n), k)
+        mesh, fn = _cached_global_fn(
+            k, num_classes, precision, query_tile, train_tile
+        )
+        n_dev = mesh.devices.size
+        qx, _ = pad_axis_to_multiple(
+            test_x.astype(np.float32), n_dev * query_tile, axis=0
+        )
+        tx, _ = pad_axis_to_multiple(train_x.astype(np.float32), train_tile, axis=0)
+        ty, _ = pad_axis_to_multiple(train_y.astype(np.int32), train_tile, axis=0)
 
     def make_global(host_arr: np.ndarray, spec: P):
         sharding = NamedSharding(mesh, spec)
@@ -152,9 +199,9 @@ def predict_query_sharded_global(
             host_arr.shape, sharding, lambda idx: host_arr[idx]
         )
 
-    g_train_x = make_global(tx, P())
-    g_train_y = make_global(ty, P())
-    g_test_x = make_global(qx, P("q"))
+    g_train_x = make_global(np.ascontiguousarray(tx), P())
+    g_train_y = make_global(np.ascontiguousarray(ty), P())
+    g_test_x = make_global(np.ascontiguousarray(qx), P("q"))
     g_nv = make_global(np.asarray(n, np.int32), P())
 
     out = fn(g_train_x, g_train_y, g_test_x, g_nv)
@@ -173,6 +220,9 @@ def _worker_main(argv) -> int:
     p.add_argument("k", type=int)
     p.add_argument("--query-tile", type=int, default=64)
     p.add_argument("--train-tile", type=int, default=2048)
+    p.add_argument("--engine", default="auto", choices=["auto", "stripe", "xla"],
+                   help="per-shard candidate kernel (auto: stripe on real TPU "
+                   "for exact narrow-feature problems)")
     p.add_argument("--dump-predictions", default=None,
                    help="rank 0 writes the prediction vector here (npy)")
     args = p.parse_args(argv)
@@ -209,6 +259,7 @@ def _worker_main(argv) -> int:
             train.features, train.labels, test.features, args.k,
             train.num_classes,
             query_tile=args.query_tile, train_tile=args.train_tile,
+            engine=args.engine,
         )
 
     if rank == 0:  # rank-0 reporting, like mpi.cpp:188-199

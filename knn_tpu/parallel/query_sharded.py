@@ -59,6 +59,60 @@ def build_query_sharded_fn(
     return jax.jit(sharded)
 
 
+def stripe_per_shard_classify(
+    k: int,
+    num_classes: int,
+    precision: str,
+    block_q: int,
+    block_n: int,
+    d_true: int,
+    interpret: bool,
+    assume_finite: bool,
+):
+    """THE per-shard stripe classify body shared by every query-sharded
+    formulation (single-controller shard_map here, the multi-controller
+    global mesh in parallel/multihost.py): lane-striped Pallas candidates
+    over the replicated transposed train set, then the vote. One definition
+    so gating/block-size changes cannot drift between the single-process and
+    multi-host engines."""
+    from knn_tpu.ops.pallas_knn import stripe_candidates_core
+    from knn_tpu.ops.vote import vote
+
+    def per_shard(train_xT, train_y, test_block, n_valid):
+        _, _, lbl = stripe_candidates_core(
+            train_xT, train_y, test_block, n_valid, k,
+            block_q=block_q, block_n=block_n, d_true=d_true,
+            precision=precision, interpret=interpret,
+            assume_finite=assume_finite,
+        )
+        return vote(lbl, num_classes)
+
+    return per_shard
+
+
+def stripe_query_sharded_prep(
+    train_x, train_y, test_x, k, n_dev, interpret,
+    block_q=None, block_n=None,
+):
+    """Shared host-side prep for the stripe query-sharded paths: resolve
+    interpret mode, lay out the replicated transposed train + ``n_dev``-way
+    padded queries (n_t=1: only queries split), and evaluate the finiteness
+    gate. Returns ``(txT, ty, qx, block_q, block_n, interpret,
+    assume_finite)``."""
+    from knn_tpu.ops.pallas_knn import stripe_inputs_finite, stripe_prepare_sharded
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
+        train_x, train_y, test_x, k, 1, n_dev,
+        block_q=block_q, block_n=block_n,
+    )
+    return (
+        txT, ty, qx, block_q, block_n, interpret,
+        stripe_inputs_finite(train_x, test_x),
+    )
+
+
 def build_query_sharded_stripe_fn(
     mesh: Mesh,
     k: int,
@@ -79,17 +133,10 @@ def build_query_sharded_stripe_fn(
     multiple. ``assume_finite`` (only when pallas_knn.stripe_inputs_finite
     holds for the unpadded inputs) selects the kernel's cheaper
     index-retirement-free selection rounds."""
-    from knn_tpu.ops.pallas_knn import stripe_candidates_core
-    from knn_tpu.ops.vote import vote
-
-    def per_shard(train_xT, train_y, test_block, n_valid):
-        _, _, lbl = stripe_candidates_core(
-            train_xT, train_y, test_block, n_valid, k,
-            block_q=block_q, block_n=block_n, d_true=d_true,
-            precision=precision, interpret=interpret,
-            assume_finite=assume_finite,
-        )
-        return vote(lbl, num_classes)
+    per_shard = stripe_per_shard_classify(
+        k, num_classes, precision, block_q, block_n, d_true, interpret,
+        assume_finite,
+    )
 
     sharded = jax.shard_map(
         per_shard,
@@ -127,16 +174,12 @@ def _predict_query_sharded_stripe(
     train_x, train_y, test_x, k, num_classes, n_dev, precision,
     mesh=None, block_q=None, block_n=None, interpret=None,
 ):
-    from knn_tpu.ops.pallas_knn import stripe_inputs_finite, stripe_prepare_sharded
-
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     q, n = test_x.shape[0], train_x.shape[0]
-    assume_finite = stripe_inputs_finite(train_x, test_x)
-    # n_t=1: the train set is replicated (one "shard"), only queries split.
-    txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
-        train_x, train_y, test_x, k, 1, n_dev,
-        block_q=block_q, block_n=block_n,
+    txT, ty, qx, block_q, block_n, interpret, assume_finite = (
+        stripe_query_sharded_prep(
+            train_x, train_y, test_x, k, n_dev, interpret,
+            block_q=block_q, block_n=block_n,
+        )
     )
     if mesh is not None:
         fn = build_query_sharded_stripe_fn(
@@ -227,6 +270,7 @@ def predict(
         return predict_query_sharded_global(
             train.features, train.labels, test.features, k, train.num_classes,
             precision=precision, query_tile=query_tile, train_tile=train_tile,
+            engine=engine,
         )
     return predict_query_sharded(
         train.features, train.labels, test.features, k, train.num_classes,
