@@ -7,6 +7,15 @@ would give, (b) the current round structure, (c) a cheaper-retirement round
 structure, across block-size configs, so the winning variant can be promoted
 into ops/pallas_knn.py with evidence.
 
+HISTORICAL RECORD (r2): the "lite" variant won (~16% off the step at
+bq=864/bn=2048) and ships in ops/pallas_knn.py gated on finite inputs
+(stripe_inputs_finite — NaN/overflow inputs need full index retirement; see
+the counterexample in _knn_stripe_kernel). The shipped kernel has since also
+moved to per-chunk distance accumulation for VMEM headroom; this probe keeps
+the r2 decision-point kernel structure so its numbers stay reproducible.
+Measurement caveat learned later (see bench.py): use one DISTINCT buffer per
+dispatch — repeat-buffer slopes can collapse to enqueue cost.
+
 Usage: python scripts/tune_stripe_selection.py
 """
 
