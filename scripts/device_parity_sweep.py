@@ -46,6 +46,14 @@ def main(trials: int = 30) -> int:
             np.empty((0, d), np.float32),
             rng.integers(0, hi, (q - dup, d)).astype(np.float32),
         ])
+        if t % 3 == 0:
+            # NaN-poisoned trial: fails the stripe_inputs_finite gate, so the
+            # stripe paths run FULL index retirement — the branch the
+            # finite-input trials never compile on real hardware. The oracle
+            # pins the NaN->+inf policy incl. the index-ordered inf tail.
+            nan_rows = rng.choice(n, max(1, n // 10), replace=False)
+            train_x[nan_rows, rng.integers(0, d, nan_rows.size)] = np.nan
+            test_x[rng.choice(q, max(1, q // 20), replace=False)] = np.nan
         want = knn_oracle(train_x, train_y, test_x, k, c)
 
         paths = {
