@@ -24,6 +24,7 @@
 // C ABI only — bound from Python via ctypes (no pybind11 in this image).
 
 #include <cctype>
+#include <charconv>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -210,43 +211,224 @@ bool parse_attribute(const std::string& rest_in, ParseState& st) {
   return true;
 }
 
-bool cell_to_float(const std::string& tok, Attr& attr, float* out,
-                   ParseState& st) {
-  if (tok == "?") {
+bool cell_view_to_float(const char* p, size_t len, Attr& attr, float* out,
+                        ParseState& st) {
+  if (len == 1 && p[0] == '?') {
     *out = NAN;
     return true;
   }
   if (attr.type == "nominal") {
     for (size_t i = 0; i < attr.nominal.size(); ++i)
-      if (attr.nominal[i] == tok) {
+      if (attr.nominal[i].size() == len &&
+          memcmp(attr.nominal[i].data(), p, len) == 0) {
         *out = (float)i;
         return true;
       }
-    fail(st, "value '" + tok + "' not in nominal set for '" + attr.name + "'");
+    fail(st, "value '" + std::string(p, len) + "' not in nominal set for '" +
+             attr.name + "'");
     return false;
   }
   if (attr.type == "string" || attr.type == "date") {
+    std::string tok(p, len);
     auto ins = attr.intern_idx.emplace(tok, (int)attr.interned.size());
     if (ins.second) attr.interned.push_back(tok);
     *out = (float)ins.first->second;
     return true;
   }
+  // Numeric fast path: std::from_chars parses straight from the view, no
+  // allocation, no locale. It must consume the ENTIRE token (same acceptance
+  // rule as the old strtof+endp check). The fallback preserves strtof's
+  // wider acceptance — leading '+', hex floats, inf/nan spellings, and
+  // over/underflow (which from_chars reports as out_of_range but strtof
+  // clamps and accepts) — so the dialect is unchanged, just faster.
+  auto res = std::from_chars(p, p + len, *out);
+  if (res.ec == std::errc() && res.ptr == p + len) return true;
+  std::string tok(p, len);
   char* endp = nullptr;
   *out = strtof(tok.c_str(), &endp);
-  if (endp == tok.c_str() || *endp != '\0') {
+  if (len == 0 || endp != tok.c_str() + tok.size()) {
     fail(st, "cannot parse '" + tok + "' as a number for '" + attr.name + "'");
     return false;
   }
   return true;
 }
 
+// Streaming zero-copy scanner for the @data section — the ingest hot path.
+//
+// One pass over the raw buffer: tokens are (offset, length) views into it
+// (ARFF has no escape syntax, so even quoted content is a contiguous slice);
+// only quote-spliced composites like ab'cd'ef fall back to a scratch string.
+// Tokens buffer per ROW (views + their line numbers) and convert to float
+// when the row completes — preserving the reference reader's exact behavior
+// (arff_parser.cpp:121-153): rows span/share physical lines, a partial row
+// at EOF is DISCARDED UNCONVERTED (a malformed value there must not error),
+// while empty cells error at scan time like the per-line validation did.
+//
+// Tokenization semantics are split_csv's, verbatim: unquoted whitespace and
+// commas both terminate tokens, a comma directly after its token is that
+// token's terminator (so one trailing comma per line is absorbed and the
+// comma-state resets per line), ",," or a leading comma is an empty cell,
+// '%' comments only at the true line start, a first non-ws '{' is a sparse
+// row, '\r' is a token character unless it belongs to line-trailing
+// whitespace, quotes may not span lines.
+bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
+  const char* s = data.data();
+  const size_t N = data.size();
+  const size_t d = st.attrs.size();
+  if (N > UINT32_MAX) {
+    // Token views store 32-bit offsets; refuse cleanly rather than let a
+    // >= 4 GiB buffer wrap them into silently corrupt cells.
+    fail(st, "file exceeds the 4 GiB parser limit");
+    return false;
+  }
+
+  struct Tok {
+    uint32_t off, len;  // view into `data` when owned < 0
+    int32_t line;
+    int32_t owned;  // index into `owned` for composite tokens, else -1
+  };
+  std::vector<Tok> row;      // tokens of the row in progress
+  std::vector<std::string> owned;
+  row.reserve(d);
+
+  auto convert_row = [&]() -> bool {
+    int save_line = st.line;
+    for (size_t j = 0; j < d; ++j) {
+      const Tok& tk = row[j];
+      const char* p = tk.owned >= 0 ? owned[tk.owned].data() : s + tk.off;
+      size_t len = tk.owned >= 0 ? owned[tk.owned].size() : tk.len;
+      float v;
+      st.line = tk.line;  // cite the token's own line
+      if (!cell_view_to_float(p, len, st.attrs[j], &v, st)) return false;
+      st.cells.push_back(v);
+    }
+    st.line = save_line;
+    row.clear();
+    owned.clear();
+    return true;
+  };
+
+  while (pos < N) {
+    st.line++;
+    // '%' comments only at the true line start (arff_lexer.cpp:60-78).
+    if (s[pos] == '%') {
+      while (pos < N && s[pos] != '\n') pos++;
+      if (pos < N) pos++;
+      continue;
+    }
+    // Leading whitespace, then the sparse-row check on the first real char.
+    while (pos < N && (s[pos] == ' ' || s[pos] == '\t' || s[pos] == '\r'))
+      pos++;
+    if (pos < N && s[pos] == '{') {
+      // Not quite: a leading '\r' run reaching the newline is a blank line,
+      // already skipped above; a real first char '{' is a sparse row.
+      fail(st, "sparse ARFF rows are not supported");
+      return false;
+    }
+    bool token_since_comma = false;  // resets per physical line
+    while (pos < N && s[pos] != '\n') {
+      char c = s[pos];
+      if (c == ' ' || c == '\t') {
+        pos++;
+        continue;
+      }
+      if (c == '\r') {
+        // Line-trailing [ \t\r]* is stripped; an interior '\r' is a token
+        // character (split_csv semantics).
+        size_t q = pos;
+        while (q < N && (s[q] == ' ' || s[q] == '\t' || s[q] == '\r')) q++;
+        if (q >= N || s[q] == '\n') {
+          pos = q;
+          continue;
+        }
+      }
+      if (c == ',') {
+        if (token_since_comma) {
+          token_since_comma = false;  // separator for the previous token
+        } else {
+          fail(st, "empty value in data row");
+          return false;
+        }
+        pos++;
+        continue;
+      }
+      // Token scan: c starts a token (possibly '\r', possibly a quote).
+      uint32_t t_off = (uint32_t)pos, t_len = 0;
+      int32_t t_owned = -1;
+      while (pos < N && s[pos] != '\n') {
+        char ch = s[pos];
+        if (ch == '\'' || ch == '"') {
+          size_t close = pos + 1;
+          while (close < N && s[close] != ch && s[close] != '\n') close++;
+          if (close >= N || s[close] == '\n') {
+            fail(st, "unterminated quoted value");
+            return false;
+          }
+          if (t_len == 0 && t_owned < 0) {
+            // Token starts with a quote: stay a zero-copy view. If more
+            // token characters follow, the discontiguity check in the
+            // append branch promotes it to an owned splice.
+            t_off = (uint32_t)(pos + 1);
+            t_len = (uint32_t)(close - (pos + 1));
+            pos = close + 1;
+            continue;
+          }
+          if (t_owned < 0) {
+            owned.emplace_back(s + t_off, t_len);
+            t_owned = (int32_t)owned.size() - 1;
+            t_len = 0;
+          }
+          owned[t_owned].append(s + pos + 1, close - (pos + 1));
+          pos = close + 1;
+          continue;
+        }
+        if (ch == ' ' || ch == '\t' || ch == ',') break;
+        if (ch == '\r') {
+          size_t q = pos;
+          while (q < N && (s[q] == ' ' || s[q] == '\t' || s[q] == '\r')) q++;
+          if (q >= N || s[q] == '\n') break;  // line-trailing whitespace
+        }
+        if (t_owned >= 0) {
+          owned[t_owned].push_back(ch);
+        } else if (t_len > 0 && (size_t)t_off + t_len != pos) {
+          // Discontiguous continuation (the view came from a quoted slice,
+          // e.g. 'ab'cd): promote to an owned splice.
+          owned.emplace_back(s + t_off, t_len);
+          t_owned = (int32_t)owned.size() - 1;
+          owned[t_owned].push_back(ch);
+          t_len = 0;
+        } else {
+          if (t_len == 0) t_off = (uint32_t)pos;
+          t_len++;
+        }
+        pos++;
+      }
+      if (t_owned < 0 && t_len == 0) {
+        // '' / "" — an empty quoted cell (split_csv pushed "" here).
+        fail(st, "empty value in data row");
+        return false;
+      }
+      if (t_owned >= 0 && owned[t_owned].empty()) {
+        fail(st, "empty value in data row");
+        return false;
+      }
+      row.push_back({t_off, t_len, st.line, t_owned});
+      if (pos < N && s[pos] == ',') {
+        pos++;
+        token_since_comma = false;  // the comma terminated its own token
+      } else {
+        token_since_comma = true;
+      }
+      if (row.size() == d && !convert_row()) return false;
+    }
+    if (pos < N) pos++;  // consume '\n'
+  }
+  // A partial row at EOF is discarded unconverted (arff_parser.cpp:130-133).
+  return true;
+}
+
 bool parse_buffer(const std::string& data, ParseState& st) {
   size_t pos = 0;
-  bool in_data = false;
-  // (cell, lineno) carried across physical lines (multi-line rows); the
-  // lineno keeps error locations on the token's own line.
-  std::vector<std::pair<std::string, int>> pending;
-  std::vector<std::string> cells;
   while (pos <= data.size()) {
     size_t nl = data.find('\n', pos);
     std::string raw = nl == std::string::npos ? data.substr(pos)
@@ -258,7 +440,7 @@ bool parse_buffer(const std::string& data, ParseState& st) {
     if (!raw.empty() && raw[0] == '%') continue;
     std::string line = strip(raw);
     if (line.empty()) continue;
-    if (!in_data && line[0] == '@') {
+    if (line[0] == '@') {
       size_t sp = line.find_first_of(" \t");
       std::string word = sp == std::string::npos ? line : line.substr(0, sp);
       std::string rest = sp == std::string::npos ? "" : strip(line.substr(sp));
@@ -275,50 +457,20 @@ bool parse_buffer(const std::string& data, ParseState& st) {
           fail(st, "@data before any @attribute");
           return false;
         }
-        in_data = true;
+        // Hand the rest of the buffer (everything after this line's newline)
+        // to the streaming zero-copy data scanner.
+        return parse_data_stream(data, pos, st);
       } else {
         fail(st, "unknown keyword '" + word + "'");
         return false;
       }
       continue;
     }
-    if (!in_data) {
-      fail(st, "unexpected content before @data: '" + line + "'");
-      return false;
-    }
-    if (line[0] == '{') {
-      fail(st, "sparse ARFF rows are not supported");
-      return false;
-    }
-    if (!split_csv(line, cells, st)) return false;
-    for (const std::string& c : cells)
-      if (c.empty()) {
-        fail(st, "empty value in data row");
-        return false;
-      }
-    // The reference's reader consumes exactly num_attributes tokens per
-    // instance from the @data token stream regardless of line breaks
-    // (arff_parser.cpp:121-153): rows may span physical lines AND several
-    // rows may share one line, so accumulate tokens and emit every full
-    // group of num_attributes.
-    for (const std::string& c : cells) pending.emplace_back(c, st.line);
-    size_t d = st.attrs.size();
-    size_t off = 0;  // offset walk: one erase per line, not per row
-    int cur_line = st.line;
-    while (pending.size() - off >= d) {
-      for (size_t j = 0; j < d; ++j) {
-        float v;
-        st.line = pending[off + j].second;  // cite the token's own line
-        if (!cell_to_float(pending[off + j].first, st.attrs[j], &v, st))
-          return false;
-        st.cells.push_back(v);
-      }
-      off += d;
-    }
-    st.line = cur_line;
-    if (off) pending.erase(pending.begin(), pending.begin() + off);
+    fail(st, "unexpected content before @data: '" + line + "'");
+    return false;
   }
-  // A partial row at EOF is discarded (arff_parser.cpp:130-133).
+  // No @data section at all. Match the historical error precedence: a file
+  // with no @attribute declarations reports that first.
   if (st.attrs.empty()) {
     st.line = 0;
     fail(st, "no @attribute declarations found");
