@@ -354,5 +354,10 @@ def parse_arff_lines(
 
 
 def parse_arff_file(path: str) -> Dataset:
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        return parse_arff_lines(f, path=str(path))
+    # newline="" + manual split: physical lines end at '\n' ONLY, like the
+    # reference scanner (NEWLINE = '\n', arff_scanner.cpp:4) and the native
+    # twin. Universal-newline mode would turn a lone '\r' into a line break,
+    # where the dialect treats interior '\r' as a token character ('\r\n'
+    # endings still work — the trailing '\r' strips as whitespace).
+    with open(path, "r", encoding="utf-8", errors="replace", newline="") as f:
+        return parse_arff_lines(f.read().split("\n"), path=str(path))

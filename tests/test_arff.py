@@ -137,6 +137,28 @@ class TestDialect:
         np.testing.assert_array_equal(ds.features, [[1.0], [3.0]])
         np.testing.assert_array_equal(ds.labels, [2, 4])
 
+    def test_interior_cr_is_a_token_char(self, tmp_path):
+        # The reference scanner's NEWLINE is '\n' alone (arff_scanner.cpp:4)
+        # and '\r' is not lexer whitespace (arff_lexer.cpp:28), so an
+        # interior '\r' belongs to its token — both parsers must agree
+        # (universal-newline file reading used to split pyarff lines at a
+        # lone '\r'). CRLF line endings still parse (trailing '\r' strips).
+        bad = tmp_path / "cr.arff"
+        bad.write_bytes(
+            b"@relation t\n@attribute a NUMERIC\n@attribute class NUMERIC\n"
+            b"@data\n1\r2,0\n3,1\n"
+        )
+        with pytest.raises(pyarff.ArffError, match=r"cannot parse '1\r2'"):
+            pyarff.parse_arff_file(str(bad))
+        crlf = tmp_path / "crlf.arff"
+        crlf.write_bytes(
+            b"@relation t\r\n@attribute a NUMERIC\r\n"
+            b"@attribute class NUMERIC\r\n@data\r\n1,0\r\n3,1\r\n"
+        )
+        ds = pyarff.parse_arff_file(str(crlf))
+        np.testing.assert_array_equal(ds.features, [[1.0], [3.0]])
+        np.testing.assert_array_equal(ds.labels, [0, 1])
+
     def test_indented_percent_is_data_not_comment(self):
         # '%' starts a comment only at the true line start
         # (arff_lexer.cpp:60-78); indented it is a data token, which fails
