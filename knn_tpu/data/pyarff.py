@@ -353,6 +353,89 @@ def parse_arff_lines(
     )
 
 
+# First line whose stripped start is the @data keyword (word-bounded, so
+# "@database" stays an unknown-keyword error for the full parser).
+_DATA_RE = re.compile(r"(?mi)^[ \t\r]*@data(?=[ \t\r]|\r?$)")
+# Empty-cell comma patterns the comma->space translation would silently
+# swallow: ",,", a line-leading comma (",  ," covered by the first).
+_BAD_COMMA_RE = re.compile(r",[ \t\r]*,|^[ \t\r]*,|\n[ \t\r]*,")
+
+
+def _parse_numeric_fast(raw: str, path: str) -> "Dataset | None":
+    """Vectorized parse for the common all-numeric case (~25x the
+    token-by-token path): headers go through the full parser, then the @data
+    section becomes one ``str.split`` + ``np.array(..., float32)`` — bitwise
+    identical to the slow path (both convert decimal text at float64 and
+    round once to float32). Returns None whenever ANY dialect subtlety might
+    apply — quotes, comments, missing values, sparse braces, empty-cell
+    comma patterns, non-numeric attributes, non-finite values, conversion
+    failures — so every error case falls through to the full parser and its
+    located messages."""
+    m = _DATA_RE.search(raw)
+    if m is None:
+        return None
+    data_end = raw.find("\n", m.end())
+    if data_end < 0:
+        return None
+    head_lines = raw[: m.start()].split("\n")
+    if head_lines and head_lines[-1] == "":
+        # The slice ends at the newline BEFORE the @data line; drop the
+        # phantom empty piece so the appended "@data" keeps its real line
+        # number (errors like "@data before any @attribute" cite it).
+        head_lines.pop()
+    header = parse_arff_lines(head_lines + ["@data"], path)
+    if not all(a.type == "numeric" for a in header.attributes):
+        return None
+    sec = raw[data_end + 1 :]
+    # Eligible content is exactly the plain ASCII float charset plus the
+    # separators the dialect shares with str.split(): anything else — quotes,
+    # comments, '?', sparse braces, letters (inf/nan/unicode digits, which
+    # numpy and _strtof accept differently), '_' (Python float accepts,
+    # _strtof rejects), '\f'/'\v' (str.split() whitespace but dialect token
+    # chars), or a '\r' outside a CRLF ending (token char, split() whitespace:
+    # test_interior_cr_is_a_token_char) — defers to the full parser.
+    if re.search(r"[^0-9eE+\-. \t\r\n,]|\r(?!\n)", sec) or _BAD_COMMA_RE.search(sec):
+        return None
+    toks = sec.replace(",", " ").split()
+    try:
+        arr64 = np.array(toks, dtype=np.float64)
+    except (ValueError, OverflowError):
+        return None  # a malformed token: the full parser owns the error
+    with np.errstate(over="ignore"):
+        # f32-range overflow (e.g. '1e40') clamps to inf like strtof; the
+        # non-finite check below then defers to the full parser without the
+        # cast warning escaping (it would crash under warnings-as-errors).
+        arr = arr64.astype(np.float32)
+    d = len(header.attributes)
+    n = arr.size // d  # partial row at EOF discarded (arff_parser.cpp:130-133)
+    if n == 0 or not np.isfinite(arr[: n * d]).all():
+        return None  # inf/nan cells: defer to the full parser's handling
+    # Double-rounding repair: the contract is C strtof's correctly-rounded
+    # decimal->f32 (what the native twin and _strtof produce). Going through
+    # f64 diverges ONLY when the f64 value lands exactly on an f32 midpoint
+    # (any true value near a midpoint rounds TO that midpoint in f64, so a
+    # non-midpoint f64 decides the f32 the same way the true value would).
+    # Those rare tokens re-parse through _strtof.
+    cast64 = arr.astype(np.float64)
+    mid_hi = (cast64 + np.nextafter(arr, np.float32(np.inf)).astype(np.float64)) / 2
+    mid_lo = (cast64 + np.nextafter(arr, np.float32(-np.inf)).astype(np.float64)) / 2
+    amb = np.nonzero((arr64 == mid_hi) | (arr64 == mid_lo))[0]
+    for i in amb:
+        try:
+            arr[i] = _strtof(toks[i])
+        except ValueError:
+            return None
+    mat = arr[: n * d].reshape(n, d)
+    raw_labels = mat[:, d - 1]
+    return Dataset(
+        features=mat[:, : d - 1],
+        labels=raw_labels.astype(np.int32),
+        relation=header.relation,
+        attributes=header.attributes,
+        raw_targets=raw_labels.astype(np.float32),
+    )
+
+
 def parse_arff_file(path: str) -> Dataset:
     # newline="" + manual split: physical lines end at '\n' ONLY, like the
     # reference scanner (NEWLINE = '\n', arff_scanner.cpp:4) and the native
@@ -360,4 +443,8 @@ def parse_arff_file(path: str) -> Dataset:
     # where the dialect treats interior '\r' as a token character ('\r\n'
     # endings still work — the trailing '\r' strips as whitespace).
     with open(path, "r", encoding="utf-8", errors="replace", newline="") as f:
-        return parse_arff_lines(f.read().split("\n"), path=str(path))
+        raw = f.read()
+    fast = _parse_numeric_fast(raw, str(path))
+    if fast is not None:
+        return fast
+    return parse_arff_lines(raw.split("\n"), path=str(path))

@@ -159,6 +159,56 @@ class TestDialect:
         np.testing.assert_array_equal(ds.features, [[1.0], [3.0]])
         np.testing.assert_array_equal(ds.labels, [0, 1])
 
+    def test_numeric_fast_path_bitwise_matches_slow(self, tmp_path, rng):
+        # The vectorized all-numeric fast path must be bitwise identical to
+        # the token-by-token parser across separator styles, multi-line and
+        # shared-line rows, ragged whitespace, and a partial trailing row.
+        from knn_tpu.data.pyarff import _parse_numeric_fast, parse_arff_lines
+
+        for trial in range(20):
+            d = int(rng.integers(2, 6))
+            n = int(rng.integers(1, 40))
+            vals = (rng.normal(0, 10, (n, d)) * 10.0 **
+                    rng.integers(-6, 7, (n, d))).astype(np.float32)
+            vals[:, -1] = rng.integers(0, 5, n)
+            toks = [repr(float(v)) if rng.random() < 0.5 else f"{v:.6g}"
+                    for v in vals.ravel()]
+            body, line = [], []
+            for tk in toks:
+                line.append(tk + (rng.choice([",", " ", ",\t"])))
+                if rng.random() < 0.3:
+                    body.append("".join(line))
+                    line = []
+            body.append("".join(line))
+            if rng.random() < 0.5:
+                body.append("0.5 1")  # partial trailing row: discarded
+            hdr = ["@relation r"] + [f"@attribute a{j} NUMERIC" for j in range(d - 1)] \
+                + ["@attribute class NUMERIC", "@data"]
+            raw = "\n".join(hdr + body) + "\n"
+            fast = _parse_numeric_fast(raw, "<t>")
+            slow = parse_arff_lines(raw.split("\n"), "<t>")
+            assert fast is not None, f"trial {trial} fell back unexpectedly"
+            np.testing.assert_array_equal(
+                fast.features.view(np.uint32), slow.features.view(np.uint32))
+            np.testing.assert_array_equal(fast.labels, slow.labels)
+            np.testing.assert_array_equal(
+                fast.raw_targets.view(np.uint32),
+                slow.raw_targets.view(np.uint32))
+
+    def test_fast_path_defers_dialect_subtleties(self, tmp_path):
+        # Files with quotes / comments / missing values / empty cells /
+        # sparse braces / nominal attrs must take the full parser.
+        from knn_tpu.data.pyarff import _parse_numeric_fast
+
+        hdr = ("@relation r\n@attribute x NUMERIC\n"
+               "@attribute class NUMERIC\n@data\n")
+        for body in ("'1',0\n", "% c\n1,0\n", "?,0\n", "1,,0\n", ",1\n",
+                     "{0 1},0\n"):
+            assert _parse_numeric_fast(hdr + body, "<t>") is None, body
+        nom = ("@relation r\n@attribute c {a,b}\n"
+               "@attribute class NUMERIC\n@data\na,0\n")
+        assert _parse_numeric_fast(nom, "<t>") is None
+
     def test_indented_percent_is_data_not_comment(self):
         # '%' starts a comment only at the true line start
         # (arff_lexer.cpp:60-78); indented it is a data token, which fails
