@@ -95,6 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
         "knobs) are rejected. Predictions per k are identical to individual "
         "runs",
     )
+    p.add_argument(
+        "--dump-predictions", default=None, metavar="FILE.npy",
+        help="save the int32 prediction vector (with --sweep-k: one file per "
+        "k, FILE.k{K}.npy) — lets graders diff predictions, not just the "
+        "accuracy field",
+    )
     p.add_argument("--json", action="store_true", help="emit structured JSON metrics")
     p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--warmup", action="store_true",
@@ -102,6 +108,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--approx", action="store_true",
                    help="TPU hardware approximate top-k (not prediction-exact)")
     return p
+
+
+def _dump_predictions(path: str, preds) -> bool:
+    """Save a prediction vector, keeping the CLI's error contract (a bad
+    path reports ``error: ...`` and exits 1, never a traceback). Runs AFTER
+    the result line so a failed save can't discard the computed output."""
+    import numpy as np
+
+    try:
+        np.save(path, preds)
+        return True
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return False
 
 
 def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
@@ -184,6 +204,9 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        base = args.dump_predictions
+        if base and base.endswith(".npy"):
+            base = base[:-4]
         for k in sweep_ks:
             acc = accuracy(confusion_matrix(
                 preds_by_k[k], test.labels, test.num_classes))
@@ -197,6 +220,9 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
                                 t.ms, acc, f"sweep:{args.engine}"),
                     file=stdout,
                 )
+            if base:
+                if not _dump_predictions(f"{base}.k{k}.npy", preds_by_k[k]):
+                    return 1
         return 0
 
     backend_name = args.backend or _PERSONAS[args.persona][0]
@@ -271,6 +297,10 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         result_line(args.k, test.num_instances, train.num_instances, t.ms, acc),
         file=stdout,
     )
+    if args.dump_predictions and not _dump_predictions(
+        args.dump_predictions, predictions
+    ):
+        return 1
     if args.json:
         print(
             result_json(args.k, test.num_instances, train.num_instances, t.ms, acc,

@@ -138,3 +138,45 @@ class TestSweepK:
                       ["--query-tile", "64"], ["4"]):
             assert run([paths[0], paths[1], "1", *extra, "--sweep-k", "1,5"]) == 1
             assert "incompatible" in capsys.readouterr().err
+
+
+class TestDumpPredictions:
+    def test_dump_matches_oracle(self, paths, tmp_path):
+        import numpy as np
+
+        from knn_tpu.backends.oracle import knn_oracle
+        from knn_tpu.data.arff import load_arff
+
+        out = tmp_path / "preds.npy"
+        assert run([paths[0], paths[1], "3", "--backend", "oracle",
+                    "--dump-predictions", str(out)], stdout=io.StringIO()) == 0
+        train, test = load_arff(paths[0]), load_arff(paths[1])
+        want = knn_oracle(
+            train.features, train.labels, test.features, 3, train.num_classes
+        )
+        np.testing.assert_array_equal(np.load(out), want)
+
+    def test_sweep_dumps_one_file_per_k(self, paths, tmp_path):
+        import numpy as np
+
+        base = tmp_path / "p.npy"
+        assert run([paths[0], paths[1], "1", "--sweep-k", "1,5",
+                    "--engine", "xla", "--dump-predictions", str(base)],
+                   stdout=io.StringIO()) == 0
+        for k in (1, 5):
+            single = tmp_path / f"single{k}.npy"
+            assert run([paths[0], paths[1], str(k), "--backend", "oracle",
+                        "--dump-predictions", str(single)],
+                       stdout=io.StringIO()) == 0
+            np.testing.assert_array_equal(
+                np.load(tmp_path / f"p.k{k}.npy"), np.load(single)
+            )
+
+    def test_unwritable_dump_path_clean_error(self, paths, capsys):
+        out = io.StringIO()
+        assert run([paths[0], paths[1], "1", "--backend", "oracle",
+                    "--dump-predictions", "/no/such/dir/p.npy"],
+                   stdout=out) == 1
+        assert "error:" in capsys.readouterr().err
+        # The result line still printed — the compute is not discarded.
+        assert LINE_RE.match(out.getvalue().strip())
