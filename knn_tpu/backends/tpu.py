@@ -39,7 +39,8 @@ from knn_tpu.utils.padding import pad_axis_to_multiple
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "num_classes", "precision", "approx")
+    jax.jit,
+    static_argnames=("k", "num_classes", "precision", "approx", "recall_target"),
 )
 def knn_forward(
     train_x: jnp.ndarray,
@@ -49,17 +50,20 @@ def knn_forward(
     num_classes: int,
     precision: str = "exact",
     approx: bool = False,
+    recall_target: float = 0.95,
 ) -> jnp.ndarray:
     """Full-matrix KNN classify: [N,D] train, [N] labels, [Q,D] queries ->
     [Q] int32 predictions.
 
     ``approx=True`` swaps ``lax.top_k`` for ``lax.approx_max_k`` — the TPU's
-    hardware-accelerated approximate selection (default target recall 0.95).
-    A capability with no reference analogue: trade exact candidate selection
-    for throughput on very large N. Not prediction-parity; opt-in only."""
+    hardware-accelerated approximate selection, with ``recall_target``
+    setting the per-candidate expected recall (higher = slower + closer to
+    exact). A capability with no reference analogue: trade exact candidate
+    selection for throughput on very large N. Not prediction-parity;
+    opt-in only."""
     d = _DIST_FNS[precision](test_x, train_x)
     if approx:
-        _, idx = lax.approx_max_k(-d, k)
+        _, idx = lax.approx_max_k(-d, k, recall_target=recall_target)
         idx = idx.astype(jnp.int32)
     else:
         _, idx = topk_smallest(d, k)
@@ -209,6 +213,7 @@ _FULL_MATRIX_CELL_LIMIT = 16 * 1024 * 1024
 def _predict_query_batched(
     train_x, train_y, test_x, k, num_classes, *,
     precision, query_tile, train_tile, force_tiled, approx, query_batch,
+    recall_target=0.95,
 ):
     """Stream queries in fixed ``query_batch`` chunks (last chunk padded so
     one compiled shape serves every dispatch). A small in-flight window of
@@ -245,7 +250,7 @@ def _predict_query_batched(
         if use_full or approx:
             pending.append(knn_forward(
                 tx, ty, jnp.asarray(chunk), k=k, num_classes=num_classes,
-                precision=precision, approx=approx,
+                precision=precision, approx=approx, recall_target=recall_target,
             ))
         else:
             qp, _ = pad_axis_to_multiple(chunk, query_tile, axis=0)
@@ -276,6 +281,7 @@ def predict_arrays(
     query_batch: "int | None" = None,
     engine: str = "auto",
     device_cache: "dict | None" = None,
+    recall_target: float = 0.95,
 ) -> np.ndarray:
     """Host-side entry: pads, dispatches to the right compiled path, unpads.
     ``approx`` (full-matrix path only) uses TPU hardware approximate top-k.
@@ -341,11 +347,13 @@ def predict_arrays(
             train_x, train_y, test_x, k, num_classes,
             precision=precision, query_tile=query_tile, train_tile=train_tile,
             force_tiled=force_tiled, approx=approx, query_batch=query_batch,
+            recall_target=recall_target,
         )
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
         out = knn_forward(
             jnp.asarray(train_x), jnp.asarray(train_y), jnp.asarray(test_x),
             k=k, num_classes=num_classes, precision=precision, approx=approx,
+            recall_target=recall_target,
         )
         return np.asarray(out)
 
@@ -375,13 +383,16 @@ def predict(
     metric: str = "euclidean",
     query_batch: "int | None" = None,
     engine: str = "auto",
+    recall_target: float = 0.95,
     **_unused,
 ) -> np.ndarray:
     train.validate_for_knn(k, test)
+    if not (0.0 < recall_target <= 1.0):
+        raise ValueError(f"recall_target must be in (0, 1], got {recall_target}")
     return predict_arrays(
         train.features, train.labels, test.features, k, train.num_classes,
         precision=precision, query_tile=query_tile, train_tile=train_tile,
         force_tiled=force_tiled, approx=approx, metric=metric,
         query_batch=query_batch, engine=engine,
-        device_cache=train.device_cache,
+        device_cache=train.device_cache, recall_target=recall_target,
     )

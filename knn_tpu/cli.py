@@ -107,6 +107,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run once before timing (excludes compile time)")
     p.add_argument("--approx", action="store_true",
                    help="TPU hardware approximate top-k (not prediction-exact)")
+    p.add_argument("--recall-target", type=float, default=None,
+                   help="per-candidate expected recall for --approx "
+                   "(0 < r <= 1, default 0.95; higher = slower, closer to "
+                   "exact)")
     return p
 
 
@@ -275,6 +279,12 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
         opts["engine"] = args.engine
     if args.approx:
         opts["approx"] = True
+    if args.recall_target is not None:
+        if not args.approx:
+            print("error: --recall-target only applies with --approx",
+                  file=sys.stderr)
+            return 1
+        opts["recall_target"] = args.recall_target
     if args.threads is not None:
         opts["num_threads"] = args.threads
     if args.devices is not None:

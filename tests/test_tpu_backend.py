@@ -218,3 +218,30 @@ class TestEngineDispatch:
             np.zeros((0, 2), np.float32), 1, 2,
         )
         assert out.shape == (0,) and out.dtype == np.int32
+
+
+class TestRecallTarget:
+    def test_recall_one_matches_exact(self, rng):
+        # recall_target=1.0 makes approx_max_k exhaustive: on a problem with
+        # distinct distances the predictions must equal the exact path.
+        import numpy as np
+
+        from knn_tpu.backends.tpu import predict_arrays
+
+        train_x = rng.normal(size=(300, 6)).astype(np.float32)
+        train_y = rng.integers(0, 5, 300).astype(np.int32)
+        test_x = rng.normal(size=(40, 6)).astype(np.float32)
+        want = predict_arrays(train_x, train_y, test_x, 5, 5)
+        got = predict_arrays(
+            train_x, train_y, test_x, 5, 5, approx=True, recall_target=1.0
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_bad_recall_rejected(self, small):
+        import pytest
+
+        from knn_tpu.backends import get_backend
+
+        train, test = small
+        with pytest.raises(ValueError, match="recall_target"):
+            get_backend("tpu")(train, test, 3, approx=True, recall_target=1.5)
