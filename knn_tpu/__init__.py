@@ -27,10 +27,13 @@ Euclidean over the first D-1 attributes, first-seen train index wins distance
 ties, lowest class id wins vote ties, ``num_classes = max(label)+1``.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.data.arff import load_arff
-from knn_tpu.models.knn import KNNClassifier, KNNRegressor
+from knn_tpu.data.arff import load_arff, write_arff
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor, sweep_k
 
-__all__ = ["Dataset", "load_arff", "KNNClassifier", "KNNRegressor", "__version__"]
+__all__ = [
+    "Dataset", "load_arff", "write_arff", "KNNClassifier", "KNNRegressor",
+    "sweep_k", "__version__",
+]
