@@ -291,9 +291,8 @@ class KNNClassifier:
             return scores / scores.sum(axis=1, keepdims=True)
         _, idx = self.kneighbors(test)
         labels = train.labels[np.minimum(idx, train.num_instances - 1)]
-        counts = np.apply_along_axis(
-            np.bincount, 1, labels, minlength=train.num_classes
-        )
+        counts = np.zeros((labels.shape[0], train.num_classes), np.int64)
+        np.add.at(counts, (np.arange(labels.shape[0])[:, None], labels), 1)
         return counts.astype(np.float64) / self.k
 
     def confusion_matrix(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> np.ndarray:
