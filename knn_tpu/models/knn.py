@@ -291,8 +291,12 @@ class KNNClassifier:
             return scores / scores.sum(axis=1, keepdims=True)
         _, idx = self.kneighbors(test)
         labels = train.labels[np.minimum(idx, train.num_instances - 1)]
-        counts = np.zeros((labels.shape[0], train.num_classes), np.int64)
-        np.add.at(counts, (np.arange(labels.shape[0])[:, None], labels), 1)
+        # One flattened bincount builds the [Q, C] histogram (np.add.at's
+        # unbuffered scatter is ~10x slower at scale).
+        nq, c = labels.shape[0], train.num_classes
+        counts = np.bincount(
+            (np.arange(nq)[:, None] * c + labels).ravel(), minlength=nq * c
+        ).reshape(nq, c)
         return counts.astype(np.float64) / self.k
 
     def confusion_matrix(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> np.ndarray:

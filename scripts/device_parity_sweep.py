@@ -34,7 +34,10 @@ def main(trials: int = 30) -> int:
     for t in range(trials):
         n = int(rng.integers(3, 6000))
         q = int(rng.integers(1, 700))
-        d = int(rng.integers(1, 33))
+        # Up to the stripe auto-eligibility boundary (128): wide-d trials
+        # compile slower (the exact unroll scales with d) but exercise the
+        # widths the auto rule now routes to the kernel.
+        d = int(rng.integers(1, 129))
         k = int(rng.integers(1, min(n, 16) + 1))
         c = int(rng.integers(2, 11))
         hi = int(rng.integers(2, 6))  # small grid => dist==0 ties abound
