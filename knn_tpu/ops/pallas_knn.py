@@ -56,6 +56,36 @@ STRIPE_MAX_D = 128
 STRIPE_MAX_K = 16
 
 
+def _tree_min(planes):
+    """Min fold over planes. Short lists use the plain sequential fold; long
+    lists (the xl config's 96+k planes) switch to groups of 8 reduced
+    pairwise (log-depth) and chained — the sequential chain, not VPU
+    throughput, bounds those selection rounds. Grouping caps how many
+    intermediates are live at once: a full pairwise tree keeps ~p/2 planes
+    alive and that extra Mosaic stack blew the 16 MB scoped-VMEM limit by
+    256 KB at the headline (448, 2048, k=5) shape, where plane counts are
+    small and the chain is fine anyway."""
+    planes = list(planes)
+    if len(planes) < 48:
+        acc = planes[0]
+        for p in planes[1:]:
+            acc = jnp.minimum(acc, p)
+        return acc
+    acc = None
+    for i in range(0, len(planes), 8):
+        grp = planes[i : i + 8]
+        while len(grp) > 1:
+            nxt = [
+                jnp.minimum(grp[j], grp[j + 1])
+                for j in range(0, len(grp) - 1, 2)
+            ]
+            if len(grp) % 2:
+                nxt.append(grp[-1])
+            grp = nxt
+        acc = grp[0] if acc is None else jnp.minimum(acc, grp[0])
+    return acc
+
+
 def _merge_topk_rounds(
     d_cat: jnp.ndarray, i_cat: jnp.ndarray, k: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -252,11 +282,19 @@ def _knn_stripe_kernel(
         # transposed train layout makes the cross term one dot with the
         # feature (sublane) axis contracted. Wide-feature mode: not
         # prediction-exact near 0 (ops/distance.py caveats apply).
-        t = tT_ref[:]  # [D_pad, BN]
+        #
+        # The train tile may arrive STORED as bf16 (wide-feature configs are
+        # bound by the [D, N] HBM re-stream per query tile — half the bytes
+        # is the speedup); norms then accumulate in f32 from the same
+        # bf16-rounded values the matmul consumes, so the distance is exact
+        # for the rounded operands.
+        t = tT_ref[:]  # [D_pad, BN], f32 or bf16
+        t32 = t.astype(jnp.float32)
         q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
-        t2 = jnp.sum(t * t, axis=0).reshape(1, block_n)  # [1, BN]
-        qc, tc = (q.astype(jnp.bfloat16), t.astype(jnp.bfloat16)) \
-            if precision == "bf16" else (q, t)
+        t2 = jnp.sum(t32 * t32, axis=0).reshape(1, block_n)  # [1, BN]
+        qc, tc = (q.astype(jnp.bfloat16),
+                  t if t.dtype == jnp.bfloat16 else t.astype(jnp.bfloat16)) \
+            if precision == "bf16" else (q, t32)
         cross = jax.lax.dot_general(
             qc, tc,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -300,14 +338,11 @@ def _knn_stripe_kernel(
     # (first-seen-wins, main.cpp:47). Retirement keys on index alone — global
     # indices are unique, and the INT_MAX padding dupes all carry +inf.
     for level in range(k):
-        m_d = d_planes[0]
-        for p in range(1, len(d_planes)):
-            m_d = jnp.minimum(m_d, d_planes[p])
-        m_i = _INT_MAX * jnp.ones_like(i_planes[0])
-        for p in range(len(d_planes)):
-            m_i = jnp.minimum(
-                m_i, jnp.where(d_planes[p] == m_d, i_planes[p], _INT_MAX)
-            )
+        m_d = _tree_min(d_planes)
+        m_i = _tree_min(
+            jnp.where(d_planes[p] == m_d, i_planes[p], _INT_MAX)
+            for p in range(len(d_planes))
+        )
         cand_d_ref[:, level * lanes : (level + 1) * lanes] = m_d
         cand_i_ref[:, level * lanes : (level + 1) * lanes] = m_i
         if level + 1 < k:
@@ -375,6 +410,11 @@ def knn_pallas_stripe_candidates(
     q_pad = test_x.shape[0]
     assert n_pad % block_n == 0 and q_pad % block_q == 0 and block_n % 128 == 0
     assert d_true is None or d_true <= d_pad
+    # A bf16-stored train operand (half the HBM re-stream per query tile) is
+    # only meaningful to the bf16 distance form; exact/fast need f32.
+    assert train_xT.dtype == jnp.float32 or (
+        train_xT.dtype == jnp.bfloat16 and precision == "bf16"
+    ), f"train dtype {train_xT.dtype} requires precision='bf16'"
     grid = (q_pad // block_q, n_pad // block_n)
 
     kernel = functools.partial(
@@ -493,18 +533,24 @@ def stripe_prepare_sharded(
     n_q: int,
     block_q: Optional[int] = None,
     block_n: Optional[int] = None,
+    precision: str = "exact",
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int, int]:
     """Host-side layout for the distributed stripe paths (train-sharded,
     query-sharded with ``n_t=1``, ring with ``n_t=n_q=P``): resolves
     shard-aware block sizes, pads train rows to ``n_t`` equal shards of a
     ``block_n`` multiple, transposes to the kernel's ``[D_pad, N_pad]``
     layout, pads labels alongside, and pads queries to ``n_q`` equal shards
-    of a ``block_q`` multiple with ``d_pad`` features. Returns ``(train_xT,
+    of a ``block_q`` multiple with ``d_pad`` features. ``precision`` feeds
+    the block resolver so the wide-feature matmul forms get the wide block
+    defaults on the distributed paths too. Returns ``(train_xT,
     train_y_padded, test_x_padded, block_q, block_n)``."""
     q, n = test_x.shape[0], train_x.shape[0]
     q_quota = -(-q // n_q)  # ceil queries per q-shard
     shard_quota = -(-n // n_t)  # ceil train rows per t-shard
-    block_q, block_n = stripe_block_sizes(block_q, block_n, q_quota, k)
+    block_q, block_n = stripe_block_sizes(
+        block_q, block_n, q_quota, k,
+        d_pad=((train_x.shape[1] + 7) // 8) * 8, precision=precision,
+    )
     block_n = min(block_n, -(-shard_quota // 128) * 128)
     shard_rows = -(-shard_quota // block_n) * block_n
     n_pad = shard_rows * n_t
@@ -585,16 +631,45 @@ def stripe_prepare_queries(
 
 
 def stripe_block_sizes(
-    block_q: Optional[int], block_n: Optional[int], q: int, k: int = 5
+    block_q: Optional[int],
+    block_n: Optional[int],
+    q: int,
+    k: int = 5,
+    d_pad: Optional[int] = None,
+    precision: str = "exact",
 ) -> Tuple[int, int]:
-    """Resolve stripe block sizes: defaults tuned on v5e (448, 2048), block_n
-    rounded to the 128-lane multiple the kernel requires, block_q clipped so
-    one tile covers small query sets and scaled down with ``k`` so the
-    candidate scratch (``2 x [block_q, 128k]``) stays within VMEM."""
-    block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
-    if block_q is None:
-        # scratch bytes ~= block_q * 128k * 8; keep under ~3.5 MB.
-        block_q = min(448, max(8, (3_500_000 // (128 * k * 8)) // 8 * 8))
+    """Resolve stripe block sizes: defaults tuned on v5e (448, 2048 for the
+    narrow-feature exact unroll), block_n rounded to the 128-lane multiple
+    the kernel requires, block_q clipped so one tile covers small query sets
+    and scaled down with ``k`` so the candidate scratch (``2 x [block_q,
+    128k]``) stays within VMEM.
+
+    The matmul forms (``fast``/``bf16``) get their own defaults: the step is
+    bound by the per-query-tile train re-stream, so block_q grows as large as
+    the [block_q, block_n] f32 distance buffer + candidate scratch allow —
+    (1024, 1024) measured best for the mnist784 shape (1.73 ms vs 2.89 for
+    the 512-row merge kernel, same session) — and shrinks with d_pad (query
+    block bytes) and k (scratch bytes)."""
+    if precision in ("fast", "bf16") and (d_pad or 0) > 128:
+        # Wide-feature matmul forms only: the step is bound by the
+        # per-query-tile train re-stream, so block_q grows as large as VMEM
+        # allows. Narrow-feature bf16/fast keeps the proven narrow defaults
+        # below (same selection cost, no re-stream problem — and the wide
+        # blocks blow scoped VMEM at high k, caught by the r3 parity sweep).
+        block_n = ((max(128, block_n or 1024) + 127) // 128) * 128
+        if block_q is None:
+            # Rough per-row VMEM: d_full (4*block_n) + scratch (8*128k) +
+            # query row (4*d_pad); budget what the measured-good mnist shape
+            # implies (~16 MB scoped, Mosaic reuses the d_full slices), with
+            # a haircut at high k where scratch liveness grows.
+            per_row = 4 * block_n + 8 * 128 * k + 4 * d_pad
+            budget = (13 if k <= 8 else 10) << 20
+            block_q = max(256, min(1024, budget // per_row // 256 * 256))
+    else:
+        block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
+        if block_q is None:
+            # scratch bytes ~= block_q * 128k * 8; keep under ~3.5 MB.
+            block_q = min(448, max(8, (3_500_000 // (128 * k * 8)) // 8 * 8))
     block_q = min(block_q, ((q + 7) // 8) * 8)
     return block_q, block_n
 
@@ -613,17 +688,25 @@ def memo_device(cache: Optional[dict], key: tuple, make):
 
 
 def _cached_stripe_train(
-    train_x: np.ndarray, block_n: int, cache: Optional[dict]
+    train_x: np.ndarray,
+    block_n: int,
+    cache: Optional[dict],
+    precision: str = "exact",
 ) -> Tuple[jnp.ndarray, int, bool]:
     """Device-resident transposed train layout, memoized in ``cache``
     (normally ``Dataset.device_cache``) so repeat predict/kneighbors calls
     skip the host pad+transpose+upload AND the finiteness scan. Returns
-    ``(train_xT device array, d_pad, train_finite)``."""
+    ``(train_xT device array, d_pad, train_finite)``. ``precision="bf16"``
+    stores the operand AS bf16 — the wide-feature step is bound by the
+    per-query-tile train re-stream, so half the bytes is the speedup — and
+    the key carries the dtype so f32 and bf16 layouts coexist."""
+    dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
     def make():
         txT, d_pad = stripe_prepare_train(train_x, block_n)
-        return jnp.asarray(txT), d_pad, stripe_inputs_finite(train_x)
+        return jnp.asarray(txT, dtype), d_pad, stripe_inputs_finite(train_x)
 
-    return memo_device(cache, ("stripe_train", block_n), make)
+    return memo_device(cache, ("stripe_train", block_n, np.dtype(dtype).name), make)
 
 
 def stripe_candidates_arrays(
@@ -646,8 +729,13 @@ def stripe_candidates_arrays(
     n, d_true = train_x.shape
     q = test_x.shape[0]
     precision = _resolve_stripe_precision(precision, d_true)
-    block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
-    txTj, d_pad, train_finite = _cached_stripe_train(train_x, block_n, cache)
+    block_q, block_n = stripe_block_sizes(
+        block_q, block_n, q, k, d_pad=((d_true + 7) // 8) * 8,
+        precision=precision,
+    )
+    txTj, d_pad, train_finite = _cached_stripe_train(
+        train_x, block_n, cache, precision
+    )
     qx = stripe_prepare_queries(test_x, block_q, d_pad)
     d, idx = knn_pallas_stripe_candidates(
         txTj, jnp.asarray(qx), n, k,
@@ -726,8 +814,13 @@ def stripe_classify_arrays(
     q = test_x.shape[0]
     if q == 0:
         return np.empty(0, np.int32)
-    block_q, block_n = stripe_block_sizes(block_q, block_n, q, k)
-    txTj, d_pad, train_finite = _cached_stripe_train(train_x, block_n, cache)
+    block_q, block_n = stripe_block_sizes(
+        block_q, block_n, q, k,
+        d_pad=((train_x.shape[1] + 7) // 8) * 8, precision=precision,
+    )
+    txTj, d_pad, train_finite = _cached_stripe_train(
+        train_x, block_n, cache, precision
+    )
     assume_finite = train_finite and stripe_inputs_finite(test_x)
     tyj = memo_device(
         cache, ("stripe_labels",), lambda: jnp.asarray(train_y)
@@ -777,10 +870,11 @@ def predict_pallas(
     gather labels, vote. Interpret mode defaults on for non-TPU backends so the
     same code path is testable on the CPU mesh (SURVEY.md §4).
 
-    ``engine``: "stripe" = the lane-striped kernel (fastest for narrow
-    features; supports every precision form), "merge" = the tile-merge
-    kernel (the wide-feature default), "auto" = stripe for narrow-feature
-    exact problems, merge otherwise."""
+    ``engine``: "stripe" = the lane-striped kernel (elementwise selection;
+    supports every precision form), "merge" = the tile-merge kernel,
+    "auto" = stripe for narrow-feature exact problems AND for bf16 problems
+    at any width (wide bf16 stores the train operand half-width — measured
+    1.7x the merge kernel on the mnist784 shape), merge otherwise."""
     from knn_tpu.ops.vote import vote
 
     if interpret is None:
@@ -788,20 +882,50 @@ def predict_pallas(
     n, q = train_x.shape[0], test_x.shape[0]
     d_true = train_x.shape[1]
     precision = _resolve_stripe_precision(precision, d_true)
-    if engine == "auto":
+    auto_routed = engine == "auto"
+    if auto_routed:
+        # Narrow-feature exact problems and wide-feature bf16 problems both
+        # route to the stripe kernel (elementwise selection; for bf16 the
+        # train operand is stored half-width, which measured 1.7x the merge
+        # kernel on the mnist784 shape). "fast" stays on the merge kernel —
+        # its full [BQ, BN] f32 distance buffer next to f32 train tiles does
+        # not fit VMEM at competitive blocks.
         engine = (
             "stripe"
-            if precision == "exact" and d_true <= STRIPE_MAX_D
-            and k <= STRIPE_MAX_K
+            if k <= STRIPE_MAX_K
+            and (precision == "bf16" or
+                 (precision == "exact" and d_true <= STRIPE_MAX_D))
             else "merge"
         )
-    if engine == "stripe":
-        _, idx = stripe_candidates_arrays(
-            train_x, test_x, k,
-            block_q=block_q, block_n=block_n, interpret=interpret,
-            precision=precision,
+    if engine not in ("stripe", "merge"):
+        raise ValueError(
+            f"unknown pallas engine {engine!r}; use 'auto', 'stripe', or 'merge'"
         )
-    elif engine == "merge":
+    if engine == "stripe":
+        try:
+            _, idx = stripe_candidates_arrays(
+                train_x, test_x, k,
+                block_q=block_q, block_n=block_n, interpret=interpret,
+                precision=precision,
+            )
+        except Exception as e:
+            # Auto-routed stripe dispatch can hit a Mosaic compile failure on
+            # unmeasured (d, k, block) corners (ADVICE r2): fall back to the
+            # merge kernel instead of turning an engine='auto' predict into a
+            # hard error — loudly, so the root cause isn't lost if the merge
+            # path then fails too. A *forced* stripe engine still propagates.
+            if not auto_routed:
+                raise
+            import warnings
+
+            warnings.warn(
+                "auto-routed stripe kernel dispatch failed "
+                f"({type(e).__name__}: {e}); falling back to the merge kernel",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            engine = "merge"
+    if engine == "merge":
         # bf16 halves the train block in VMEM, which is exactly what lets the
         # bigger query block (fewer train re-streams) fit: (512, 1024) is the
         # v5e sweet spot for the bf16 form, (256, 1024) for f32.
@@ -822,7 +946,5 @@ def predict_pallas(
             d_true=d_true, precision=precision,
         )
         idx = np.asarray(idx)[:q]
-    else:
-        raise ValueError(f"unknown pallas engine {engine!r}; use 'auto', 'stripe', or 'merge'")
     labels = train_y[np.minimum(idx, n - 1)]
     return np.asarray(vote(jnp.asarray(labels), num_classes))

@@ -175,6 +175,7 @@ def predict_query_sharded_global(
         tx, ty, qx, block_q, block_n, interpret, assume_finite = (
             stripe_query_sharded_prep(
                 train_x, train_y, test_x, k, n_dev, interpret,
+                precision=precision,
             )
         )
         mesh, fn = _cached_global_stripe_fn(

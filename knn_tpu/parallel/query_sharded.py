@@ -92,7 +92,7 @@ def stripe_per_shard_classify(
 
 def stripe_query_sharded_prep(
     train_x, train_y, test_x, k, n_dev, interpret,
-    block_q=None, block_n=None,
+    block_q=None, block_n=None, precision="exact",
 ):
     """Shared host-side prep for the stripe query-sharded paths: resolve
     interpret mode, lay out the replicated transposed train + ``n_dev``-way
@@ -105,7 +105,7 @@ def stripe_query_sharded_prep(
         interpret = jax.default_backend() != "tpu"
     txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
         train_x, train_y, test_x, k, 1, n_dev,
-        block_q=block_q, block_n=block_n,
+        block_q=block_q, block_n=block_n, precision=precision,
     )
     return (
         txT, ty, qx, block_q, block_n, interpret,
@@ -178,7 +178,7 @@ def _predict_query_sharded_stripe(
     txT, ty, qx, block_q, block_n, interpret, assume_finite = (
         stripe_query_sharded_prep(
             train_x, train_y, test_x, k, n_dev, interpret,
-            block_q=block_q, block_n=block_n,
+            block_q=block_q, block_n=block_n, precision=precision,
         )
     )
     if mesh is not None:

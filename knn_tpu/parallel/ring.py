@@ -217,7 +217,8 @@ def predict_ring(
         )
 
         txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
-            train_x, train_y, test_x, k, n_dev, n_dev
+            train_x, train_y, test_x, k, n_dev, n_dev,
+            precision=precision,
         )
         fn = _cached_fn(
             n_dev, k, num_classes, precision, "stripe", query_tile,

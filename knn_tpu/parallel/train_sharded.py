@@ -190,7 +190,7 @@ def _predict_train_sharded_stripe(
     q, n = test_x.shape[0], train_x.shape[0]
     txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
         train_x, train_y, test_x, k, n_t, n_q,
-        block_q=block_q, block_n=block_n,
+        block_q=block_q, block_n=block_n, precision=precision,
     )
     fn = _cached_stripe_fn(
         n_q, n_t, k, num_classes, precision, block_q, block_n,

@@ -1,0 +1,171 @@
+"""Round-3 probe: where does the mnist784 (wide-feature) step time go?
+
+Components measured on the live chip, all at n=65536, d=784 (pad 896), q=2048,
+k=5, one distinct query buffer per dispatch (dedupe-proof):
+
+  A. pure bf16 matmul pallas kernel, same grid/blocks as the merge kernel
+     -> MXU + pipeline floor per step
+  B. merge kernel bf16 (current shipping form)
+  C. stripe kernel precision=bf16 at the same blocks (elementwise selection)
+  D. merge kernel bf16 with a 1024-row query block (train re-streams halved)
+
+Diagnostics only — not part of bench.py.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+from bench import _pipelined_slope  # noqa: E402
+from knn_tpu.ops.pallas_knn import (  # noqa: E402
+    knn_pallas_candidates,
+    knn_pallas_stripe_candidates,
+    stripe_prepare_queries,
+    stripe_prepare_train,
+)
+from knn_tpu.utils.padding import pad_axis_to_multiple
+
+N, Q, D, K = 65536, 2048, 784, 5
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _matmul_kernel(q_ref, t_ref, out_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
+
+    cross = jax.lax.dot_general(
+        q_ref[:], t_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # cheap per-tile fold so the matmul can't be DCE'd and the output block
+    # stays [BQ, 8] (not the full [BQ, N] distance matrix)
+    out_ref[:] = out_ref[:] + jax.lax.reshape(
+        jnp.sum(cross.reshape(cross.shape[0], 8, -1), axis=2),
+        out_ref.shape,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_n"))
+def pure_matmul(tx, qx, block_q, block_n):
+    n_pad, d_feat = tx.shape
+    q_pad = qx.shape[0]
+    grid = (q_pad // block_q, n_pad // block_n)
+    return pl.pallas_call(
+        _matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, d_feat), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, d_feat), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, 8), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 8), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qx, tx)
+
+
+def make_bufs(bq, count, dtype=np.float32, d_to=896):
+    rng = np.random.default_rng(1)
+    test_x = rng.random((Q, D), np.float32)
+    out = []
+    for i in range(count):
+        qp, _ = pad_axis_to_multiple(test_x + np.float32(i) * 1e-6, bq, axis=0)
+        qp = np.pad(qp, ((0, 0), (0, d_to - D)))
+        out.append(jnp.asarray(qp, dtype))
+    jax.block_until_ready(out)
+    return out
+
+
+def run(name, mkstep, bufs, r_lo=10, r_hi=40):
+    t0 = time.monotonic()
+    np.asarray(jax.tree.leaves(mkstep(bufs[0]))[0])
+    log(f"{name}: compile {time.monotonic()-t0:.1f}s")
+    per_step, _ = _pipelined_slope(mkstep, bufs, r_lo, r_hi)
+    tf = 2 * Q * N * D / per_step / 1e12
+    log(f"{name}: {per_step*1e3:.3f} ms/step  ({Q/per_step:,.0f} q/s, {tf:.0f} TF eff)")
+    return per_step
+
+
+def main():
+    rng = np.random.default_rng(0)
+    train_x = rng.random((N, D), np.float32)
+    tx, _ = pad_axis_to_multiple(train_x, 1024, axis=0)
+    tx, _ = pad_axis_to_multiple(tx, 128, axis=1)
+    txb = jnp.asarray(tx, jnp.bfloat16)
+    txf = jnp.asarray(tx)
+
+    bufs512 = make_bufs(512, 40)
+    bufs512b = make_bufs(512, 40, jnp.bfloat16)
+    bufs1024 = make_bufs(1024, 40)
+
+    # A: pure matmul floor (bf16 operands)
+    run("A  pure matmul bf16 bq=512 bn=1024",
+        lambda qb: pure_matmul(txb, qb, 512, 1024), bufs512b)
+
+    # B: shipping merge kernel bf16
+    run("B  merge bf16 bq=512 bn=1024",
+        lambda qb: knn_pallas_candidates(
+            txb, qb, N, K, block_q=512, block_n=1024, d_true=D,
+            precision="bf16"), bufs512)
+
+    # B2: shipping merge kernel f32 (bq=256 shipping default)
+    bufs256 = make_bufs(256, 40)
+    run("B2 merge f32  bq=256 bn=1024",
+        lambda qb: knn_pallas_candidates(
+            txf, qb, N, K, block_q=256, block_n=1024, d_true=D,
+            precision="fast"), bufs256)
+
+    # C: stripe kernel with bf16 matmul distance (selection is elementwise)
+    rngq = np.random.default_rng(1)
+    test_x = rngq.random((Q, D), np.float32)
+
+    def stripe_case(name, bq, bn, store_bf16):
+        txT_h, d_pad = stripe_prepare_train(train_x, bn)
+        txTj = jnp.asarray(txT_h, jnp.bfloat16 if store_bf16 else None)
+        sbufs = []
+        for i in range(40):
+            sbufs.append(jnp.asarray(
+                stripe_prepare_queries(test_x + np.float32(i) * 1e-6, bq, d_pad)))
+        jax.block_until_ready(sbufs)
+        try:
+            run(name,
+                lambda qb: knn_pallas_stripe_candidates(
+                    txTj, qb, N, K, block_q=bq, block_n=bn, d_true=D,
+                    precision="bf16", assume_finite=True), sbufs)
+        except Exception as e:
+            log(f"{name} failed: {type(e).__name__}: {str(e)[:160]}")
+
+    stripe_case("C  stripe bf16 f32-store bq=512 bn=1024", 512, 1024, False)
+    stripe_case("C2 stripe bf16 bf16-store bq=512 bn=1024", 512, 1024, True)
+    stripe_case("C3 stripe bf16 bf16-store bq=512 bn=2048", 512, 2048, True)
+    stripe_case("C4 stripe bf16 bf16-store bq=1024 bn=1024", 1024, 1024, True)
+    stripe_case("C5 stripe bf16 bf16-store bq=2048 bn=1024", 2048, 1024, True)
+
+    # D: merge bf16, 1024-row query block (half the train re-streams)
+    try:
+        run("D  merge bf16 bq=1024 bn=1024",
+            lambda qb: knn_pallas_candidates(
+                txb, qb, N, K, block_q=1024, block_n=1024, d_true=D,
+                precision="bf16"), bufs1024)
+    except Exception as e:
+        log(f"D failed: {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

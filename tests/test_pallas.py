@@ -317,6 +317,50 @@ class TestPallasKernel:
         )
         np.testing.assert_array_equal(got, want)
 
+    def test_wide_features_bf16_stripe_store(self, rng):
+        # The wide-feature bf16 flagship (r3): engine auto routes
+        # precision="bf16" to the stripe kernel with the train operand
+        # STORED bf16. 0/1 grid => bf16 rounding is exact, so predictions
+        # must still match the oracle bit-for-bit.
+        train_x = rng.integers(0, 2, (300, 784)).astype(np.float32)
+        train_y = rng.integers(0, 10, 300).astype(np.int32)
+        test_x = np.concatenate(
+            [train_x[:10], rng.integers(0, 2, (6, 784)).astype(np.float32)]
+        )
+        want = knn_oracle(train_x, train_y, test_x, 5, 10)
+        got = predict_pallas(
+            train_x, train_y, test_x, 5, 10,
+            block_q=16, block_n=128, interpret=True, precision="bf16",
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_auto_engine_falls_back_to_merge_on_stripe_failure(
+        self, rng, monkeypatch
+    ):
+        # ADVICE r2: a Mosaic compile failure on an auto-routed stripe
+        # dispatch must fall back to the merge kernel, not error out; a
+        # FORCED stripe engine must still propagate the failure.
+        import knn_tpu.ops.pallas_knn as pk
+
+        train_x, train_y, test_x, c = _int_grid_problem(rng, n=260, q=20)
+        want = knn_oracle(train_x, train_y, test_x, 5, c)
+
+        def boom(*a, **kw):
+            raise RuntimeError("synthetic Mosaic compile failure")
+
+        monkeypatch.setattr(pk, "stripe_candidates_arrays", boom)
+        got = predict_pallas(
+            train_x, train_y, test_x, 5, c,
+            block_q=16, block_n=128, interpret=True, precision="exact",
+        )
+        np.testing.assert_array_equal(got, want)
+        with pytest.raises(RuntimeError, match="synthetic"):
+            predict_pallas(
+                train_x, train_y, test_x, 5, c,
+                block_q=16, block_n=128, interpret=True,
+                precision="exact", engine="stripe",
+            )
+
     def test_wide_features_mnist_shaped(self, rng):
         # BASELINE config-5 shape class: D=784 (pads to 896 lanes), parity on
         # an integer grid where the matmul expansion is exact.
