@@ -23,12 +23,11 @@ Dialect (SURVEY.md §3.4, libarff/arff_parser.cpp:23-153, arff_lexer.cpp:60-203)
   stores them as heap strings (arff_value.cpp:33-48) and only fails when KNN
   reads one as float (arff_value.cpp:121), so such files LOAD there; here the
   numeric-only requirement is deferred to ``Dataset.validate_for_knn``.
-- Deliberate deviation: the reference lexer lets a quoted value span physical
-  lines (``_read_str`` reads to the matching quote through newlines,
-  arff_lexer.cpp:159-188); both parsers here are line-based and raise
-  ``unterminated quoted value`` instead. (Exotic: the reference drops quoted
-  @data rows anyway, so this only matters for nominal declarations split
-  across lines.)
+- A quoted value may span physical lines, preserving the newline inside the
+  value (``_read_str`` reads to the matching quote through newlines,
+  arff_lexer.cpp:159-188), and an open ``{`` nominal list continues on the
+  following line(s) — newlines are ordinary inter-token whitespace to the
+  reference lexer. An unterminated quote at EOF is a located error.
 
 Errors carry ``file:line`` context like libarff's THROW (arff_utils.cpp:8-20);
 tokens carried across physical lines by multi-line rows are reported with the
@@ -100,13 +99,16 @@ def _strtof(tok: str) -> float:
     if _LIBC_STRTOF is not None:
         import ctypes
 
-        buf = ctypes.create_string_buffer(tok.encode("utf-8"))
+        raw = tok.encode("utf-8")
+        buf = ctypes.create_string_buffer(raw)
         endp = ctypes.c_char_p()
         val = _LIBC_STRTOF(buf, ctypes.byref(endp))
         consumed = ctypes.cast(endp, ctypes.c_void_p).value - ctypes.addressof(buf)
-        # Mirror the native `endp == start || *endp != '\0'` rejection,
-        # including its quirk of stopping at an embedded NUL.
-        if consumed == 0 or buf.raw[consumed] != 0:
+        # Mirror the native parser's full-consumption rule on the token's
+        # EXPLICIT length: a token with an embedded NUL is rejected (strtof
+        # stops at the NUL, so it can never consume the whole view) —
+        # ADVICE r2: the two parsers previously disagreed here.
+        if consumed != len(raw) or consumed == 0:
             raise ValueError(tok)
         return val
     m = _STRTOF_RE.match(tok)
@@ -140,16 +142,23 @@ def _split_csv(line: str, path: str, lineno: int) -> list:
     reject — the reference silently truncates the dataset there
     (arff_lexer.cpp:125-127), a defect replaced with a located error. A
     comma directly after its token is that token's terminator, so a single
-    trailing comma is absorbed (``1,2,`` tokenizes like ``1,2``)."""
+    trailing comma is absorbed (``1,2,`` tokenizes like ``1,2``).
+
+    Returns ``(token, lineno)`` pairs: ``line`` may be a quote-joined
+    logical line whose '\\n's advance the physical line count, and each
+    token cites the line it STARTED on — same attribution as the native
+    scanner's per-token line."""
     out: list = []
     buf: list = []
     active = False            # a token is in progress
     token_since_comma = False  # a completed token awaits its comma
     quote = None
+    cur_line = lineno
+    tok_line = lineno
 
     def flush():
         nonlocal buf, active, token_since_comma
-        out.append("".join(buf))
+        out.append(("".join(buf), tok_line))
         buf = []
         active = False
         token_since_comma = True
@@ -159,10 +168,21 @@ def _split_csv(line: str, path: str, lineno: int) -> list:
             if ch == quote:
                 quote = None
             else:
+                if ch == "\n":
+                    cur_line += 1
                 buf.append(ch)
+            continue
+        if ch == "\n":
+            cur_line += 1
+            # A newline outside quotes acts as inter-token whitespace
+            # (only quote-joined logical lines contain one).
+            if active:
+                flush()
             continue
         if ch in ("'", '"'):
             quote = ch
+            if not active:
+                tok_line = cur_line
             active = True
             continue
         if ch in " \t":
@@ -176,12 +196,14 @@ def _split_csv(line: str, path: str, lineno: int) -> list:
             elif token_since_comma:
                 token_since_comma = False  # separator for the flushed token
             else:
-                out.append("")  # ",," or leading comma: empty cell
+                out.append(("", cur_line))  # ",," or leading comma: empty cell
             continue
+        if not active:
+            tok_line = cur_line
         active = True
         buf.append(ch)
     if quote is not None:
-        raise ArffError(path, lineno, "unterminated quoted value")
+        raise ArffError(path, tok_line, "unterminated quoted value")
     if active:
         flush()
     return out
@@ -216,7 +238,10 @@ def _parse_attribute(rest: str, path: str, lineno: int) -> Attribute:
         # value ({a,''}) still hits the empty-value error below. "{}" is an
         # empty nominal set (reference: BRKT_CLOSE immediately ends the
         # value loop).
-        values = [] if inner.strip(_WS) == "" else _split_csv(inner, path, lineno)
+        values = (
+            [] if inner.strip(_WS) == ""
+            else [tok for tok, _ in _split_csv(inner, path, lineno)]
+        )
         if any(v == "" for v in values):
             raise ArffError(path, lineno, "empty value in nominal list")
         return Attribute(name, "nominal", values)
@@ -254,6 +279,40 @@ def _cell_to_float(
         ) from None
 
 
+def _scan_quote(s: str, quote: Optional[str] = None) -> Optional[str]:
+    """Fold quote state over ``s``: returns the open quote char if the text
+    ends inside a quoted value, else None. The carry for multi-line quoted
+    values (arff_lexer.cpp:159-188 reads through newlines to the matching
+    quote)."""
+    for ch in s:
+        if quote is not None:
+            if ch == quote:
+                quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+    return quote
+
+
+def _open_nominal(rest: str) -> bool:
+    """True when ``rest`` opens a ``{`` nominal list (outside quotes) that
+    no later unquoted ``}`` closes — the declaration continues on the next
+    physical line, as in the reference's token-stream reader (newlines are
+    ordinary whitespace between tokens, arff_lexer.cpp:93-97)."""
+    quote = None
+    opened = False
+    for ch in rest:
+        if quote is not None:
+            if ch == quote:
+                quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+        elif ch == "{":
+            opened = True
+        elif ch == "}" and opened:
+            return False
+    return opened
+
+
 def parse_arff_lines(
     lines: Iterable[str], path: str = "<memory>"
 ) -> Dataset:
@@ -266,14 +325,39 @@ def parse_arff_lines(
     # carrying the lineno keeps error locations on the token's own line.
     pending: list = []
 
-    for lineno, raw in enumerate(lines, start=1):
+    it = iter(lines)
+    lineno = 0
+    while True:
+        raw = next(it, None)
+        if raw is None:
+            break
+        lineno += 1
         # '%' starts a comment only at the true line start (the reference
         # lexer skips comments only when '%' is the first character after a
         # newline, arff_lexer.cpp:60-78); an indented or trailing '%' is
         # DATA and typically a located type error downstream.
         if raw.startswith("%"):
             continue
-        line = raw.strip(_WS)
+        # A quoted value may span physical lines (arff_lexer.cpp:159-188
+        # reads to the matching quote through newlines): join lines into one
+        # logical line while a quote is open, preserving the line break
+        # inside the value VERBATIM — a '\r' before the newline stays, as in
+        # the native parser's zero-copy slice and the reference's raw-byte
+        # scanner (the file reader splits at '\n' only). Comment skipping
+        # never applies inside a quote (the reference skips '%' lines only
+        # BETWEEN tokens). The quote state folds incrementally over each
+        # appended segment, so the join is linear in the value's length.
+        logical = raw
+        start_line = lineno
+        open_q = _scan_quote(raw)
+        while open_q is not None:
+            nxt = next(it, None)
+            if nxt is None:
+                raise ArffError(path, start_line, "unterminated quoted value")
+            lineno += 1
+            logical += "\n" + nxt
+            open_q = _scan_quote("\n" + nxt, open_q)
+        line = logical.strip(_WS)
         if not line:
             continue
         if not in_data and line.startswith("@"):
@@ -295,28 +379,58 @@ def parse_arff_lines(
                 ):
                     relation = relation[1:-1]
             elif key == "@attribute":
-                attributes.append(_parse_attribute(rest, path, lineno))
+                # An open nominal list continues on the next physical
+                # line(s): the reference reads the {...} value tokens from
+                # the lexer stream, where a newline is ordinary whitespace
+                # (arff_parser.cpp:69-119). '%' comment lines between the
+                # value tokens are skipped as usual; a quoted value inside
+                # the continued list may itself span further lines.
+                while _open_nominal(rest):
+                    nxt = next(it, None)
+                    if nxt is None:
+                        break  # _parse_attribute raises its located error
+                    lineno += 1
+                    if nxt.startswith("%"):
+                        continue
+                    seg = nxt
+                    seg_q = _scan_quote(seg)
+                    while seg_q is not None:
+                        nx2 = next(it, None)
+                        if nx2 is None:
+                            raise ArffError(
+                                path, lineno, "unterminated quoted value"
+                            )
+                        lineno += 1
+                        seg += "\n" + nx2
+                        seg_q = _scan_quote("\n" + nx2, seg_q)
+                    rest = rest + " " + seg.strip(_WS)
+                attributes.append(_parse_attribute(rest, path, start_line))
                 interns.append({})
             elif key == "@data":
                 if not attributes:
-                    raise ArffError(path, lineno, "@data before any @attribute")
+                    raise ArffError(path, start_line, "@data before any @attribute")
                 in_data = True
             else:
-                raise ArffError(path, lineno, f"unknown keyword '{word}'")
+                raise ArffError(path, start_line, f"unknown keyword '{word}'")
             continue
         if not in_data:
-            raise ArffError(path, lineno, f"unexpected content before @data: '{line}'")
+            raise ArffError(
+                path, start_line, f"unexpected content before @data: '{line}'"
+            )
         if line.startswith("{"):
-            raise ArffError(path, lineno, "sparse ARFF rows are not supported")
-        cells = _split_csv(line, path, lineno)
-        if "" in cells:
-            raise ArffError(path, lineno, "empty value in data row")
+            raise ArffError(path, start_line, "sparse ARFF rows are not supported")
+        cells = _split_csv(line, path, start_line)
+        for tok, tok_line in cells:
+            if tok == "":
+                raise ArffError(path, tok_line, "empty value in data row")
         # The reference's reader consumes exactly num_attributes tokens per
         # instance from the @data token stream regardless of line breaks
         # (arff_parser.cpp:121-153): rows may span physical lines AND several
         # rows may share one line, so accumulate tokens and emit every full
-        # group of num_attributes.
-        pending.extend((tok, lineno) for tok in cells)
+        # group of num_attributes. Each token carries the physical line it
+        # started on (quote-joined logical lines span several), matching the
+        # native scanner's attribution.
+        pending.extend(cells)
         d = len(attributes)
         off = 0
         while len(pending) - off >= d:

@@ -15,11 +15,12 @@
 // num_attributes values per instance, arff_parser.cpp:121-153), a partial row
 // at EOF is discarded, sparse rows are rejected. STRING/DATE data cells
 // intern to first-seen float32 codes (tables exported per attribute).
-// Deliberate deviation (shared with the Python twin, see pyarff docstring):
-// a quoted value may NOT span physical lines here, where the reference's
-// _read_str reads through newlines (arff_lexer.cpp:159-188). Errors carry
-// file:line context like libarff's THROW (arff_utils.cpp:8-20), citing the
-// token's own line for multi-line rows.
+// A quoted value may span physical lines, preserving the newline(s) inside
+// the value (the reference's _read_str reads through newlines,
+// arff_lexer.cpp:159-188), and an open '{' nominal list continues on the
+// following line(s) — newlines are ordinary inter-token whitespace to the
+// reference lexer. Errors carry file:line context like libarff's THROW
+// (arff_utils.cpp:8-20), citing the token's own line for multi-line rows.
 //
 // C ABI only — bound from Python via ctypes (no pybind11 in this image).
 
@@ -76,6 +77,41 @@ std::string strip(const std::string& s) {
   if (b == std::string::npos) return "";
   size_t e = s.find_last_not_of(" \t\r\n");
   return s.substr(b, e - b + 1);
+}
+
+// Fold quote state over `s`: returns the open quote char if the text ends
+// inside a quoted value, else 0. The carry for multi-line quoted values
+// (arff_lexer.cpp:159-188 reads through newlines to the matching quote).
+char scan_quote(const std::string& s, char quote = 0) {
+  for (char ch : s) {
+    if (quote) {
+      if (ch == quote) quote = 0;
+    } else if (ch == '\'' || ch == '"') {
+      quote = ch;
+    }
+  }
+  return quote;
+}
+
+// True when `rest` opens a '{' nominal list (outside quotes) that no later
+// unquoted '}' closes — the declaration continues on the next physical
+// line, as in the reference's token-stream reader (newlines are ordinary
+// whitespace between tokens, arff_lexer.cpp:93-97).
+bool open_nominal(const std::string& rest) {
+  char quote = 0;
+  bool opened = false;
+  for (char ch : rest) {
+    if (quote) {
+      if (ch == quote) quote = 0;
+    } else if (ch == '\'' || ch == '"') {
+      quote = ch;
+    } else if (ch == '{') {
+      opened = true;
+    } else if (ch == '}' && opened) {
+      return false;
+    }
+  }
+  return opened;
 }
 
 // Tokenize a data/nominal segment the way the reference lexer does:
@@ -270,7 +306,7 @@ bool cell_view_to_float(const char* p, size_t len, Attr& attr, float* out,
 // comma-state resets per line), ",," or a leading comma is an empty cell,
 // '%' comments only at the true line start, a first non-ws '{' is a sparse
 // row, '\r' is a token character unless it belongs to line-trailing
-// whitespace, quotes may not span lines.
+// whitespace, a quoted value reads through newlines to its closing quote.
 bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
   const char* s = data.data();
   const size_t N = data.size();
@@ -355,15 +391,25 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
       // Token scan: c starts a token (possibly '\r', possibly a quote).
       uint32_t t_off = (uint32_t)pos, t_len = 0;
       int32_t t_owned = -1;
+      int32_t t_line = st.line;  // cite the token's opening line
       while (pos < N && s[pos] != '\n') {
         char ch = s[pos];
         if (ch == '\'' || ch == '"') {
+          // The close search runs THROUGH newlines (arff_lexer.cpp:159-188:
+          // a quoted value may span physical lines; the content, newlines
+          // included, stays one contiguous zero-copy slice).
           size_t close = pos + 1;
-          while (close < N && s[close] != ch && s[close] != '\n') close++;
-          if (close >= N || s[close] == '\n') {
+          int nl_in_quote = 0;
+          while (close < N && s[close] != ch) {
+            if (s[close] == '\n') nl_in_quote++;
+            close++;
+          }
+          if (close >= N) {
+            st.line = t_line;
             fail(st, "unterminated quoted value");
             return false;
           }
+          st.line += nl_in_quote;
           if (t_len == 0 && t_owned < 0) {
             // Token starts with a quote: stay a zero-copy view. If more
             // token characters follow, the discontiguity check in the
@@ -412,7 +458,7 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
         fail(st, "empty value in data row");
         return false;
       }
-      row.push_back({t_off, t_len, st.line, t_owned});
+      row.push_back({t_off, t_len, t_line, t_owned});
       if (pos < N && s[pos] == ',') {
         pos++;
         token_since_comma = false;  // the comma terminated its own token
@@ -429,16 +475,41 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
 
 bool parse_buffer(const std::string& data, ParseState& st) {
   size_t pos = 0;
-  while (pos <= data.size()) {
+  // Pull the next physical line into *out; false at EOF. No comment
+  // skipping — callers decide (none applies inside an open quote).
+  auto next_line = [&](std::string* out) -> bool {
+    if (pos > data.size()) return false;
     size_t nl = data.find('\n', pos);
-    std::string raw = nl == std::string::npos ? data.substr(pos)
-                                              : data.substr(pos, nl - pos);
+    *out = nl == std::string::npos ? data.substr(pos)
+                                   : data.substr(pos, nl - pos);
     pos = nl == std::string::npos ? data.size() + 1 : nl + 1;
     st.line++;
+    return true;
+  };
+  std::string raw;
+  while (next_line(&raw)) {
     // '%' comments only at the true line start (arff_lexer.cpp:60-78);
     // indented/trailing '%' is data and errors downstream on typed attrs.
     if (!raw.empty() && raw[0] == '%') continue;
-    std::string line = strip(raw);
+    // A quoted value may span physical lines (arff_lexer.cpp:159-188 reads
+    // to the matching quote through newlines): join lines into one logical
+    // line while a quote is open, preserving the newline inside the value.
+    int start_line = st.line;
+    std::string logical = raw;
+    // Quote state folds incrementally over each appended segment, so the
+    // join stays linear in the value's length.
+    char open_q = scan_quote(logical);
+    while (open_q) {
+      std::string nxt;
+      if (!next_line(&nxt)) {
+        st.line = start_line;
+        fail(st, "unterminated quoted value");
+        return false;
+      }
+      logical += "\n" + nxt;
+      open_q = scan_quote("\n" + nxt, open_q);
+    }
+    std::string line = strip(logical);
     if (line.empty()) continue;
     if (line[0] == '@') {
       size_t sp = line.find_first_of(" \t");
@@ -451,7 +522,31 @@ bool parse_buffer(const std::string& data, ParseState& st) {
             st.relation.back() == st.relation.front())
           st.relation = st.relation.substr(1, st.relation.size() - 2);
       } else if (ieq(word, "@attribute")) {
+        // An open nominal list continues on the next physical line(s): the
+        // reference reads the {...} value tokens from the lexer stream,
+        // where a newline is ordinary whitespace (arff_parser.cpp:69-119).
+        // '%' comment lines between the value tokens are skipped as usual;
+        // a quoted value inside the continued list may span further lines.
+        while (open_nominal(rest)) {
+          std::string seg;
+          if (!next_line(&seg)) break;  // parse_attribute fails located
+          if (!seg.empty() && seg[0] == '%') continue;
+          char seg_q = scan_quote(seg);
+          while (seg_q) {
+            std::string more;
+            if (!next_line(&more)) {
+              fail(st, "unterminated quoted value");
+              return false;
+            }
+            seg += "\n" + more;
+            seg_q = scan_quote("\n" + more, seg_q);
+          }
+          rest += " " + strip(seg);
+        }
+        int end_line = st.line;
+        st.line = start_line;  // cite the declaration's own line
         if (!parse_attribute(rest, st)) return false;
+        st.line = end_line;
       } else if (ieq(word, "@data")) {
         if (st.attrs.empty()) {
           fail(st, "@data before any @attribute");
@@ -461,11 +556,13 @@ bool parse_buffer(const std::string& data, ParseState& st) {
         // to the streaming zero-copy data scanner.
         return parse_data_stream(data, pos, st);
       } else {
+        st.line = start_line;
         fail(st, "unknown keyword '" + word + "'");
         return false;
       }
       continue;
     }
+    st.line = start_line;
     fail(st, "unexpected content before @data: '" + line + "'");
     return false;
   }

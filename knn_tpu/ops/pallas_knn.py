@@ -56,24 +56,34 @@ STRIPE_MAX_D = 128
 STRIPE_MAX_K = 16
 
 
-def _tree_min(planes):
-    """Min fold over planes. Short lists use the plain sequential fold; long
-    lists (the xl config's 96+k planes) switch to groups of 8 reduced
-    pairwise (log-depth) and chained — the sequential chain, not VPU
-    throughput, bounds those selection rounds. Grouping caps how many
-    intermediates are live at once: a full pairwise tree keeps ~p/2 planes
-    alive and that extra Mosaic stack blew the 16 MB scoped-VMEM limit by
-    256 KB at the headline (448, 2048, k=5) shape, where plane counts are
-    small and the chain is fine anyway."""
-    planes = list(planes)
-    if len(planes) < 48:
-        acc = planes[0]
-        for p in planes[1:]:
+def _tree_min(planes, n_planes: int):
+    """Min fold over ``planes`` (an iterable consumed lazily; ``n_planes``
+    is its length). Short lists use the plain sequential fold, consuming
+    each plane into the accumulator as it is produced — materializing them
+    first (a ``list()``) keeps every leaf live at once and blew the 16 MB
+    scoped-VMEM limit on a narrow full-retirement sweep shape. Long lists
+    (the xl config's 96+k planes) switch to groups of 8 reduced pairwise
+    (log-depth) and chained — there the sequential dependence chain, not
+    VPU throughput, bounds the selection rounds, and the per-group liveness
+    stays capped at 4 planes."""
+    it = iter(planes)
+    if n_planes < 48:
+        acc = next(it)
+        for p in it:
             acc = jnp.minimum(acc, p)
         return acc
     acc = None
-    for i in range(0, len(planes), 8):
-        grp = planes[i : i + 8]
+    done = False
+    while not done:
+        grp = []
+        for _ in range(8):
+            p = next(it, None)
+            if p is None:
+                done = True
+                break
+            grp.append(p)
+        if not grp:
+            break
         while len(grp) > 1:
             nxt = [
                 jnp.minimum(grp[j], grp[j + 1])
@@ -289,7 +299,10 @@ def _knn_stripe_kernel(
         # bf16-rounded values the matmul consumes, so the distance is exact
         # for the rounded operands.
         t = tT_ref[:]  # [D_pad, BN], f32 or bf16
-        t32 = t.astype(jnp.float32)
+        # The f32->f32 identity cast is NOT elided by Mosaic — it
+        # materializes a tile-sized copy that blew scoped VMEM on a narrow
+        # k=9 sweep shape — so cast only when the operand really is bf16.
+        t32 = t if t.dtype == jnp.float32 else t.astype(jnp.float32)
         q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
         t2 = jnp.sum(t32 * t32, axis=0).reshape(1, block_n)  # [1, BN]
         qc, tc = (q.astype(jnp.bfloat16),
@@ -338,10 +351,12 @@ def _knn_stripe_kernel(
     # (first-seen-wins, main.cpp:47). Retirement keys on index alone — global
     # indices are unique, and the INT_MAX padding dupes all carry +inf.
     for level in range(k):
-        m_d = _tree_min(d_planes)
+        n_planes = len(d_planes)
+        m_d = _tree_min(d_planes, n_planes)
         m_i = _tree_min(
-            jnp.where(d_planes[p] == m_d, i_planes[p], _INT_MAX)
-            for p in range(len(d_planes))
+            (jnp.where(d_planes[p] == m_d, i_planes[p], _INT_MAX)
+             for p in range(n_planes)),
+            n_planes,
         )
         cand_d_ref[:, level * lanes : (level + 1) * lanes] = m_d
         cand_i_ref[:, level * lanes : (level + 1) * lanes] = m_i
@@ -505,23 +520,27 @@ def stripe_inputs_finite(*arrays: np.ndarray) -> bool:
     return True
 
 
+def stripe_route_ok(precision: str, d: int, k: int) -> bool:
+    """Platform-independent half of THE auto-engine rule: which problems
+    belong on the lane-striped kernel. Exact euclidean with narrow features
+    (d <= 128 measured on v5e: the stripe exact unroll beats the XLA
+    full-matrix path 1.3x at d=64/100 and 2.25x at d=128; d=256 fails to
+    compile at the default blocks) — and the bf16 matmul form at ANY width
+    (r3: with the train operand stored bf16 it measured 1.7x the merge
+    kernel on the mnist784 shape)."""
+    return (
+        (precision == "bf16" or (precision == "exact" and d <= STRIPE_MAX_D))
+        and k <= STRIPE_MAX_K
+    )
+
+
 def stripe_auto_eligible(precision: str, d: int, k: int) -> bool:
     """THE auto-engine rule, shared by every dispatch point (single-device
     backend, kneighbors, the three distributed paths): route to the
-    lane-striped kernel when the problem is exact euclidean with narrow
-    features and small k AND a real TPU is attached (interpret mode is
-    correct but slow, so CPU meshes default to the XLA formulations).
-
-    d <= 128 is measured, not guessed (v5e, 30,803 x 1,718 at k=5): the
-    stripe exact unroll beats the XLA full-matrix path 1.3x at d=64/100 and
-    2.25x at d=128 (4.46/5.72/6.76 ms vs 5.89/7.41/15.23); d=256 fails to
-    compile at the default blocks, so the boundary stays at 128."""
-    return (
-        precision == "exact"
-        and d <= STRIPE_MAX_D
-        and k <= STRIPE_MAX_K
-        and jax.default_backend() == "tpu"
-    )
+    lane-striped kernel when :func:`stripe_route_ok` holds AND a real TPU is
+    attached (interpret mode is correct but slow, so CPU meshes default to
+    the XLA formulations)."""
+    return stripe_route_ok(precision, d, k) and jax.default_backend() == "tpu"
 
 
 def stripe_prepare_sharded(
@@ -563,6 +582,11 @@ def stripe_prepare_sharded(
         np.pad(test_x.astype(np.float32), ((0, n_q * q_shard - q), (0, 0))),
         block_q, d_pad,
     )
+    if precision == "bf16" and train_x.shape[1] > 128:
+        # Same store rule as the single-device cache (_cached_stripe_train):
+        # wide bf16 ships the transposed train operand half-width, which is
+        # both the HBM re-stream win and what the wide block budget assumes.
+        txT = txT.astype(jnp.bfloat16)
     return txT, ty, qx, block_q, block_n
 
 
@@ -659,11 +683,16 @@ def stripe_block_sizes(
         block_n = ((max(128, block_n or 1024) + 127) // 128) * 128
         if block_q is None:
             # Rough per-row VMEM: d_full (4*block_n) + scratch (8*128k) +
-            # query row (4*d_pad); budget what the measured-good mnist shape
-            # implies (~16 MB scoped, Mosaic reuses the d_full slices), with
+            # query row (4*d_pad); the fixed cost is the double-buffered
+            # train tile at its STORE width (bf16 stores half — "fast" keeps
+            # f32 tiles and gets a smaller query block). Budget anchored on
+            # the measured-good mnist shape (bf16, k=5, d_pad=896 ->
+            # (1024, 1024) compiles; Mosaic reuses the d_full slices), with
             # a haircut at high k where scratch liveness grows.
+            store_bytes = 2 if precision == "bf16" else 4
+            tiles = 2 * block_n * d_pad * store_bytes
             per_row = 4 * block_n + 8 * 128 * k + 4 * d_pad
-            budget = (13 if k <= 8 else 10) << 20
+            budget = ((17 if k <= 8 else 14) << 20) - tiles
             block_q = max(256, min(1024, budget // per_row // 256 * 256))
     else:
         block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
@@ -697,10 +726,17 @@ def _cached_stripe_train(
     (normally ``Dataset.device_cache``) so repeat predict/kneighbors calls
     skip the host pad+transpose+upload AND the finiteness scan. Returns
     ``(train_xT device array, d_pad, train_finite)``. ``precision="bf16"``
-    stores the operand AS bf16 — the wide-feature step is bound by the
+    on WIDE features stores the operand AS bf16 — that step is bound by the
     per-query-tile train re-stream, so half the bytes is the speedup — and
-    the key carries the dtype so f32 and bf16 layouts coexist."""
-    dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+    the key carries the dtype so f32 and bf16 layouts coexist. Narrow
+    features keep f32 storage: no re-stream problem to fix, and the
+    in-kernel f32 norm materialization a bf16 operand forces tipped a
+    narrow k=9 shape over the scoped-VMEM limit (r3 parity sweep)."""
+    dtype = (
+        jnp.bfloat16
+        if precision == "bf16" and train_x.shape[1] > 128
+        else jnp.float32
+    )
 
     def make():
         txT, d_pad = stripe_prepare_train(train_x, block_n)
@@ -884,19 +920,12 @@ def predict_pallas(
     precision = _resolve_stripe_precision(precision, d_true)
     auto_routed = engine == "auto"
     if auto_routed:
-        # Narrow-feature exact problems and wide-feature bf16 problems both
-        # route to the stripe kernel (elementwise selection; for bf16 the
-        # train operand is stored half-width, which measured 1.7x the merge
-        # kernel on the mnist784 shape). "fast" stays on the merge kernel —
-        # its full [BQ, BN] f32 distance buffer next to f32 train tiles does
-        # not fit VMEM at competitive blocks.
-        engine = (
-            "stripe"
-            if k <= STRIPE_MAX_K
-            and (precision == "bf16" or
-                 (precision == "exact" and d_true <= STRIPE_MAX_D))
-            else "merge"
-        )
+        # The shared routing rule (stripe_route_ok, platform check elided —
+        # interpret mode runs the same kernel on CPU): narrow-feature exact
+        # and any-width bf16 go to the stripe kernel. "fast" stays on the
+        # merge kernel — its full [BQ, BN] f32 distance buffer next to f32
+        # train tiles does not fit VMEM at competitive blocks.
+        engine = "stripe" if stripe_route_ok(precision, d_true, k) else "merge"
     if engine not in ("stripe", "merge"):
         raise ValueError(
             f"unknown pallas engine {engine!r}; use 'auto', 'stripe', or 'merge'"

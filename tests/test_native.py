@@ -61,6 +61,101 @@ class TestNativeParser:
         assert nat.attributes[1].nominal_values == ["red", "dark blue"]
         assert nat.num_instances == 3
 
+    def test_multiline_quoted_values_both_parsers(self, native_arff, tmp_path):
+        # arff_lexer.cpp:159-188: a quoted value spans physical lines, the
+        # newline is part of the value; an open '{' nominal list continues on
+        # the next line (newlines are inter-token whitespace to the lexer).
+        p = tmp_path / "ml.arff"
+        p.write_text(
+            "@relation 'two\nline rel'\n"
+            "@attribute c {'re\nd', green,\n  blue}\n"
+            "@attribute s string\n"
+            "@attribute class NUMERIC\n"
+            "@data\n"
+            "'re\nd', 'one\ntwo', 0\n"
+            "green, plain, 1\n"
+            "blue, 'one\ntwo', 2\n"
+        )
+        nat = native_arff.parse(str(p))
+        py = pyarff.parse_arff_file(str(p))
+        assert nat.relation == py.relation == "two\nline rel"
+        assert (
+            nat.attributes[0].nominal_values
+            == py.attributes[0].nominal_values
+            == ["re\nd", "green", "blue"]
+        )
+        np.testing.assert_array_equal(
+            nat.features, np.array([[0, 0], [1, 1], [2, 0]], np.float32)
+        )
+        np.testing.assert_array_equal(nat.features, py.features)
+        np.testing.assert_array_equal(nat.labels, py.labels)
+        assert (
+            nat.attributes[1].string_values
+            == py.attributes[1].string_values
+            == ["one\ntwo", "plain"]
+        )
+
+    def test_multiline_quote_crlf_parity(self, native_arff, tmp_path):
+        # CRLF file with a quoted value spanning lines: the reference scanner
+        # reads raw bytes, so the '\r' before the line break is part of the
+        # value — both parsers must preserve it identically (r3 review: the
+        # Python join once stripped it while the native zero-copy slice kept
+        # it).
+        p = tmp_path / "crlfq.arff"
+        p.write_bytes(
+            b"@relation t\r\n@attribute s string\r\n"
+            b"@attribute class NUMERIC\r\n@data\r\n"
+            b"'a\r\nb',0\r\nplain,1\r\n"
+        )
+        nat = native_arff.parse(str(p))
+        py = pyarff.parse_arff_file(str(p))
+        assert (
+            nat.attributes[0].string_values
+            == py.attributes[0].string_values
+            == ["a\r\nb", "plain"]
+        )
+        np.testing.assert_array_equal(nat.features, py.features)
+
+    def test_multiline_row_error_cites_token_line(self, native_arff, tmp_path):
+        # A bad numeric token AFTER a multi-line quoted cell must cite its
+        # own physical line in both parsers (native: per-token t_line;
+        # pyarff: per-token attribution through the quote-joined line).
+        p = tmp_path / "loc.arff"
+        p.write_text(
+            "@relation t\n@attribute s string\n@attribute x NUMERIC\n"
+            "@attribute class NUMERIC\n@data\n"
+            "'a\nb', zz, 0\n"
+        )
+        with pytest.raises(ValueError, match=r"loc\.arff:7"):
+            native_arff.parse(str(p))
+        with pytest.raises(ValueError, match=r"loc\.arff:7"):
+            pyarff.parse_arff_file(str(p))
+
+    def test_embedded_nul_rejected_both_parsers(self, native_arff, tmp_path):
+        # ADVICE r2: the parsers disagreed on a numeric cell with an embedded
+        # NUL (native rejected via full-view consumption, pyarff accepted via
+        # strtof's stop-at-NUL). Both now enforce the explicit token length.
+        p = tmp_path / "nul.arff"
+        p.write_bytes(
+            b"@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            b"@data\n1\x00x,0\n"
+        )
+        with pytest.raises(ValueError, match="cannot parse"):
+            native_arff.parse(str(p))
+        with pytest.raises(ValueError, match="cannot parse"):
+            pyarff.parse_arff_file(str(p))
+
+    def test_unterminated_quote_at_eof_both_parsers(self, native_arff, tmp_path):
+        p = tmp_path / "uq.arff"
+        p.write_text(
+            "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,0\n'never closed\n2,1\n"
+        )
+        with pytest.raises(ValueError, match="unterminated"):
+            native_arff.parse(str(p))
+        with pytest.raises(ValueError, match="unterminated"):
+            pyarff.parse_arff_file(str(p))
+
     def test_error_has_location(self, native_arff, tmp_path):
         p = tmp_path / "bad.arff"
         p.write_text("@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\nzz,0\n")
