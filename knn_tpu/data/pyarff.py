@@ -293,14 +293,18 @@ def _scan_quote(s: str, quote: Optional[str] = None) -> Optional[str]:
     return quote
 
 
-def _open_nominal(rest: str) -> bool:
-    """True when ``rest`` opens a ``{`` nominal list (outside quotes) that
-    no later unquoted ``}`` closes — the declaration continues on the next
-    physical line, as in the reference's token-stream reader (newlines are
-    ordinary whitespace between tokens, arff_lexer.cpp:93-97)."""
-    quote = None
-    opened = False
-    for ch in rest:
+def _fold_nominal(state: tuple, seg: str) -> tuple:
+    """Fold nominal-list bracket/quote state over ``seg`` incrementally —
+    ``state`` is ``(quote, opened, closed)``. The declaration continues on
+    the next physical line while a ``{`` has opened (outside quotes) and no
+    unquoted ``}`` has closed it, as in the reference's token-stream reader
+    (newlines are ordinary whitespace between tokens, arff_lexer.cpp:93-97).
+    Folding per appended segment keeps multi-line declarations linear in
+    their total length (rescanning the accumulation is quadratic)."""
+    quote, opened, closed = state
+    if closed:
+        return state
+    for ch in seg:
         if quote is not None:
             if ch == quote:
                 quote = None
@@ -309,8 +313,8 @@ def _open_nominal(rest: str) -> bool:
         elif ch == "{":
             opened = True
         elif ch == "}" and opened:
-            return False
-    return opened
+            return (quote, opened, True)
+    return (quote, opened, closed)
 
 
 def parse_arff_lines(
@@ -385,7 +389,9 @@ def parse_arff_lines(
                 # (arff_parser.cpp:69-119). '%' comment lines between the
                 # value tokens are skipped as usual; a quoted value inside
                 # the continued list may itself span further lines.
-                while _open_nominal(rest):
+                nom_state = _fold_nominal((None, False, False), rest)
+                pieces = [rest]
+                while nom_state[1] and not nom_state[2]:
                     nxt = next(it, None)
                     if nxt is None:
                         break  # _parse_attribute raises its located error
@@ -403,7 +409,15 @@ def parse_arff_lines(
                         lineno += 1
                         seg += "\n" + nx2
                         seg_q = _scan_quote("\n" + nx2, seg_q)
-                    rest = rest + " " + seg.strip(_WS)
+                    piece = seg.strip(_WS)
+                    pieces.append(piece)
+                    # Quote state at each boundary is None (both rest and
+                    # seg join to quote-balanced logical lines above), so
+                    # folding just the appended piece matches a rescan; a
+                    # single join below keeps the whole declaration linear
+                    # (chained `rest += piece` recopies the accumulation).
+                    nom_state = _fold_nominal(nom_state, " " + piece)
+                rest = " ".join(pieces)
                 attributes.append(_parse_attribute(rest, path, start_line))
                 interns.append({})
             elif key == "@data":
@@ -491,7 +505,37 @@ def _parse_numeric_fast(raw: str, path: str) -> "Dataset | None":
     data_end = raw.find("\n", m.end())
     if data_end < 0:
         return None
+    # The match may lie INSIDE a multi-line header value — a quoted value
+    # (quotes span physical lines, arff_lexer.cpp:159-188) or an open {...}
+    # nominal list (newlines are ordinary whitespace between value tokens,
+    # arff_parser.cpp:69-119) — and the @data line's own trailing content
+    # can open a quote that joins the first data row into the header's
+    # logical line. Fold quote AND brace state over everything up to and
+    # including the @data physical line — skipping '%' comment lines only
+    # while outside a quote, as parse_arff_lines does both at top level and
+    # between continuation lines — and defer to the full parser when the
+    # region ends inside either. Nominal lists don't nest, so one
+    # open/close flag mirrors the per-declaration continuation state.
     head_lines = raw[: m.start()].split("\n")
+    quote = None
+    brace = False
+    for ln in head_lines:
+        if quote is None and ln.startswith("%"):
+            continue
+        for ch in ln:
+            if quote is not None:
+                if ch == quote:
+                    quote = None
+            elif ch in ("'", '"'):
+                quote = ch
+            elif ch == "{":
+                brace = True
+            elif ch == "}":
+                brace = False
+    if quote is not None or brace:
+        return None  # the @data match itself lies inside a header value
+    if _scan_quote(raw[m.end() : data_end]) is not None:
+        return None  # the @data line's own tail opens a quote
     if head_lines and head_lines[-1] == "":
         # The slice ends at the newline BEFORE the @data line; drop the
         # phantom empty piece so the appended "@data" keeps its real line

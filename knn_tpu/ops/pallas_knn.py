@@ -297,7 +297,12 @@ def _knn_stripe_kernel(
         # bound by the [D, N] HBM re-stream per query tile — half the bytes
         # is the speedup); norms then accumulate in f32 from the same
         # bf16-rounded values the matmul consumes, so the distance is exact
-        # for the rounded operands.
+        # for the rounded TRAIN operand. The query side still rounds in the
+        # cross term only (q2 uses the unrounded f32 query): that shifts
+        # every distance for a given query by the same |q|^2 - |q~|^2, so
+        # neighbor ORDERING is unaffected (up to ties created by the zero
+        # clamp); absolute distances carry ~2^-8 relative query-rounding
+        # error (the bench recall guard covers the practical impact).
         t = tT_ref[:]  # [D_pad, BN], f32 or bf16
         # The f32->f32 identity cast is NOT elided by Mosaic — it
         # materializes a tile-sized copy that blew scoped VMEM on a narrow
@@ -937,12 +942,23 @@ def predict_pallas(
                 block_q=block_q, block_n=block_n, interpret=interpret,
                 precision=precision,
             )
+        except MemoryError:
+            # Host OOM is NOT a Mosaic corner case: retrying it on the merge
+            # kernel would double the work and bury the real bug under a
+            # RuntimeWarning (ADVICE r3). ValueError/TypeError stay INSIDE
+            # the net: Pallas surfaces trace-time lowering failures on odd
+            # (d, k, block) corners as exactly those types, which is the
+            # case this fallback exists for.
+            raise
         except Exception as e:
             # Auto-routed stripe dispatch can hit a Mosaic compile failure on
             # unmeasured (d, k, block) corners (ADVICE r2): fall back to the
             # merge kernel instead of turning an engine='auto' predict into a
             # hard error — loudly, so the root cause isn't lost if the merge
             # path then fails too. A *forced* stripe engine still propagates.
+            # The net stays wide below these carve-outs because the observed
+            # compile-failure surface spans RuntimeError, NotImplementedError,
+            # XlaRuntimeError, and the axon tunnel's HTTP-500 wrapper.
             if not auto_routed:
                 raise
             import warnings

@@ -209,6 +209,57 @@ class TestDialect:
                "@attribute class NUMERIC\n@data\na,0\n")
         assert _parse_numeric_fast(nom, "<t>") is None
 
+    def test_fast_path_ignores_data_inside_quoted_header_value(self):
+        # An '@data' line can lie INSIDE a multi-line quoted header value
+        # (quoted values span physical lines, arff_lexer.cpp:159-188). The
+        # fast path must not anchor on it: truncating the header there ends
+        # mid-quote and would raise 'unterminated quoted value' on a file
+        # both full parsers load fine (round-3 advisor repro).
+        from knn_tpu.data.pyarff import _parse_numeric_fast, parse_arff_lines
+
+        raw = ("@relation 'x\n@data y'\n@attribute a NUMERIC\n"
+               "@attribute class NUMERIC\n@data\n1,2\n")
+        assert _parse_numeric_fast(raw, "<t>") is None  # no spurious raise
+        ds = parse_arff_lines(raw.split("\n"), path="<t>")
+        assert ds.relation == "x\n@data y"
+        np.testing.assert_array_equal(ds.features, [[1.0]])
+        np.testing.assert_array_equal(ds.labels, [2])
+        # A quoted header value that CLOSES before the real @data keeps the
+        # fast path (state scan is exact, not just conservative).
+        ok = ("@relation 'multi\nline name'\n@attribute a NUMERIC\n"
+              "@attribute class NUMERIC\n@data\n1,2\n")
+        fast = _parse_numeric_fast(ok, "<t>")
+        assert fast is not None and fast.relation == "multi\nline name"
+
+    def test_fast_path_ignores_data_inside_open_nominal_list(self):
+        # Same defect class through the OTHER multi-line header construct:
+        # an '@data' line inside an open {...} nominal list (newlines are
+        # whitespace between value tokens, arff_parser.cpp:69-119) must not
+        # anchor the fast path either — truncating there raises
+        # 'unterminated nominal value list' on a file the full parser loads.
+        from knn_tpu.data.pyarff import _parse_numeric_fast, parse_arff_lines
+
+        raw = ("@relation r\n@attribute a {x,\n@data\ny}\n"
+               "@attribute class NUMERIC\n@data\nx,1\n")
+        assert _parse_numeric_fast(raw, "<t>") is None  # no spurious raise
+        ds = parse_arff_lines(raw.split("\n"), path="<t>")
+        assert [a.name for a in ds.attributes] == ["a", "class"]
+        assert ds.attributes[0].nominal_values == ["x", "@data", "y"]
+        np.testing.assert_array_equal(ds.labels, [1])
+
+    def test_fast_path_defers_quote_opened_on_data_line(self):
+        # A quote opened by the @data line's OWN trailing content joins the
+        # first data row into the header's logical line in the full parser
+        # (which then errors at EOF); the fast path must not silently
+        # succeed there — the scan covers through the end of the @data line.
+        from knn_tpu.data.pyarff import _parse_numeric_fast, parse_arff_lines
+
+        raw = ("@relation r\n@attribute a NUMERIC\n"
+               "@attribute class NUMERIC\n@data '\n1,2\n")
+        assert _parse_numeric_fast(raw, "<t>") is None
+        with pytest.raises(pyarff.ArffError, match="unterminated"):
+            parse_arff_lines(raw.split("\n"), path="<t>")
+
     def test_indented_percent_is_data_not_comment(self):
         # '%' starts a comment only at the true line start
         # (arff_lexer.cpp:60-78); indented it is a data token, which fails
