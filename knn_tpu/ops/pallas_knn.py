@@ -119,9 +119,15 @@ def _merge_topk_rounds(
 
 
 def _knn_kernel(
-    n_valid_ref, q_ref, t_ref, out_d_ref, out_i_ref,
-    *, k: int, block_n: int, d_true: int, precision: str,
+    n_valid_ref, q_ref, t_ref, *rest,
+    k: int, block_n: int, d_true: int, precision: str,
 ):
+    # Matmul forms take two extra inputs (precomputed norms); the exact form
+    # takes none. Outputs follow.
+    if precision in ("fast", "bf16"):
+        q2_ref, t2_ref, out_d_ref, out_i_ref = rest
+    else:
+        out_d_ref, out_i_ref = rest
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -139,16 +145,20 @@ def _knn_kernel(
         # This wide-feature config is HBM-bound on the train stream (the
         # whole [N, D] matrix re-streams once per query tile), so the host
         # entry stores the train operand AS bf16 — halving the stream is
-        # worth more than the matmul speedup itself; norms are accumulated
-        # in f32 from the same bf16 values the matmul consumes.
-        t32 = t.astype(jnp.float32)
-        q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
-        t2 = jnp.sum(t32 * t32, axis=1, keepdims=True).T  # [1, BN]
+        # worth more than the matmul speedup itself.
+        #
+        # The norms arrive PRECOMPUTED ([BQ,1] / [1,BN] blocks): computing
+        # them here re-ran the q reduction once per TRAIN tile and the t
+        # reduction once per QUERY tile (the kernel body executes per grid
+        # step — nothing hoists it), and forced an f32 materialization of a
+        # bf16 train tile that cost tile-sized VMEM. One XLA reduction per
+        # dispatch outside the kernel replaces all of it (r4); t2 still
+        # accumulates from the same bf16-rounded values the matmul consumes.
+        q2 = q2_ref[:]  # [BQ, 1]
+        t2 = t2_ref[:]  # [1, BN]
         if precision == "bf16":
             q = q.astype(jnp.bfloat16)
             t = t if t.dtype == jnp.bfloat16 else t.astype(jnp.bfloat16)
-        else:
-            t = t32
         cross = jax.lax.dot_general(
             q, t,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -217,6 +227,20 @@ def knn_pallas_candidates(
         _knn_kernel, k=k, block_n=block_n,
         d_true=d_true if d_true is not None else d_feat, precision=precision,
     )
+    in_specs = [
+        pl.BlockSpec((block_q, d_feat), lambda i, j, n_ref: (i, 0)),
+        pl.BlockSpec((block_n, d_feat), lambda i, j, n_ref: (j, 0)),
+    ]
+    inputs = [test_x, train_x]
+    if precision in ("fast", "bf16"):
+        # Precomputed norms (see _knn_kernel): one XLA reduction per dispatch
+        # instead of a per-grid-step in-kernel recompute. t2 accumulates in
+        # f32 from the STORED train values (bf16-rounded when stored bf16).
+        t32 = train_x.astype(jnp.float32)
+        inputs.append(jnp.sum(test_x * test_x, axis=1, keepdims=True))
+        inputs.append(jnp.sum(t32 * t32, axis=1, keepdims=True).T)
+        in_specs.append(pl.BlockSpec((block_q, 1), lambda i, j, n_ref: (i, 0)))
+        in_specs.append(pl.BlockSpec((1, block_n), lambda i, j, n_ref: (0, j)))
     flops = 2 * q_pad * n_pad * d_feat + 4 * grid[1] * q_pad * k * (block_n + k)
     return pl.pallas_call(
         kernel,
@@ -224,10 +248,7 @@ def knn_pallas_candidates(
             num_scalar_prefetch=1,
             grid=grid,
             # Index maps take (grid indices..., scalar-prefetch refs...).
-            in_specs=[
-                pl.BlockSpec((block_q, d_feat), lambda i, j, n_ref: (i, 0)),
-                pl.BlockSpec((block_n, d_feat), lambda i, j, n_ref: (j, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((block_q, k), lambda i, j, n_ref: (i, 0)),
                 pl.BlockSpec((block_q, k), lambda i, j, n_ref: (i, 0)),
@@ -246,13 +267,13 @@ def knn_pallas_candidates(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(jnp.asarray(n_valid, jnp.int32).reshape(1), test_x, train_x)
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1), *inputs)
 
 
 def _knn_stripe_kernel(
-    n_valid_ref, q_ref, tT_ref, out_d_ref, out_i_ref, cand_d_ref, cand_i_ref,
-    *, k: int, block_n: int, d_true: int, n_tiles: int, precision: str = "exact",
-    lite_retire: bool = False,
+    n_valid_ref, q_ref, tT_ref, *rest,
+    k: int, block_n: int, d_true: int, n_tiles: int, precision: str = "exact",
+    lite_retire: bool = False, select: Optional[str] = None,
 ):
     """Lane-striped KNN tile kernel (exact subtraction-form distance by
     default; ``precision="fast"/"bf16"`` swaps in the MXU matmul expansion).
@@ -274,6 +295,10 @@ def _knn_stripe_kernel(
     on the last train tile (writing the accumulator through the output refs
     instead costs an HBM write-back per grid step — ~20x the whole kernel).
     """
+    if precision in ("fast", "bf16"):
+        q2_ref, t2_ref, out_d_ref, out_i_ref, cand_d_ref, cand_i_ref = rest
+    else:
+        out_d_ref, out_i_ref, cand_d_ref, cand_i_ref = rest
     j = pl.program_id(1)
     lanes = 128
 
@@ -303,16 +328,19 @@ def _knn_stripe_kernel(
         # neighbor ORDERING is unaffected (up to ties created by the zero
         # clamp); absolute distances carry ~2^-8 relative query-rounding
         # error (the bench recall guard covers the practical impact).
+        # The norms arrive PRECOMPUTED ([BQ,1] / [1,BN] blocks): computing
+        # them here re-ran the q reduction once per TRAIN tile and the t
+        # reduction once per QUERY tile (the kernel body executes per grid
+        # step — nothing hoists it), and the bf16 store's f32 cast
+        # materialized a tile-sized VMEM copy. One XLA reduction per
+        # dispatch outside replaces all of it (r4); t2 still accumulates in
+        # f32 from the same bf16-rounded values the matmul consumes.
         t = tT_ref[:]  # [D_pad, BN], f32 or bf16
-        # The f32->f32 identity cast is NOT elided by Mosaic — it
-        # materializes a tile-sized copy that blew scoped VMEM on a narrow
-        # k=9 sweep shape — so cast only when the operand really is bf16.
-        t32 = t if t.dtype == jnp.float32 else t.astype(jnp.float32)
-        q2 = jnp.sum(q * q, axis=1, keepdims=True)  # [BQ, 1]
-        t2 = jnp.sum(t32 * t32, axis=0).reshape(1, block_n)  # [1, BN]
+        q2 = q2_ref[:]  # [BQ, 1]
+        t2 = t2_ref[:]  # [1, BN]
         qc, tc = (q.astype(jnp.bfloat16),
                   t if t.dtype == jnp.bfloat16 else t.astype(jnp.bfloat16)) \
-            if precision == "bf16" else (q, t32)
+            if precision == "bf16" else (q, t)
         cross = jax.lax.dot_general(
             qc, tc,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -351,10 +379,48 @@ def _knn_stripe_kernel(
     d_planes += [cand_d_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
     i_planes += [cand_i_ref[:, l * lanes : (l + 1) * lanes] for l in range(k)]
 
-    # k rounds of lexicographic (distance, index) min across planes. All ops
-    # are elementwise [BQ, 128]; ties resolve to the lowest global index
-    # (first-seen-wins, main.cpp:47). Retirement keys on index alone — global
-    # indices are unique, and the INT_MAX padding dupes all carry +inf.
+    # Fold the fresh planes into the running candidates. Two formulations,
+    # routed by trace-time op count (both exact, same lexicographic
+    # (distance, index) tie rule — first-seen-wins, main.cpp:47):
+    #
+    # 1. Truncated odd-even merge network (ops/topk_net.py): a tournament
+    #    of Batcher merges over (d, i) compare-exchanges. No retirement, no
+    #    finiteness gating; wins for k >= ~3 (r4 — recovered the xl k=10
+    #    regression and cut the headline selection cost ~25%).
+    # 2. k rounds of min-extraction across planes with retirement — cheaper
+    #    only at k <= 2 where two thin passes beat fused comparators.
+    from knn_tpu.ops import topk_net
+
+    net_ops, net_out = topk_net.tile_topk_program(g, k)
+    use_net = (
+        topk_net.program_cost(net_ops) < topk_net.rounds_cost(g, k, lite_retire)
+        if select is None
+        else select == "net"
+    )
+    if use_net:
+        for a, b, kind, ordered in net_ops:
+            ad, bd = d_planes[a], d_planes[b]
+            ai, bi = i_planes[a], i_planes[b]
+            swap = (bd < ad) if ordered else ((bd < ad) | ((bd == ad) & (bi < ai)))
+            if kind != "hi":
+                d_planes[a] = jnp.minimum(ad, bd)
+                i_planes[a] = jnp.where(swap, bi, ai)
+            if kind != "lo":
+                d_planes[b] = jnp.maximum(ad, bd)
+                i_planes[b] = jnp.where(swap, ai, bi)
+        for level in range(k):
+            cand_d_ref[:, level * lanes : (level + 1) * lanes] = \
+                d_planes[net_out[level]]
+            cand_i_ref[:, level * lanes : (level + 1) * lanes] = \
+                i_planes[net_out[level]]
+
+        @pl.when(j == n_tiles - 1)
+        def _writeback_net():
+            out_d_ref[:] = cand_d_ref[:]
+            out_i_ref[:] = cand_i_ref[:]
+
+        return
+
     for level in range(k):
         n_planes = len(d_planes)
         m_d = _tree_min(d_planes, n_planes)
@@ -404,7 +470,7 @@ def _knn_stripe_kernel(
     jax.jit,
     static_argnames=(
         "k", "block_q", "block_n", "interpret", "d_true", "precision",
-        "assume_finite",
+        "assume_finite", "select",
     ),
 )
 def knn_pallas_stripe_candidates(
@@ -418,6 +484,7 @@ def knn_pallas_stripe_candidates(
     d_true: Optional[int] = None,
     precision: str = "exact",
     assume_finite: bool = False,
+    select: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Lane-striped kernel entry. ``train_xT`` is the TRANSPOSED train
     matrix ``[D_pad, N_pad]`` (N padded to ``block_n``, D padded to a sublane
@@ -425,11 +492,21 @@ def knn_pallas_stripe_candidates(
     [Q,k] int32 global indices)`` sorted ascending by (distance, index).
     ``assume_finite`` — set ONLY when :func:`stripe_inputs_finite` holds for
     the unpadded inputs — selects the cheaper index-retirement-free selection
-    rounds (see the exactness argument in _knn_stripe_kernel)."""
+    rounds (see the exactness argument in _knn_stripe_kernel) when the
+    round-based formulation is in play. ``select`` overrides the trace-time
+    selection routing ("net" = merge network, "rounds" = min-extraction
+    rounds, None = route by op-count estimate) — a tuning/probe knob; both
+    formulations are exact."""
     d_pad, n_pad = train_xT.shape
     q_pad = test_x.shape[0]
     assert n_pad % block_n == 0 and q_pad % block_q == 0 and block_n % 128 == 0
     assert d_true is None or d_true <= d_pad
+    if select not in (None, "net", "rounds"):
+        # A typo ("Net", "network") would otherwise silently route to the
+        # rounds formulation and corrupt a probe comparison.
+        raise ValueError(
+            f"unknown select {select!r}; use None (auto), 'net', or 'rounds'"
+        )
     # A bf16-stored train operand (half the HBM re-stream per query tile) is
     # only meaningful to the bf16 distance form; exact/fast need f32.
     assert train_xT.dtype == jnp.float32 or (
@@ -445,16 +522,29 @@ def knn_pallas_stripe_candidates(
         n_tiles=grid[1],
         precision=precision,
         lite_retire=assume_finite,
+        select=select,
     )
+    in_specs = [
+        pl.BlockSpec((block_q, test_x.shape[1]), lambda i, j, n_ref: (i, 0)),
+        pl.BlockSpec((d_pad, block_n), lambda i, j, n_ref: (0, j)),
+    ]
+    inputs = [test_x, train_xT]
+    if precision in ("fast", "bf16"):
+        # Precomputed norms (see _knn_stripe_kernel): one XLA reduction per
+        # dispatch instead of a per-grid-step in-kernel recompute. t2
+        # accumulates in f32 from the STORED train values (bf16-rounded when
+        # stored bf16) — XLA fuses the cast into the reduction.
+        t32 = train_xT.astype(jnp.float32)
+        inputs.append(jnp.sum(test_x * test_x, axis=1, keepdims=True))
+        inputs.append(jnp.sum(t32 * t32, axis=0, keepdims=True))
+        in_specs.append(pl.BlockSpec((block_q, 1), lambda i, j, n_ref: (i, 0)))
+        in_specs.append(pl.BlockSpec((1, block_n), lambda i, j, n_ref: (0, j)))
     cand_d, cand_i = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((block_q, test_x.shape[1]), lambda i, j, n_ref: (i, 0)),
-                pl.BlockSpec((d_pad, block_n), lambda i, j, n_ref: (0, j)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((block_q, k * 128), lambda i, j, n_ref: (i, 0)),
                 pl.BlockSpec((block_q, k * 128), lambda i, j, n_ref: (i, 0)),
@@ -470,6 +560,14 @@ def knn_pallas_stripe_candidates(
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
+            # v5e has 128 MB of VMEM; the 16 MB scoped default is what XLA's
+            # output-placement heuristic budgets against, and it flips the
+            # [Q, 128k] outputs onto the VMEM stack (S(1)) whenever the
+            # kernel's own scoped usage reports low — observed the moment
+            # the r4 norm hoist freed the in-kernel t32 tile. Raise the
+            # kernel's budget instead of fighting the placement: the stack
+            # outputs are then a win (no HBM write-back on the last tile).
+            vmem_limit_bytes=64 * 1024 * 1024,
         ),
         cost_estimate=pl.CostEstimate(
             flops=3 * q_pad * n_pad * (d_true or d_pad) + 8 * q_pad * n_pad * k,
@@ -477,7 +575,7 @@ def knn_pallas_stripe_candidates(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(jnp.asarray(n_valid, jnp.int32).reshape(1), test_x, train_xT)
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1), *inputs)
 
     # Final 128·k -> k merge in XLA. k rounds of lexicographic (distance,
     # index) min-extraction — same tie order as a two-key sort but ~2x

@@ -1,0 +1,162 @@
+"""Compile-time top-k merge networks for the stripe kernel's selection.
+
+The stripe kernel keeps one k-candidate stripe per lane and must, per train
+tile, fold ``g`` fresh distance planes (128-column chunks of the tile) into
+the running candidates. The round-based formulation pays k passes over all
+``g + k`` planes per tile — a min-reduction, an index-select pass, and a
+retirement pass each round (``O(4 k (g + k))`` VPU ops). This module
+generates the cheaper structure: a **truncated odd-even merge network** —
+a tournament of Batcher merges that sorts the fresh planes' per-lane top-k
+and merges them with the (sorted) running candidates, with every
+compare-exchange whose outputs cannot reach the kept k wires pruned away
+(``O(g + k log^2 k)`` comparators, each a handful of elementwise ops).
+
+A network is a list of compare-exchange (CE) ops over *wires*; each wire
+holds one ``(distance, index)`` plane. A CE orders two wires by the
+lexicographic ``(d, i)`` key — the reference's first-seen-wins tie rule
+(main.cpp:47) — so the network needs no retirement passes and no finiteness
+gating: ties, +inf padding and NaN-policy +inf distances all flow through
+the total order. Correctness is validated exhaustively in the test suite by
+the 0-1 principle (a comparator network that sorts every 0-1 input sorts
+every input), which covers the truncation because top-k of a union equals
+top-k of the unions' top-k's.
+
+Programs are pure Python data generated at trace time and memoized per
+``(g, k)``; the kernel emits the corresponding jnp ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+# A CE op: (wire_a, wire_b, kind, ordered). After the op, wire_a holds the
+# lexicographic min of the two inputs and wire_b the max. ``kind`` marks
+# which outputs later ops actually read: "full" (both), "lo" (only the
+# min — the max write may be skipped), "hi" (only the max). ``ordered``
+# marks leaf CEs between two untouched fresh wires: there the per-lane
+# indices are statically ascending (plane order IS index order within a
+# lane), so the tie-break half of the swap predicate is constant-false and
+# the kernel can emit ``swap = (b.d < a.d)`` alone.
+CeOp = Tuple[int, int, str, bool]
+
+
+def _merge(a: Sequence[int], b: Sequence[int], ops: List[Tuple[int, int]]):
+    """Batcher odd-even merge of two sorted wire lists (arbitrary lengths),
+    appending CE ops; returns the merged wire order."""
+    if not a:
+        return list(b)
+    if not b:
+        return list(a)
+    if len(a) == 1 and len(b) == 1:
+        ops.append((a[0], b[0]))
+        return [a[0], b[0]]
+    evens = _merge(a[0::2], b[0::2], ops)
+    odds = _merge(a[1::2], b[1::2], ops)
+    # Interleave evens/odds and fix up adjacent (odd, next-even) pairs —
+    # the classic construction (TAOCP 5.3.4); validated exhaustively by the
+    # 0-1 principle in tests/test_topk_net.py for every size in use.
+    out = [evens[0]]
+    for t in range(len(odds)):
+        if t + 1 < len(evens):
+            ops.append((odds[t], evens[t + 1]))
+            out.append(odds[t])
+            out.append(evens[t + 1])
+        else:
+            out.append(odds[t])
+    out.extend(evens[len(odds) + 1 :])
+    return out
+
+
+def _prune(
+    ops: Sequence[Tuple[int, int]], keep: Sequence[int], n_fresh: int
+) -> List[CeOp]:
+    """Drop CEs whose outputs can never reach the kept wires, mark the
+    survivors with which side is consumed (a one-sided CE emits fewer
+    elementwise ops in the kernel), and flag ordered leaf CEs (see CeOp)."""
+    live = set(keep)
+    kept: List[CeOp] = []
+    for a, b in reversed(ops):
+        a_live, b_live = a in live, b in live
+        if not (a_live or b_live):
+            continue
+        kind = "full" if (a_live and b_live) else ("lo" if a_live else "hi")
+        kept.append((a, b, kind))
+        live.add(a)
+        live.add(b)
+    kept.reverse()
+    # Forward pass for the ordered flag: a CE is ordered when both wires are
+    # fresh planes (wire id < n_fresh), untouched so far, and a < b — per
+    # lane, fresh plane indices ascend with the wire id.
+    virgin = set(range(n_fresh))
+    out: List[CeOp] = []
+    for a, b, kind in kept:
+        ordered = a in virgin and b in virgin and a < b
+        virgin.discard(a)
+        virgin.discard(b)
+        out.append((a, b, kind, ordered))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def tile_topk_program(g: int, k: int) -> Tuple[Tuple[CeOp, ...], Tuple[int, ...]]:
+    """The per-train-tile selection program: wires ``0..g-1`` are the fresh
+    distance planes (unsorted singletons), wires ``g..g+k-1`` the running
+    candidate levels (sorted ascending per lane). Returns ``(ops,
+    out_wires)``: after executing ``ops`` in order, the ``k`` wires in
+    ``out_wires`` hold the new sorted running candidates — the per-lane
+    lexicographic top-k of all ``g + k`` inputs."""
+    ops: List[Tuple[int, int]] = []
+    lists: List[List[int]] = [[w] for w in range(g)]
+    while len(lists) > 1:
+        nxt: List[List[int]] = []
+        for i in range(0, len(lists) - 1, 2):
+            # Truncate every intermediate list at k: top-k of a union is
+            # top-k of the union of top-k's.
+            nxt.append(_merge(lists[i], lists[i + 1], ops)[:k])
+        if len(lists) % 2:
+            nxt.append(lists[-1])
+        lists = nxt
+    fresh = lists[0][:k]
+    running = list(range(g, g + k))
+    out = _merge(fresh, running, ops)[:k]
+    return tuple(_prune(ops, out, g)), tuple(out)
+
+
+def program_cost(ops: Sequence[CeOp]) -> int:
+    """Elementwise-op estimate for a program (full CE ~9 VPU ops, one-sided
+    ~7; ordered CEs save the 4-op tie-break predicate). This is HALF OF THE
+    KERNEL'S ROUTING PREDICATE: _knn_stripe_kernel picks the network iff
+    ``program_cost(ops) < rounds_cost(g, k, lite)`` at trace time, so the
+    weights here are load-bearing — change them and selection routing
+    flips."""
+    return sum(
+        (9 if kind == "full" else 7) - (4 if ordered else 0)
+        for _, _, kind, ordered in ops
+    )
+
+
+def rounds_cost(g: int, k: int, lite: bool = True) -> int:
+    """Elementwise-op estimate for the legacy round-based selection the
+    stripe kernel routes against: k rounds over ``n = g + k`` planes, each a
+    d min-tree (n-1), an index-select pass (3n-1), and — before the last
+    round — retirement (2n lite, 3n full). The kernel picks the network
+    whenever :func:`program_cost` beats this; at k <= 2 two cheap passes
+    beat fused (d, i) comparators and the rounds stay."""
+    n = g + k
+    return k * (4 * n - 2) + (k - 1) * (2 if lite else 3) * n
+
+
+def simulate(ops: Sequence[CeOp], values: list) -> list:
+    """Run a program on host scalars (pure Python, for tests): ``values`` is
+    a list of (d, i) tuples indexed by wire. One-sided ops still write both
+    wires — kind only marks which side later ops read, so writing both is
+    semantics-preserving — keeping the simulation faithful to pruning. The
+    ordered flag is honored the way the kernel honors it (no index
+    tie-break), so a wrongly-flagged op would surface as a wrong result."""
+    vals = list(values)
+    for a, b, kind, ordered in ops:
+        va, vb = vals[a], vals[b]
+        swap = (vb[0] < va[0]) if ordered else (vb < va)
+        vals[a], vals[b] = (vb, va) if swap else (va, vb)
+    return vals

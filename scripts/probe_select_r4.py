@@ -1,0 +1,104 @@
+"""r4 probe: round-based vs merge-network stripe selection, interleaved.
+
+Session-to-session device load on the tunneled v5e flickers far beyond the
+documented 1.5x (r4 observed a slope trial reading 247 Tflop/s — above the
+chip's bf16 peak — purely from a fast window during the r_hi batch), so the
+only trustworthy comparison is the two kernels INTERLEAVED in one session.
+Drives knn_pallas_stripe_candidates with select="rounds" vs select="net" on
+the bench shapes; everything else (blocks, precision, buffers) identical.
+
+Usage: python scripts/probe_select_r4.py [mnist|xl|headline ...]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import _interleaved_slope_trials, load_large, log  # noqa: E402
+
+
+def make_cases(config):
+    import jax
+    import jax.numpy as jnp
+
+    from knn_tpu.ops.pallas_knn import (
+        knn_pallas_stripe_candidates, stripe_prepare_queries,
+        stripe_prepare_train,
+    )
+
+    if config == "mnist":
+        n, q, d, k = 65536, 2048, 784, 5
+        bq, bn = 1024, 1024
+        precision, dtype = "bf16", jnp.bfloat16
+        rng = np.random.default_rng(0)
+        train = rng.random((n, d), np.float32)
+        test = rng.random((q, d), np.float32)
+        r_lo, r_hi = 10, 40
+    elif config in ("xl", "headline"):
+        tr, te, _ = load_large()
+        reps = 33 if config == "xl" else 1
+        train = np.tile(tr.features, (reps, 1))
+        if reps > 1:
+            train += 1e-3 * np.random.default_rng(0).standard_normal(
+                train.shape, dtype=np.float32)
+        test = te.features
+        n, d = train.shape
+        q = test.shape[0]
+        k = 10 if config == "xl" else 5
+        bq, bn = (64, 12288) if config == "xl" else (864, 2048)
+        precision, dtype = "exact", jnp.float32
+        r_lo, r_hi = (5, 20) if config == "xl" else (50, 200)
+    else:
+        raise SystemExit(f"unknown config {config}")
+
+    txT, d_pad = stripe_prepare_train(train, bn)
+    txj = jnp.asarray(txT, dtype)
+    bufs = [
+        jnp.asarray(stripe_prepare_queries(
+            test + np.float32(i) * 1e-7, bq, d_pad))
+        for i in range(r_hi)
+    ]
+    jax.block_until_ready(bufs)
+
+    def mkstep(select):
+        def step(qb):
+            return knn_pallas_stripe_candidates(
+                txj, qb, n, k, block_q=bq, block_n=bn, d_true=d,
+                precision=precision, assume_finite=True, select=select,
+            )
+        return step
+
+    steps = {s: mkstep(s) for s in ("rounds", "net")}
+    # Compile both and check bit-identical outputs (both exact selections).
+    outs = {}
+    for s, st in steps.items():
+        dd, ii = st(bufs[0])
+        outs[s] = (np.asarray(dd), np.asarray(ii))
+    same_i = np.array_equal(outs["rounds"][1], outs["net"][1])
+    same_d = np.array_equal(outs["rounds"][0], outs["net"][0])
+    log(f"{config}: rounds vs net outputs identical: idx={same_i} d={same_d}")
+    assert same_i and same_d
+    return {s: (st, bufs) for s, st in steps.items()}, q, n, d, r_lo, r_hi
+
+
+def main(configs):
+    for config in configs:
+        cases, q, n, d, r_lo, r_hi = make_cases(config)
+        slopes = _interleaved_slope_trials(cases, r_lo, r_hi, trials=5)
+        for s in ("rounds", "net"):
+            tr = sorted(slopes[s])
+            med = tr[len(tr) // 2]
+            log(f"{config} [{s:6}]: best {min(tr)*1e3:7.3f} ms  "
+                f"median {med*1e3:7.3f} ms  "
+                f"({q/min(tr):,.0f} q/s best, {q*n/min(tr)/1e9:.1f} Gdist/s)")
+        ratio = min(slopes["rounds"]) / min(slopes["net"])
+        log(f"{config}: net is {ratio:.2f}x rounds (best-vs-best, interleaved)")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or ["mnist", "xl", "headline"])

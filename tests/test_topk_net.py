@@ -1,0 +1,114 @@
+"""Correctness of the compile-time top-k merge networks (ops/topk_net.py).
+
+The programs are pure data; these tests validate them on host scalars. The
+0-1 principle (Knuth 5.3.4) makes the small exhaustive sweeps PROOFS for
+every (g, k) they cover: a comparator network computes the sorted top-k of
+every input iff it does so for every 0-1 input, and truncation is covered
+because the tested property is end-to-end (output == sorted(all inputs)[:k]).
+"""
+
+import itertools
+import random
+
+from knn_tpu.ops.topk_net import program_cost, simulate, tile_topk_program
+
+
+def run_program(g, k, fresh_vals, running_vals):
+    ops, out = tile_topk_program(g, k)
+    vals = list(fresh_vals) + sorted(running_vals)
+    result = simulate(ops, vals)
+    return [result[w] for w in out]
+
+
+def check_case(g, k, fresh_vals, running_vals):
+    got = run_program(g, k, fresh_vals, running_vals)
+    want = sorted(list(fresh_vals) + list(running_vals))[:k]
+    assert got == want, (g, k, fresh_vals, running_vals, got, want)
+
+
+class TestTileTopkProgram:
+    def test_zero_one_exhaustive_small(self):
+        # Every 0-1 assignment of the g fresh + k running wires (running
+        # sorted, as the kernel invariant guarantees) for every small shape:
+        # by the 0-1 principle this proves the network for these (g, k).
+        for g in range(1, 9):
+            for k in range(1, 6):
+                for bits in itertools.product((0, 1), repeat=g):
+                    for ones in range(k + 1):
+                        fresh = [(b, i) for i, b in enumerate(bits)]
+                        running = [
+                            (0 if i < k - ones else 1, 100 + i) for i in range(k)
+                        ]
+                        check_case(g, k, fresh, running)
+
+    def test_zero_one_exhaustive_bench_shapes(self):
+        # The bench shapes are too wide for full exhaustion; exhaust the 0-1
+        # patterns of a sliding window of fresh wires (others pinned) plus
+        # every running fill level — covers every comparator the window
+        # touches. g=16 (headline block_n=2048), g=8 (mnist block_n=1024),
+        # g=96 (xl block_n=12288, k=10).
+        for g, k in ((16, 5), (8, 5), (96, 10)):
+            for lo in range(0, g - 3, 3):
+                for bits in itertools.product((0, 1), repeat=4):
+                    fresh = [(1, i) for i in range(g)]
+                    for off, b in enumerate(bits):
+                        fresh[lo + off] = (b, lo + off)
+                    for ones in (0, k // 2, k):
+                        running = [
+                            (0 if i < k - ones else 1, 1000 + i)
+                            for i in range(k)
+                        ]
+                        check_case(g, k, fresh, running)
+
+    def test_random_with_heavy_ties(self):
+        # Lexicographic (d, i) semantics under dense ties: the kept set and
+        # its order must match a stable host sort — first-seen-wins on equal
+        # distances (main.cpp:47).
+        rng = random.Random(0)
+        for _ in range(400):
+            g = rng.randint(1, 24)
+            k = rng.randint(1, 10)
+            fresh = [(rng.randint(0, 3), i) for i in range(g)]
+            running = [(rng.randint(0, 3), 100 + i) for i in range(k)]
+            check_case(g, k, fresh, running)
+
+    def test_inf_padding_flows(self):
+        # +inf/INT_MAX padding (masked lanes, init levels) must lose to any
+        # finite candidate and tie harmlessly among themselves.
+        inf = float("inf")
+        imax = 2**31 - 1
+        fresh = [(inf, imax), (2.0, 7), (inf, imax), (0.0, 3)]
+        running = [(1.0, 50), (inf, imax), (inf, imax)]
+        check_case(4, 3, fresh, running)
+
+    def test_duplicate_distances_prefer_low_index(self):
+        fresh = [(1.0, 9), (1.0, 2), (1.0, 5)]
+        running = [(1.0, 0), (1.0, 7)]
+        got = run_program(3, 2, fresh, running)
+        assert got == [(1.0, 0), (1.0, 2)]
+
+    def test_cost_routing(self):
+        # The reason this module exists: the network must beat the k-round
+        # min-extraction on the shapes the kernel routes to it (every
+        # bench-relevant k >= 3 shape), and the kernel's routing rule
+        # (program_cost < rounds_cost) must keep the rounds at k <= 2 where
+        # two thin passes beat fused (d, i) comparators.
+        from knn_tpu.ops.topk_net import rounds_cost
+
+        for g, k in ((8, 5), (16, 5), (96, 10), (16, 16), (8, 3), (16, 4)):
+            ops, _ = tile_topk_program(g, k)
+            assert program_cost(ops) < rounds_cost(g, k), (g, k)
+        for g, k in ((8, 1), (16, 2), (96, 2)):
+            ops, _ = tile_topk_program(g, k)
+            assert program_cost(ops) >= rounds_cost(g, k), (g, k)
+
+    def test_outputs_sorted_invariant(self):
+        # The out wires must be sorted so the next tile's merge sees a
+        # sorted running list — the invariant the whole tournament rests on.
+        rng = random.Random(1)
+        for _ in range(100):
+            g, k = rng.randint(1, 20), rng.randint(1, 8)
+            fresh = [(rng.random(), i) for i in range(g)]
+            running = sorted((rng.random(), 100 + i) for i in range(k))
+            got = run_program(g, k, fresh, running)
+            assert got == sorted(got)
