@@ -136,18 +136,30 @@ class TestDeviceCache:
         p2 = fn(train, test, 5, engine="stripe")
         np.testing.assert_array_equal(p1, p2)
 
-    def test_inplace_mutation_requires_clear(self, rng):
-        # The documented contract: in-place feature mutation must be followed
-        # by device_cache.clear(); after clearing, results reflect new data.
+    def test_inplace_mutation_raises(self, rng):
+        # The ENFORCED contract (VERDICT r3 #8): the array attributes are
+        # read-only — in-place writes that would silently serve a stale
+        # device cache raise instead of corrupting results.
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x.copy(), train_y)
+        with pytest.raises(ValueError, match="read-only"):
+            train.features[:] = np.flipud(train.features.copy())
+        with pytest.raises(ValueError, match="read-only"):
+            train.labels[0] = 1
+
+    def test_rebinding_arrays_clears_device_cache(self, rng):
+        # Rebinding an array attribute invalidates cached device layouts
+        # automatically; subsequent retrievals reflect the new data.
         train_x, train_y, test_x, c = _tie_problem(rng)
         train = Dataset(train_x.copy(), train_y)
         test = Dataset(test_x, np.zeros(len(test_x), np.int32))
         m = KNNClassifier(k=3, engine="stripe").fit(train)
         m.kneighbors(test)  # populate
-        train.features[:] = np.flipud(train.features.copy())
-        train.device_cache.clear()
+        assert train.device_cache
+        train.features = np.flipud(np.asarray(train.features).copy())
+        assert not train.device_cache  # auto-cleared, no clear() call needed
         _, idx = m.kneighbors(test)
-        fresh = Dataset(train.features.copy(), train_y)
+        fresh = Dataset(np.asarray(train.features).copy(), train_y)
         want = KNNClassifier(k=3, engine="stripe").fit(fresh).kneighbors(test)[1]
         np.testing.assert_array_equal(idx, want)
 
