@@ -234,35 +234,30 @@ def _predict_query_batched(
         tx, ty = jnp.asarray(txp), jnp.asarray(typ)
         nv = jnp.asarray(n, jnp.int32)
 
-    window = 4  # in-flight dispatches: enough to pipeline, bounds residency
-    pending: list = []
-    results: list = []
+    from knn_tpu.utils.windowed import windowed_dispatch
 
-    def drain_one():
-        # Fetching frees our reference to the device buffers; trim tile
-        # padding per chunk so concatenation preserves global query order.
-        results.append(np.asarray(pending.pop(0))[:query_batch])
-
-    for s in range(0, q, query_batch):
+    def dispatch(s):
         chunk = test_x[s : s + query_batch]
         if chunk.shape[0] < query_batch:  # pad: one shape, one executable
             chunk = np.pad(chunk, ((0, query_batch - chunk.shape[0]), (0, 0)))
         if use_full or approx:
-            pending.append(knn_forward(
+            return knn_forward(
                 tx, ty, jnp.asarray(chunk), k=k, num_classes=num_classes,
                 precision=precision, approx=approx, recall_target=recall_target,
-            ))
-        else:
-            qp, _ = pad_axis_to_multiple(chunk, query_tile, axis=0)
-            pending.append(knn_forward_tiled(
-                tx, ty, jnp.asarray(qp), nv,
-                k=k, num_classes=num_classes, precision=precision,
-                query_tile=query_tile, train_tile=train_tile,
-            ))
-        if len(pending) > window:
-            drain_one()
-    while pending:
-        drain_one()
+            )
+        qp, _ = pad_axis_to_multiple(chunk, query_tile, axis=0)
+        return knn_forward_tiled(
+            tx, ty, jnp.asarray(qp), nv,
+            k=k, num_classes=num_classes, precision=precision,
+            query_tile=query_tile, train_tile=train_tile,
+        )
+
+    def fetch(out, s):
+        # Fetching frees our reference to the device buffers; trim tile
+        # padding per chunk so concatenation preserves global query order.
+        return np.asarray(out)[:query_batch]
+
+    results = windowed_dispatch(range(0, q, query_batch), dispatch, fetch)
     return np.concatenate(results)[:q]
 
 

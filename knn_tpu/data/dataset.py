@@ -71,33 +71,58 @@ class Dataset:
     _ARRAY_FIELDS = frozenset({"features", "labels", "raw_targets"})
 
     def __setattr__(self, name, value):
-        if name in self._ARRAY_FIELDS and isinstance(value, np.ndarray):
-            if value.flags.writeable:
+        if name in self._ARRAY_FIELDS:
+            if self.__dict__.get("_init_done"):
+                # Post-init rebind: the sanctioned mutation path. Coerce and
+                # validate like the constructor (a rebind must preserve N —
+                # changing the instance count means a new Dataset), and
+                # clear cached device layouts UNCONDITIONALLY: any rebind,
+                # whatever the value's type, makes them stale.
+                value = self._coerce(name, value)
+                self._check_shape(name, value)
+                self.device_cache.clear()
+            if isinstance(value, np.ndarray) and value.flags.writeable:
                 value = value.view()  # leave the caller's own flags alone
                 value.flags.writeable = False
-            cache = self.__dict__.get("device_cache")
-            if cache:  # rebinding after init: cached layouts are now stale
-                cache.clear()
         object.__setattr__(self, name, value)
 
-    def __post_init__(self):
-        self.features = np.ascontiguousarray(self.features, dtype=np.float32)
-        self.labels = np.ascontiguousarray(self.labels, dtype=np.int32)
-        if self.features.ndim != 2:
-            raise ValueError(f"features must be [N, D-1], got {self.features.shape}")
-        if self.labels.shape != (self.features.shape[0],):
-            raise ValueError(
-                f"labels shape {self.labels.shape} does not match N={self.features.shape[0]}"
-            )
-        if self.raw_targets is not None:
-            self.raw_targets = np.ascontiguousarray(
-                self.raw_targets, dtype=np.float32
-            )
-            if self.raw_targets.shape != (self.features.shape[0],):
+    @staticmethod
+    def _coerce(name: str, value):
+        if name == "raw_targets" and value is None:
+            return None
+        dtype = np.int32 if name == "labels" else np.float32
+        return np.ascontiguousarray(value, dtype=dtype)
+
+    def _check_shape(self, name: str, value) -> None:
+        if name == "features":
+            if value.ndim != 2:
+                raise ValueError(f"features must be [N, D-1], got {value.shape}")
+            want_n = value.shape[0]
+        else:
+            want_n = self.features.shape[0]
+        for field, arr in (
+            ("features", value if name == "features" else self.__dict__.get("features")),
+            ("labels", value if name == "labels" else self.__dict__.get("labels")),
+            ("raw_targets", value if name == "raw_targets" else self.__dict__.get("raw_targets")),
+        ):
+            if field == "features" or arr is None or not isinstance(arr, np.ndarray):
+                continue
+            if arr.shape != (want_n,):
                 raise ValueError(
-                    f"raw_targets shape {self.raw_targets.shape} does not match "
-                    f"N={self.features.shape[0]}"
+                    f"{field} shape {arr.shape} does not match N={want_n}"
                 )
+
+    def __post_init__(self):
+        self.features = self._coerce("features", self.features)
+        self.labels = self._coerce("labels", self.labels)
+        self.raw_targets = self._coerce("raw_targets", self.raw_targets)
+        self._check_shape("features", self.features)
+        if self.device_cache:
+            # A populated cache at construction means it was copied from
+            # another instance (dataclasses.replace passes the same dict),
+            # whose layouts may describe DIFFERENT arrays: start fresh.
+            self.device_cache = {}
+        object.__setattr__(self, "_init_done", True)
 
     @property
     def targets(self) -> np.ndarray:

@@ -147,6 +147,27 @@ class TestDeviceCache:
         with pytest.raises(ValueError, match="read-only"):
             train.labels[0] = 1
 
+    def test_dataclasses_replace_gets_fresh_cache(self, rng):
+        # dataclasses.replace passes the ORIGINAL instance's device_cache
+        # dict to the new instance; its layouts describe the old arrays, so
+        # the new instance must start with a fresh cache.
+        import dataclasses
+
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x.copy(), train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        m = KNNClassifier(k=3, engine="stripe").fit(train)
+        m.kneighbors(test)  # populate
+        assert train.device_cache
+        flipped = np.flipud(np.asarray(train.features).copy())
+        train2 = dataclasses.replace(train, features=flipped)
+        assert train2.device_cache == {}
+        assert train2.device_cache is not train.device_cache
+        _, idx = KNNClassifier(k=3, engine="stripe").fit(train2).kneighbors(test)
+        fresh = Dataset(flipped.copy(), train_y)
+        want = KNNClassifier(k=3, engine="stripe").fit(fresh).kneighbors(test)[1]
+        np.testing.assert_array_equal(idx, want)
+
     def test_rebinding_arrays_clears_device_cache(self, rng):
         # Rebinding an array attribute invalidates cached device layouts
         # automatically; subsequent retrievals reflect the new data.

@@ -237,6 +237,35 @@ class TestPallasKernel:
         bruteforce = ((test_x[:, None, :] - train_x[None, :, :]) ** 2).sum(-1)
         np.testing.assert_allclose(d, np.sort(bruteforce, axis=1)[:, :k], rtol=1e-5)
 
+    def test_stripe_candidates_chunked_matches_unchunked(self, rng):
+        # The windowed host entry (VERDICT r3 #3) must return exactly what
+        # one monolithic dispatch returns: chunk_rows=200 makes q=650 span
+        # four chunks including a ragged last one (padded up to the shared
+        # chunk shape so every chunk reuses one compiled executable).
+        from knn_tpu.ops.pallas_knn import (
+            knn_pallas_stripe_candidates, stripe_candidates_arrays,
+            stripe_prepare_queries, stripe_prepare_train,
+        )
+
+        train_x = rng.integers(0, 4, (200, 6)).astype(np.float32)
+        test_x = rng.integers(0, 4, (650, 6)).astype(np.float32)
+        k, bq, bn = 16, 8, 128
+        d, i = stripe_candidates_arrays(
+            train_x, test_x, k, block_q=bq, block_n=bn, interpret=True,
+            chunk_rows=200,
+        )
+        assert d.shape == (650, k)
+        txT, d_pad = stripe_prepare_train(train_x, bn)
+        import jax.numpy as jnp
+
+        dm, im = knn_pallas_stripe_candidates(
+            jnp.asarray(txT),
+            jnp.asarray(stripe_prepare_queries(test_x, bq, d_pad)),
+            200, k, block_q=bq, block_n=bn, interpret=True, d_true=6,
+        )
+        np.testing.assert_array_equal(d, np.asarray(dm)[:650])
+        np.testing.assert_array_equal(i, np.asarray(im)[:650])
+
     def test_stripe_duplicate_rows_across_tiles(self, rng):
         # Duplicates landing in the same lane stripe across different train
         # tiles AND in different lanes: merge must keep lowest global index.
