@@ -3,17 +3,18 @@
 The default run classifies large-test.arff (1,718 queries) against
 large-train.arff (30,803 rows, 11 features) at k=5 on the available
 accelerator, then also runs the secondary configs (mnist / xl / xxl /
-ingest / sharded / kneighbors) and prints ONE JSON line — the headline
-record with every secondary config embedded under ``"configs"`` so each
-round's BENCH_r*.json proves all claims (VERDICT r1 #7):
+ingest / sharded / kneighbors / sweepk) and prints ONE JSON line — the
+headline record with every secondary config embedded under ``"configs"``
+so each round's BENCH_r*.json proves all claims (VERDICT r1 #7):
 
   {"metric": "large_k5_query_throughput", "value": N, "unit": "queries/sec",
    "vs_baseline": N, ..., "configs": {"mnist784": {...}, "xl": {...},
-   "xxl": {...}, "ingest": {...}, "sharded": {...}, "kneighbors": {...}}}
+   "xxl": {...}, "ingest": {...}, "sharded": {...}, "kneighbors": {...},
+   "sweepk": {...}}}
 
 Diagnostics go to stderr. ``--config
-mnist|xl|xxl|ingest|sharded|kneighbors|headline`` runs a single config and
-prints just its record:
+mnist|xl|xxl|ingest|sharded|kneighbors|sweepk|headline`` runs a single
+config and prints just its record:
 
 - mnist      — BASELINE.json config-5 shape (65,536 x 784 synthetic, 2,048
                queries, k=5) through the Pallas kernel (MXU distance form).
@@ -25,6 +26,8 @@ prints just its record:
                the stripe kernel on a 1-device mesh: proves the multi-chip
                code path runs at single-chip headline throughput per chip.
 - kneighbors — model retrieval API wall latency per candidate engine.
+- sweepk     — sweep_k({1,5,10}) vs three single-k runs vs one k=10 run at
+               two train scales: the measured one-retrieval-many-k claim.
 """
 
 from __future__ import annotations
@@ -272,6 +275,16 @@ def bench_mnist():
     }
 
 
+def _tiled_large(train, reps):
+    """THE xl/xxl scale dataset: large-train tiled ``reps``x, de-duplicated
+    with float32 noise (a float64 normal at 10M x 11 is an ~880 MB
+    temporary). One definition so every config benchmarks the same data."""
+    rng = np.random.default_rng(0)
+    feats = np.tile(train.features, (reps, 1))
+    feats += 1e-3 * rng.standard_normal(feats.shape, dtype=np.float32)
+    return feats, np.tile(train.labels, reps)
+
+
 def _scaled_stripe_run(reps_tile, k, block_q, block_n, r_lo, r_hi):
     """Shared core for the xl/xxl scale configs: tile large-train
     ``reps_tile``x with float32 dedup noise, run the lane-striped classify at
@@ -286,11 +299,7 @@ def _scaled_stripe_run(reps_tile, k, block_q, block_n, r_lo, r_hi):
     )
 
     train, test, _ = load_large()
-    rng = np.random.default_rng(0)
-    feats = np.tile(train.features, (reps_tile, 1))
-    # float32 noise: a float64 normal at 10M x 11 is an ~880 MB temporary.
-    feats += 1e-3 * rng.standard_normal(feats.shape, dtype=np.float32)
-    labels = np.tile(train.labels, reps_tile)
+    feats, labels = _tiled_large(train, reps_tile)
     n, d_true = feats.shape
     log(f"scaled config: {n:,} train rows x {d_true} features, "
         f"{test.num_instances} queries, k={k}")
@@ -578,6 +587,81 @@ def bench_kneighbors():
     }
 
 
+def bench_sweepk():
+    """VERDICT r3 #7: the measured version of the sweep_k claim — every k in
+    {1, 5, 10} from ONE shared retrieval should cost about one max-k run,
+    where the reference re-runs the whole binary per k (BASELINE.json runs
+    them as separate jobs). Measured at two scales: the headline train set
+    and the xl 1M-row tiling, both through the real model API (device cache
+    warm, compiles warm)."""
+    from knn_tpu.data.dataset import Dataset
+    from knn_tpu.models.knn import sweep_k
+    from knn_tpu.utils.evaluate import accuracy, confusion_matrix
+
+    train, test, is_reference = load_large()
+    ks = [1, 5, 10]
+    record = {
+        "metric": "sweepk_vs_single_cost",
+        "value": None,  # filled with the large-config ratio below
+        "unit": "sweep_wall / single_k10_wall",
+        "vs_baseline": None,
+    }
+
+    xl_ds = Dataset(*_tiled_large(train, 33))
+
+    for name, tr_ds in (("large", train), ("xl_1M", xl_ds)):
+        preds = sweep_k(tr_ds, test, ks)  # warm: compile + device cache
+        if name == "large" and is_reference:
+            accs = {
+                k: round(accuracy(confusion_matrix(
+                    preds[k], test.labels, test.num_classes)), 4)
+                for k in ks
+            }
+            log(f"sweep_k accuracies: {accs} "
+                f"(golden 0.9919 / 0.9948 / 0.7538)")
+            record["large_accuracies"] = accs
+        sweep_trials, single_trials, kmax_trials = [], [], []
+        for _ in range(3):
+            t0 = time.monotonic()
+            sweep_k(tr_ds, test, ks)
+            sweep_trials.append(time.monotonic() - t0)
+        for k in ks:
+            # Warm each k's single-run shape — and use the output to verify
+            # the prefix-equivalence claim itself: every sweep entry must
+            # equal that k's individual run.
+            single = sweep_k(tr_ds, test, [k])
+            if not np.array_equal(preds[k], single[k]):
+                log(f"WARNING: sweep_k[{name}] k={k} diverges from the "
+                    f"individual run — prefix-vote invariant broken")
+                record["prefix_equivalence"] = False
+        record.setdefault("prefix_equivalence", True)
+        for _ in range(3):
+            t0 = time.monotonic()
+            for k in ks:
+                sweep_k(tr_ds, test, [k])
+            single_trials.append(time.monotonic() - t0)
+            t0 = time.monotonic()
+            sweep_k(tr_ds, test, [ks[-1]])
+            kmax_trials.append(time.monotonic() - t0)
+        t_sweep, t_three = min(sweep_trials), min(single_trials)
+        t_kmax = min(kmax_trials)
+        log(f"sweep_k[{name}]: sweep {t_sweep*1e3:.0f} ms vs three runs "
+            f"{t_three*1e3:.0f} ms vs one k=10 run {t_kmax*1e3:.0f} ms")
+        record[f"{name}_sweep_ms"] = round(t_sweep * 1e3, 1)
+        record[f"{name}_three_runs_ms"] = round(t_three * 1e3, 1)
+        record[f"{name}_single_k10_ms"] = round(t_kmax * 1e3, 1)
+        record[f"{name}_sweep_ms_trials"] = [
+            round(t * 1e3, 1) for t in sweep_trials
+        ]
+        record[f"{name}_single_k10_ms_trials"] = [
+            round(t * 1e3, 1) for t in kmax_trials
+        ]
+    record["value"] = round(
+        record["large_sweep_ms"] / record["large_single_k10_ms"], 2
+    )
+    return record
+
+
 def bench_headline():
     import jax
     import jax.numpy as jnp
@@ -703,6 +787,7 @@ _SECONDARY_CONFIGS = {
     "ingest": bench_ingest,
     "sharded": bench_sharded,
     "kneighbors": bench_kneighbors,
+    "sweepk": bench_sweepk,
 }
 
 
