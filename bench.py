@@ -339,21 +339,78 @@ def bench_xl():
     blocks amortize the selection rounds. (The train-sharded multi-chip
     variant of this config is validated on the CPU mesh — tests/test_parallel
     and __graft_entry__.dryrun_multichip — since one real chip is available.)"""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    k = 10
     train, test, feats, _, trials, _ = _scaled_stripe_run(
-        reps_tile=33, k=10, block_q=64, block_n=12288, r_lo=5, r_hi=20,
+        reps_tile=33, k=k, block_q=64, block_n=12288, r_lo=5, r_hi=20,
     )
     per_step = min(trials)
-    qps = test.num_instances / per_step
-    dist_rate = test.num_instances * feats.shape[0] / per_step
+    q = test.num_instances
+    n = feats.shape[0]
+    qps = q / per_step
+    dist_rate = q * n / per_step
+
+    # Hardware approximate selection at the scale where it could plausibly
+    # win (VERDICT r3 #4): lax.approx_max_k over the full distance matrix,
+    # recall measured against the exact stripe candidates. This is the
+    # measurement that decides whether --approx earns its API surface.
+    #
+    # Run on a RANDOM 1M x 11 set of the same shape, not the tiled arrays:
+    # approx_max_k's recall guarantee assumes the true top-k land at
+    # ~random positions, and the 33x tiling places each query's top-k at a
+    # regular 30,803-row stride that is adversarial to its positional
+    # binning — measured recall collapses to 0.002 there (r4), an artifact
+    # of the synthetic duplication, not of real data.
+    from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
+
+    rng = np.random.default_rng(7)
+    rnd_train = rng.random((feats.shape[0], feats.shape[1]), np.float32)
+    rnd_test = rng.random((q, feats.shape[1]), np.float32)
+    _, exact_idx = stripe_candidates_arrays(rnd_train, rnd_test, k)
+
+    @functools.partial(jax.jit, static_argnames=("k", "recall_target"))
+    def approx_step(tx, qx, k, recall_target):
+        d2 = (
+            jnp.sum(qx * qx, axis=1, keepdims=True)
+            - 2.0 * qx @ tx.T
+            + jnp.sum(tx * tx, axis=1)[None, :]
+        )
+        _, idx = jax.lax.approx_max_k(-d2, k, recall_target=recall_target)
+        return idx.astype(jnp.int32)
+
+    txj = jnp.asarray(rnd_train)
+    qbufs = [jnp.asarray(rnd_test + np.float32(i) * 1e-7) for i in range(8)]
+    jax.block_until_ready(qbufs)
+    approx_idx = np.asarray(approx_step(txj, qbufs[0], k, 0.95))
+    idx_recall = float(np.mean([
+        len(set(exact_idx[i]) & set(approx_idx[i])) / k for i in range(q)
+    ]))
+    approx_trials = _slope_trials(
+        lambda qb: approx_step(txj, qb, k, 0.95), qbufs, 2, 8, trials=3,
+    )
+    approx_qps = q / min(approx_trials)
+    log(f"approx_max_k (full-matrix, random 1M, recall_target=0.95): "
+        f"{min(approx_trials)*1e3:.1f} ms/step ({approx_qps:,.0f} q/s), "
+        f"recall@{k} vs exact stripe = {idx_recall:.4f}")
     return {
         "metric": "xl_1M_k10_query_throughput",
         "value": round(qps, 1),
         "unit": "queries/sec",
         "vs_baseline": None,
-        "train_rows": int(feats.shape[0]),
+        "train_rows": int(n),
         "dist_evals_per_sec": round(dist_rate / 1e9, 1),
         "dist_unit": "Gdist/s",
         **_spread(trials),
+        "approx_qps": round(approx_qps, 1),
+        "approx_recall_at_k": round(idx_recall, 4),
+        "approx_dataset": "random 1M x 11 (tiled data is adversarial to "
+                          "approx_max_k's positional binning: recall 0.002)",
+        "approx_step_ms_trials": [round(t * 1e3, 2) for t in approx_trials],
+        "approx_wins": bool(approx_qps > qps),
     }
 
 
@@ -656,9 +713,8 @@ def bench_sweepk():
         record[f"{name}_single_k10_ms_trials"] = [
             round(t * 1e3, 1) for t in kmax_trials
         ]
-    record["value"] = round(
-        record["large_sweep_ms"] / record["large_single_k10_ms"], 2
-    )
+        if name == "large":
+            record["value"] = round(t_sweep / t_kmax, 2)
     return record
 
 

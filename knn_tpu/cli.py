@@ -106,7 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup", action="store_true",
                    help="run once before timing (excludes compile time)")
     p.add_argument("--approx", action="store_true",
-                   help="TPU hardware approximate top-k (not prediction-exact)")
+                   help="TPU hardware approximate top-k (not prediction-"
+                   "exact). Measured r4 on 1M random rows, k=10: ~10x the "
+                   "exact stripe kernel at recall ~0.92; AVOID on data with "
+                   "regularly-strided duplicates, where the positional "
+                   "binning's recall guarantee collapses (measured 0.002 on "
+                   "a 33x-tiled set)")
     p.add_argument("--recall-target", type=float, default=None,
                    help="per-candidate expected recall for --approx "
                    "(0 < r <= 1, default 0.95; higher = slower, closer to "
