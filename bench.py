@@ -507,6 +507,33 @@ def bench_ingest():
     results["python_ms_trials"] = [round(t * 1e3, 1) for t in tr]
     log(f"python parser: {t_py*1e3:.1f} ms ({size_mb/t_py:.0f} MB/s)")
 
+    # Amortized large-file rate: per-call fixed costs (open, ctypes, attr
+    # JSON, Dataset construction) are a real fraction of a 1.8 MB parse;
+    # a ~90 MB file shows the streaming rate a big ingest actually gets
+    # (the r3 framing was "a 100 MB ARFF costs ~1.1 s of host time").
+    if "native_mb_per_s" in results:
+        big = Path(__file__).parent / "build" / "ingest_xl.arff"
+        raw = Path(train_path).read_text()
+        head_end = raw.lower().index("@data") + len("@data\n")
+        body = raw[head_end:]
+        expected = head_end + 50 * len(body)
+        if not big.exists() or os.path.getsize(big) != expected:
+            # Size-checked against the current source so a regenerated
+            # fixture can't leave a stale replica being measured.
+            big.parent.mkdir(parents=True, exist_ok=True)
+            with open(big, "w") as f:
+                f.write(raw[:head_end])
+                for _ in range(50):
+                    f.write(body)
+        big_mb = os.path.getsize(big) / 1e6
+        t_big, big_rows, tr = timeit(
+            lambda: arff_native.parse(str(big)), reps=3)
+        results["native_xl_file_mb"] = round(big_mb, 1)
+        results["native_xl_mb_per_s"] = round(big_mb / t_big, 1)
+        results["native_xl_ms_trials"] = [round(t * 1e3, 1) for t in tr]
+        log(f"native C++ parser, {big_mb:.0f} MB file: {t_big*1e3:.0f} ms "
+            f"({big_mb/t_big:.0f} MB/s, {big_rows:,} rows)")
+
     return {
         "metric": "arff_ingest_throughput",
         "value": results.get("native_mb_per_s", results["python_mb_per_s"]),

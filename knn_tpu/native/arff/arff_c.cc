@@ -24,6 +24,7 @@
 //
 // C ABI only — bound from Python via ctypes (no pybind11 in this image).
 
+#include <array>
 #include <cctype>
 #include <charconv>
 #include <cmath>
@@ -31,16 +32,23 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <utility>
 #include <vector>
 
 namespace {
 
+enum TypeCode { TC_NUMERIC = 0, TC_NOMINAL = 1, TC_STRING = 2, TC_DATE = 3 };
+
 struct Attr {
   std::string name;
   std::string type;  // "numeric" | "string" | "date" | "nominal"
+  // The same fact as an enum: cell_view_to_float runs per CELL and three
+  // std::string comparisons there were a measurable slice of ingest.
+  int type_code = TC_NUMERIC;
   std::vector<std::string> nominal;
   // STRING/DATE cell interning (first-seen order): the dense matrix stores
   // the code, `interned` is the code->value table. The reference keeps heap
@@ -212,6 +220,7 @@ bool parse_attribute(const std::string& rest_in, ParseState& st) {
       return false;
     }
     attr.type = "nominal";
+    attr.type_code = TC_NOMINAL;
     std::string inner = rest.substr(1, rest.size() - 2);
     std::vector<std::string> vals;
     // "{a,b,}" is reference-valid: the comma before "}" is consumed as the
@@ -232,13 +241,16 @@ bool parse_attribute(const std::string& rest_in, ParseState& st) {
   } else {
     size_t sp = rest.find_first_of(" \t");
     std::string word = sp == std::string::npos ? rest : rest.substr(0, sp);
-    if (ieq(word, "numeric") || ieq(word, "real") || ieq(word, "integer"))
+    if (ieq(word, "numeric") || ieq(word, "real") || ieq(word, "integer")) {
       attr.type = "numeric";
-    else if (ieq(word, "string"))
+      attr.type_code = TC_NUMERIC;
+    } else if (ieq(word, "string")) {
       attr.type = "string";
-    else if (ieq(word, "date"))
+      attr.type_code = TC_STRING;
+    } else if (ieq(word, "date")) {
       attr.type = "date";
-    else {
+      attr.type_code = TC_DATE;
+    } else {
       fail(st, "unsupported attribute type '" + rest + "'");
       return false;
     }
@@ -253,7 +265,62 @@ bool cell_view_to_float(const char* p, size_t len, Attr& attr, float* out,
     *out = NAN;
     return true;
   }
-  if (attr.type == "nominal") {
+  if (attr.type_code == TC_NUMERIC) {
+    // Fastest path: plain short decimals ([-]D*.D*, <= 8 digits, no
+    // exponent) — the overwhelming cell shape in numeric ARFF. With
+    // mantissa m < 2^24 and frac <= 10, float(m) and float(10^frac) are
+    // both EXACT (5^10 < 2^24), so one correctly-rounded float division
+    // computes the correctly rounded value of the decimal itself —
+    // bit-identical to strtof/from_chars at ~3x the speed. Anything else
+    // (longer, exponents, inf/nan, signs beyond '-') falls through.
+    {
+      static const float kP10[11] = {1e0f, 1e1f, 1e2f, 1e3f, 1e4f, 1e5f,
+                                     1e6f, 1e7f, 1e8f, 1e9f, 1e10f};
+      const char* c = p;
+      const char* e = p + len;
+      bool neg = c < e && *c == '-';
+      if (neg) c++;
+      uint32_t m = 0;
+      int ndig = 0, frac = 0;
+      bool seen_dot = false, simple = c < e;
+      while (c < e) {
+        char ch = *c;
+        if (ch >= '0' && ch <= '9') {
+          m = m * 10u + (uint32_t)(ch - '0');
+          if (++ndig > 8) { simple = false; break; }
+          if (seen_dot) frac++;
+        } else if (ch == '.' && !seen_dot) {
+          seen_dot = true;
+        } else {
+          simple = false;
+          break;
+        }
+        c++;
+      }
+      if (simple && ndig >= 1 && m < (1u << 24) && frac <= 10) {
+        float v = (float)m / kP10[frac];
+        *out = neg ? -v : v;
+        return true;
+      }
+    }
+    // General path: from_chars — no allocation, no locale. It must consume
+    // the ENTIRE token (same acceptance rule as the old strtof+endp
+    // check). The fallback keeps strtof's wider acceptance — leading '+',
+    // hex floats, inf/nan spellings, and over/underflow (from_chars
+    // reports out_of_range, strtof clamps and accepts) — so the dialect
+    // is unchanged.
+    auto res = std::from_chars(p, p + len, *out);
+    if (res.ec == std::errc() && res.ptr == p + len) return true;
+    std::string tok(p, len);
+    char* endp = nullptr;
+    *out = strtof(tok.c_str(), &endp);
+    if (len == 0 || endp != tok.c_str() + tok.size()) {
+      fail(st, "cannot parse '" + tok + "' as a number for '" + attr.name + "'");
+      return false;
+    }
+    return true;
+  }
+  if (attr.type_code == TC_NOMINAL) {
     for (size_t i = 0; i < attr.nominal.size(); ++i)
       if (attr.nominal[i].size() == len &&
           memcmp(attr.nominal[i].data(), p, len) == 0) {
@@ -264,30 +331,21 @@ bool cell_view_to_float(const char* p, size_t len, Attr& attr, float* out,
              attr.name + "'");
     return false;
   }
-  if (attr.type == "string" || attr.type == "date") {
-    std::string tok(p, len);
-    auto ins = attr.intern_idx.emplace(tok, (int)attr.interned.size());
-    if (ins.second) attr.interned.push_back(tok);
-    *out = (float)ins.first->second;
-    return true;
-  }
-  // Numeric fast path: std::from_chars parses straight from the view, no
-  // allocation, no locale. It must consume the ENTIRE token (same acceptance
-  // rule as the old strtof+endp check). The fallback preserves strtof's
-  // wider acceptance — leading '+', hex floats, inf/nan spellings, and
-  // over/underflow (which from_chars reports as out_of_range but strtof
-  // clamps and accepts) — so the dialect is unchanged, just faster.
-  auto res = std::from_chars(p, p + len, *out);
-  if (res.ec == std::errc() && res.ptr == p + len) return true;
+  // TC_STRING / TC_DATE: intern in first-seen order.
   std::string tok(p, len);
-  char* endp = nullptr;
-  *out = strtof(tok.c_str(), &endp);
-  if (len == 0 || endp != tok.c_str() + tok.size()) {
-    fail(st, "cannot parse '" + tok + "' as a number for '" + attr.name + "'");
-    return false;
-  }
+  auto ins = attr.intern_idx.emplace(tok, (int)attr.interned.size());
+  if (ins.second) attr.interned.push_back(tok);
+  *out = (float)ins.first->second;
   return true;
 }
+
+// The seven structural bytes of the @data tokenizer; everything else is an
+// ordinary token byte the run scan consumes without per-byte dispatch.
+static const std::array<bool, 256> kStructural = [] {
+  std::array<bool, 256> t{};
+  for (unsigned char c : {' ', '\t', ',', '\n', '\r', '\'', '"'}) t[c] = true;
+  return t;
+}();
 
 // Streaming zero-copy scanner for the @data section — the ingest hot path.
 //
@@ -307,7 +365,19 @@ bool cell_view_to_float(const char* p, size_t len, Attr& attr, float* out,
 // '%' comments only at the true line start, a first non-ws '{' is a sparse
 // row, '\r' is a token character unless it belongs to line-trailing
 // whitespace, a quoted value reads through newlines to its closing quote.
-bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
+//
+// EAGER mode (all-numeric headers only): each token converts the moment it
+// closes, skipping the per-row Tok buffering entirely. The deferred-error
+// dance preserves the discard rule exactly: a conversion failure stashes
+// its message and only surfaces if that row COMPLETES (a malformed value
+// in the final partial row must not error); at EOF the partial row's
+// already-pushed cells are truncated away. Numeric conversion has no side
+// effects, so eager conversion of a to-be-discarded partial row is
+// invisible — which is exactly why interning types (STRING/DATE) must take
+// the buffered path instead.
+template <bool EAGER>
+bool parse_data_stream_impl(std::string_view data, size_t pos,
+                            ParseState& st) {
   const char* s = data.data();
   const size_t N = data.size();
   const size_t d = st.attrs.size();
@@ -323,9 +393,33 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
     int32_t line;
     int32_t owned;  // index into `owned` for composite tokens, else -1
   };
+  // `row` and `convert_row` serve only the buffered (!EAGER) instantiation;
+  // every use sits behind the EAGER branches, so the eager binary carries
+  // no Tok traffic (the compiler strips the dead lambda).
   std::vector<Tok> row;      // tokens of the row in progress
   std::vector<std::string> owned;
-  row.reserve(d);
+  if constexpr (!EAGER) row.reserve(d);
+  // One up-front reservation keeps the hot push_back from ever
+  // reallocating. Estimate rows from the line density of a 64 KB sample
+  // instead of a blind bytes/3 guess: at 90 MB the blind guess
+  // over-reserved ~60%, and the first-touch page faults on the unused
+  // tail were a measurable slice of large-file ingest.
+  {
+    size_t span = N - pos;
+    size_t sample = span < (64u << 10) ? span : (64u << 10);
+    size_t nl = 0;
+    for (size_t i = pos; i < pos + sample; ++i) nl += s[i] == '\n';
+    // No newline in the sample = rows wider than 64 KB: estimate cells
+    // from bytes-per-cell instead of rows (a row-count guess that ignores
+    // d asked for ~row_est*d cells and turned a 2 MB, 30k-attribute file
+    // into a multi-GB reserve). Either way, clamp by the hard bound that
+    // every cell costs at least 2 input bytes (token + separator).
+    double cells_est =
+        nl ? (double)span * nl / sample * (double)d * 1.08 : span / 6.0;
+    size_t cap = span / 2 + d;
+    st.cells.reserve(st.cells.size() +
+                     (cells_est < (double)cap ? (size_t)cells_est : cap));
+  }
 
   auto convert_row = [&]() -> bool {
     int save_line = st.line;
@@ -344,6 +438,10 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
     return true;
   };
 
+  size_t toks_in_row = 0;   // EAGER: tokens seen in the current row
+  size_t cells_in_row = 0;  // EAGER: cells pushed for the current row
+  std::string pending_err;  // EAGER: first conversion error in the row
+
   while (pos < N) {
     st.line++;
     // '%' comments only at the true line start (arff_lexer.cpp:60-78).
@@ -351,6 +449,89 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
       while (pos < N && s[pos] != '\n') pos++;
       if (pos < N) pos++;
       continue;
+    }
+    if constexpr (EAGER) {
+      // Opportunistic fused line scan — the shape of essentially every
+      // line of a dense numeric file: ordinary-byte tokens separated by
+      // SINGLE commas, ending straight in '\n' (or EOF). One run scan and
+      // one convert per token, no per-character dispatch. Anything off the
+      // shape (leading '{' or whitespace, tabs/CR/quotes, empty cells,
+      // trailing comma) restores the line-start state transactionally and
+      // falls through to the general machinery below — which re-parses
+      // the line from scratch, so the fast attempt can never change what
+      // is accepted, rejected, or reported.
+      if (s[pos] != '{') {
+        size_t p2 = pos;
+        const size_t save_cells = st.cells.size();
+        const size_t save_toks = toks_in_row;
+        const size_t save_cir = cells_in_row;
+        const bool had_pending = !pending_err.empty();
+        bool ok_line = true, line_done = false;
+        while (true) {
+          size_t t0 = p2;
+          while (p2 < N && !kStructural[(unsigned char)s[p2]]) p2++;
+          if (p2 == t0) {
+            ok_line = false;  // blank line, leading ws, or empty cell
+            break;
+          }
+          // Validate the terminator BEFORE converting or counting: a
+          // quote here means the token CONTINUES as a spliced composite
+          // (e.g. 1e'5' -> 1e5) and a non-EOL '\r' may be an interior
+          // token char — both must go to the general machinery with no
+          // eager side effects, or a row the general parser accepts could
+          // be rejected on the truncated token (r4 review repro). A '\r'
+          // directly before '\n' (or EOF) is a plain CRLF ending and
+          // stays on the fast path.
+          char term = p2 < N ? s[p2] : '\n';
+          bool eol = term == '\n' ||
+                     (term == '\r' && (p2 + 1 >= N || s[p2 + 1] == '\n'));
+          if (term != ',' && !eol) {
+            ok_line = false;  // space/tab, quote, or interior CR
+            break;
+          }
+          if (pending_err.empty()) {
+            float v;
+            if (cell_view_to_float(s + t0, p2 - t0, st.attrs[toks_in_row],
+                                   &v, st)) {
+              st.cells.push_back(v);
+              cells_in_row++;
+            } else {
+              pending_err.swap(st.error);
+            }
+          }
+          if (++toks_in_row == d) {
+            // Same first-error semantics as the general path: the tokens
+            // up to here are identical either way, so failing now reports
+            // exactly what a full re-parse would.
+            if (!pending_err.empty()) {
+              st.error = std::move(pending_err);
+              return false;
+            }
+            toks_in_row = 0;
+            cells_in_row = 0;
+          }
+          if (p2 >= N) {
+            line_done = true;  // EOF completes the token like EOL
+            break;
+          }
+          if (eol) {
+            p2 += term == '\r' ? (p2 + 1 < N ? 2 : 1) : 1;
+            line_done = true;
+            break;
+          }
+          p2++;  // consume ','; ",,", ",\n" etc. bail on the next pass
+        }
+        if (ok_line && line_done) {
+          pos = p2;
+          continue;
+        }
+        // Transactional bail: undo everything this attempt did (including
+        // a row it may have completed — the re-parse recreates it).
+        st.cells.resize(save_cells);
+        toks_in_row = save_toks;
+        cells_in_row = save_cir;
+        if (!had_pending) pending_err.clear();
+      }
     }
     // Leading whitespace, then the sparse-row check on the first real char.
     while (pos < N && (s[pos] == ' ' || s[pos] == '\t' || s[pos] == '\r'))
@@ -389,11 +570,39 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
         continue;
       }
       // Token scan: c starts a token (possibly '\r', possibly a quote).
+      // The hot structure is a RUN scan: a 256-entry class table marks the
+      // seven structural bytes (space, tab, comma, newline, CR, both
+      // quotes) and everything else is an "ordinary" token byte consumed
+      // in a tight one-load-per-byte loop — the digits that dominate a
+      // numeric file never touch the structural dispatch below it.
       uint32_t t_off = (uint32_t)pos, t_len = 0;
       int32_t t_owned = -1;
       int32_t t_line = st.line;  // cite the token's opening line
-      while (pos < N && s[pos] != '\n') {
+      auto append_run = [&](size_t off, size_t len) {
+        if (len == 0) return;
+        if (t_owned >= 0) {
+          owned[t_owned].append(s + off, len);
+        } else if (t_len == 0) {
+          t_off = (uint32_t)off;
+          t_len = (uint32_t)len;
+        } else if ((size_t)t_off + t_len == off) {
+          t_len += (uint32_t)len;  // contiguous: extend the view
+        } else {
+          // Discontiguous continuation (the view came from a quoted slice,
+          // e.g. 'ab'cd): promote to an owned splice.
+          owned.emplace_back(s + t_off, t_len);
+          t_owned = (int32_t)owned.size() - 1;
+          owned[t_owned].append(s + off, len);
+          t_len = 0;
+        }
+      };
+      for (;;) {
+        size_t run0 = pos;
+        while (pos < N && !kStructural[(unsigned char)s[pos]]) pos++;
+        append_run(run0, pos - run0);
+        if (pos >= N) break;
         char ch = s[pos];
+        if (ch == '\n' || ch == ' ' || ch == '\t' || ch == ',') break;
         if (ch == '\'' || ch == '"') {
           // The close search runs THROUGH newlines (arff_lexer.cpp:159-188:
           // a quoted value may span physical lines; the content, newlines
@@ -412,41 +621,27 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
           st.line += nl_in_quote;
           if (t_len == 0 && t_owned < 0) {
             // Token starts with a quote: stay a zero-copy view. If more
-            // token characters follow, the discontiguity check in the
-            // append branch promotes it to an owned splice.
+            // token characters follow, append_run's discontiguity check
+            // promotes it to an owned splice.
             t_off = (uint32_t)(pos + 1);
             t_len = (uint32_t)(close - (pos + 1));
-            pos = close + 1;
-            continue;
+          } else {
+            if (t_owned < 0) {
+              owned.emplace_back(s + t_off, t_len);
+              t_owned = (int32_t)owned.size() - 1;
+              t_len = 0;
+            }
+            owned[t_owned].append(s + pos + 1, close - (pos + 1));
           }
-          if (t_owned < 0) {
-            owned.emplace_back(s + t_off, t_len);
-            t_owned = (int32_t)owned.size() - 1;
-            t_len = 0;
-          }
-          owned[t_owned].append(s + pos + 1, close - (pos + 1));
           pos = close + 1;
           continue;
         }
-        if (ch == ' ' || ch == '\t' || ch == ',') break;
-        if (ch == '\r') {
-          size_t q = pos;
-          while (q < N && (s[q] == ' ' || s[q] == '\t' || s[q] == '\r')) q++;
-          if (q >= N || s[q] == '\n') break;  // line-trailing whitespace
-        }
-        if (t_owned >= 0) {
-          owned[t_owned].push_back(ch);
-        } else if (t_len > 0 && (size_t)t_off + t_len != pos) {
-          // Discontiguous continuation (the view came from a quoted slice,
-          // e.g. 'ab'cd): promote to an owned splice.
-          owned.emplace_back(s + t_off, t_len);
-          t_owned = (int32_t)owned.size() - 1;
-          owned[t_owned].push_back(ch);
-          t_len = 0;
-        } else {
-          if (t_len == 0) t_off = (uint32_t)pos;
-          t_len++;
-        }
+        // ch == '\r': line-trailing [ \t\r]* ends the token; an interior
+        // '\r' is an ordinary token character (split_csv semantics).
+        size_t q = pos;
+        while (q < N && (s[q] == ' ' || s[q] == '\t' || s[q] == '\r')) q++;
+        if (q >= N || s[q] == '\n') break;
+        append_run(pos, 1);
         pos++;
       }
       if (t_owned < 0 && t_len == 0) {
@@ -458,22 +653,60 @@ bool parse_data_stream(const std::string& data, size_t pos, ParseState& st) {
         fail(st, "empty value in data row");
         return false;
       }
-      row.push_back({t_off, t_len, t_line, t_owned});
+      if constexpr (EAGER) {
+        if (pending_err.empty()) {
+          const char* tp = t_owned >= 0 ? owned[t_owned].data() : s + t_off;
+          size_t tl = t_owned >= 0 ? owned[t_owned].size() : t_len;
+          float v;
+          int save_line = st.line;
+          st.line = t_line;  // cite the token's own line
+          if (cell_view_to_float(tp, tl, st.attrs[toks_in_row], &v, st)) {
+            st.cells.push_back(v);
+            cells_in_row++;
+          } else {
+            pending_err.swap(st.error);  // defer until the row completes
+          }
+          st.line = save_line;
+        }
+        owned.clear();
+      } else {
+        row.push_back({t_off, t_len, t_line, t_owned});
+      }
       if (pos < N && s[pos] == ',') {
         pos++;
         token_since_comma = false;  // the comma terminated its own token
       } else {
         token_since_comma = true;
       }
-      if (row.size() == d && !convert_row()) return false;
+      if constexpr (EAGER) {
+        if (++toks_in_row == d) {
+          if (!pending_err.empty()) {
+            st.error = std::move(pending_err);
+            return false;
+          }
+          toks_in_row = 0;
+          cells_in_row = 0;
+        }
+      } else {
+        if (row.size() == d && !convert_row()) return false;
+      }
     }
     if (pos < N) pos++;  // consume '\n'
   }
-  // A partial row at EOF is discarded unconverted (arff_parser.cpp:130-133).
+  // A partial row at EOF is discarded unconverted (arff_parser.cpp:130-133);
+  // eager mode truncates the partial row's already-converted cells.
+  if constexpr (EAGER) st.cells.resize(st.cells.size() - cells_in_row);
   return true;
 }
 
-bool parse_buffer(const std::string& data, ParseState& st) {
+bool parse_data_stream(std::string_view data, size_t pos, ParseState& st) {
+  for (const Attr& a : st.attrs)
+    if (a.type_code != TC_NUMERIC)
+      return parse_data_stream_impl<false>(data, pos, st);
+  return parse_data_stream_impl<true>(data, pos, st);
+}
+
+bool parse_buffer(std::string_view data, ParseState& st) {
   size_t pos = 0;
   // Pull the next physical line into *out; false at EOF. No comment
   // skipping — callers decide (none applies inside an open quote).
@@ -628,23 +861,43 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
   ParseState st;
   st.path = path;
 
-  FILE* f = fopen(path, "rb");
-  if (!f) {
-    out->error = dup_string(std::string(path) + ": cannot open file");
-    return 1;
-  }
-  fseek(f, 0, SEEK_END);
-  long size = ftell(f);
-  fseek(f, 0, SEEK_SET);
-  std::string data(size > 0 ? (size_t)size : 0, '\0');
-  if (size > 0 && fread(&data[0], 1, (size_t)size, f) != (size_t)size) {
+  // The parser runs over a read-only view of one uninitialized buffer:
+  // a single fread, no std::string zero-fill. (mmap was tried and measured
+  // SLOWER here — per-call soft page faults across the mapping cost more
+  // than one streaming copy of a page-cached file.)
+  std::unique_ptr<char[]> file_buf;
+  std::string_view data;
+  {
+    FILE* f = fopen(path, "rb");
+    if (!f) {
+      out->error = dup_string(std::string(path) + ": cannot open file");
+      return 1;
+    }
+    fseek(f, 0, SEEK_END);
+    long size = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    if (size > 0) {
+      file_buf.reset(new char[(size_t)size]);
+      if (fread(file_buf.get(), 1, (size_t)size, f) != (size_t)size) {
+        fclose(f);
+        out->error = dup_string(std::string(path) + ": short read");
+        return 1;
+      }
+      data = std::string_view(file_buf.get(), (size_t)size);
+    }
     fclose(f);
-    out->error = dup_string(std::string(path) + ": short read");
+  }
+
+  bool parsed;
+  try {
+    parsed = parse_buffer(data, st);
+  } catch (const std::bad_alloc&) {
+    // Allocation failure must come back through the C ABI's error field —
+    // an exception escaping extern "C" aborts the host interpreter.
+    out->error = dup_string(std::string(path) + ": out of memory while parsing");
     return 1;
   }
-  fclose(f);
-
-  if (!parse_buffer(data, st)) {
+  if (!parsed) {
     out->error = dup_string(st.error);
     return 1;
   }

@@ -61,6 +61,63 @@ class TestNativeParser:
         assert nat.attributes[1].nominal_values == ["red", "dark blue"]
         assert nat.num_instances == 3
 
+    def test_quoted_splice_completes_numeric_token(self, native_arff, tmp_path):
+        # A numeric-looking prefix continued by a quoted slice (1e'5' ->
+        # 1e5) must stay one token and PARSE in an all-numeric file: the
+        # fused eager line scan may not commit a conversion error before
+        # the token's terminator is known (r4 review repro — the truncated
+        # '1e' must never be converted on its own).
+        p = tmp_path / "sp.arff"
+        p.write_text(
+            "@relation r\n@attribute a NUMERIC\n@attribute class NUMERIC\n"
+            "@data\n1,1e'5'\n"
+        )
+        nat = native_arff.parse(str(p))
+        py = pyarff.parse_arff_file(str(p))
+        np.testing.assert_array_equal(nat.features, [[1.0]])
+        np.testing.assert_array_equal(nat.labels, [100000])
+        np.testing.assert_array_equal(nat.features, py.features)
+        np.testing.assert_array_equal(nat.labels, py.labels)
+
+    def test_crlf_numeric_file_parity(self, native_arff, tmp_path):
+        # Plain CRLF endings ride the fused fast path (a '\r' directly
+        # before '\n' is an EOL, not a bail); output must match the Python
+        # parser and the LF rendering of the same file.
+        p = tmp_path / "crlf.arff"
+        body = ("@relation r\r\n@attribute a NUMERIC\r\n"
+                "@attribute class NUMERIC\r\n@data\r\n"
+                "1.5,0\r\n2.25,1\r\n7,2\r\n")
+        p.write_bytes(body.encode())
+        nat = native_arff.parse(str(p))
+        py = pyarff.parse_arff_file(str(p))
+        np.testing.assert_array_equal(
+            nat.features.view(np.uint32), py.features.view(np.uint32))
+        np.testing.assert_array_equal(nat.labels, [0, 1, 2])
+        lf = tmp_path / "lf.arff"
+        lf.write_bytes(body.replace("\r\n", "\n").encode())
+        nat_lf = native_arff.parse(str(lf))
+        np.testing.assert_array_equal(
+            nat.features.view(np.uint32), nat_lf.features.view(np.uint32))
+
+    def test_wide_row_exceeding_sample_window(self, native_arff, tmp_path):
+        # Rows wider than the 64 KB row-estimate sample window (no newline
+        # in the sample): the reservation heuristic must scale by bytes,
+        # not by a row-count guess times d — the latter asked for a
+        # multi-GB reserve on a 2 MB file (r4 review repro).
+        d = 30000
+        p = tmp_path / "wide.arff"
+        with open(p, "w") as f:
+            f.write("@relation w\n")
+            for i in range(d):
+                f.write(f"@attribute a{i} NUMERIC\n")
+            f.write("@attribute class NUMERIC\n@data\n")
+            for r in range(3):
+                f.write(",".join(["1.5"] * d) + f",{r}\n")
+        ds = native_arff.parse(str(p))
+        assert ds.features.shape == (3, d)
+        np.testing.assert_array_equal(ds.labels, [0, 1, 2])
+        assert (ds.features == 1.5).all()
+
     def test_multiline_quoted_values_both_parsers(self, native_arff, tmp_path):
         # arff_lexer.cpp:159-188: a quoted value spans physical lines, the
         # newline is part of the value; an open '{' nominal list continues on
