@@ -628,12 +628,20 @@ def stripe_route_ok(precision: str, d: int, k: int) -> bool:
     """Platform-independent half of THE auto-engine rule: which problems
     belong on the lane-striped kernel. Exact euclidean with narrow features
     (d <= 128 measured on v5e: the stripe exact unroll beats the XLA
-    full-matrix path 1.3x at d=64/100 and 2.25x at d=128; d=256 fails to
-    compile at the default blocks) — and the bf16 matmul form at ANY width
-    (r3: with the train operand stored bf16 it measured 1.7x the merge
-    kernel on the mnist784 shape)."""
+    full-matrix path 1.3x at d=64/100 and 2.25x at d=128; d=256 failed to
+    compile at the r2 blocks), the bf16 matmul form at ANY width (r3: train
+    operand stored bf16, 1.7x the merge kernel on the mnist784 shape), and
+    the f32 "fast" matmul form for WIDE features (r4: with the norms
+    hoisted and the 64 MB vmem budget, stripe fast at (1024, 2048) measured
+    ~1.6x the merge kernel's medians on the same shape, interleaved).
+    Narrow-feature fast stays on the merge/XLA paths — no measurement says
+    stripe wins there."""
     return (
-        (precision == "bf16" or (precision == "exact" and d <= STRIPE_MAX_D))
+        (
+            precision == "bf16"
+            or (precision == "fast" and d > STRIPE_MAX_D)
+            or (precision == "exact" and d <= STRIPE_MAX_D)
+        )
         and k <= STRIPE_MAX_K
     )
 
@@ -782,21 +790,29 @@ def stripe_block_sizes(
         # Wide-feature matmul forms only: the step is bound by the
         # per-query-tile train re-stream, so block_q grows as large as VMEM
         # allows. Narrow-feature bf16/fast keeps the proven narrow defaults
-        # below (same selection cost, no re-stream problem — and the wide
-        # blocks blow scoped VMEM at high k, caught by the r3 parity sweep).
-        block_n = ((max(128, block_n or 1024) + 127) // 128) * 128
+        # below (same selection cost, no re-stream problem).
+        block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
+        # VERY wide features must shrink the train tile, not die in Mosaic:
+        # the double-buffered tile costs 2*block_n*d_pad*store_bytes and
+        # the auto dispatch points outside predict_pallas have no merge
+        # fallback. Cap the tiles at ~16 MB of the 64 MB kernel budget
+        # (e.g. d_pad=8192 f32 fast -> block_n 256).
+        store_cap = 2 if precision == "bf16" else 4
+        tile_cap = (16 << 20) // (2 * max(d_pad, 1) * store_cap) // 128 * 128
+        block_n = max(128, min(block_n, max(tile_cap, 128)))
         if block_q is None:
             # Rough per-row VMEM: d_full (4*block_n) + scratch (8*128k) +
             # query row (4*d_pad); the fixed cost is the double-buffered
             # train tile at its STORE width (bf16 stores half — "fast" keeps
-            # f32 tiles and gets a smaller query block). Budget anchored on
-            # the measured-good mnist shape (bf16, k=5, d_pad=896 ->
-            # (1024, 1024) compiles; Mosaic reuses the d_full slices), with
+            # f32 tiles and gets a smaller query block). The budget assumes
+            # the kernel's raised 64 MB vmem_limit (r4: the norm hoist
+            # removed the in-kernel f32 train-tile materialization, and
+            # (1024, 2048) measured best on the mnist784 bf16 shape), with
             # a haircut at high k where scratch liveness grows.
             store_bytes = 2 if precision == "bf16" else 4
             tiles = 2 * block_n * d_pad * store_bytes
             per_row = 4 * block_n + 8 * 128 * k + 4 * d_pad
-            budget = ((17 if k <= 8 else 14) << 20) - tiles
+            budget = ((34 if k <= 8 else 28) << 20) - tiles
             block_q = max(256, min(1024, budget // per_row // 256 * 256))
     else:
         block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
@@ -1041,9 +1057,11 @@ def predict_pallas(
 
     ``engine``: "stripe" = the lane-striped kernel (elementwise selection;
     supports every precision form), "merge" = the tile-merge kernel,
-    "auto" = stripe for narrow-feature exact problems AND for bf16 problems
+    "auto" = stripe for narrow-feature exact problems, for bf16 problems
     at any width (wide bf16 stores the train operand half-width — measured
-    1.7x the merge kernel on the mnist784 shape), merge otherwise."""
+    1.7x the merge kernel on the mnist784 shape), and for wide-feature
+    "fast" (r4: ~1.6x the merge kernel with hoisted norms), merge
+    otherwise."""
     from knn_tpu.ops.vote import vote
 
     if interpret is None:
@@ -1054,10 +1072,10 @@ def predict_pallas(
     auto_routed = engine == "auto"
     if auto_routed:
         # The shared routing rule (stripe_route_ok, platform check elided —
-        # interpret mode runs the same kernel on CPU): narrow-feature exact
-        # and any-width bf16 go to the stripe kernel. "fast" stays on the
-        # merge kernel — its full [BQ, BN] f32 distance buffer next to f32
-        # train tiles does not fit VMEM at competitive blocks.
+        # interpret mode runs the same kernel on CPU): narrow-feature exact,
+        # any-width bf16, and wide-feature fast go to the stripe kernel
+        # (r4: the hoisted norms + 64 MB vmem budget fit the wide f32
+        # distance buffer at competitive blocks, ~1.6x the merge kernel).
         engine = "stripe" if stripe_route_ok(precision, d_true, k) else "merge"
     if engine not in ("stripe", "merge"):
         raise ValueError(
