@@ -237,6 +237,32 @@ class TestPallasKernel:
         bruteforce = ((test_x[:, None, :] - train_x[None, :, :]) ** 2).sum(-1)
         np.testing.assert_allclose(d, np.sort(bruteforce, axis=1)[:, :k], rtol=1e-5)
 
+    @pytest.mark.parametrize("k", [1, 3, 7])
+    def test_selection_formulations_identical(self, rng, k):
+        # Both selection formulations (merge network / min-extraction
+        # rounds) must be bit-identical on a tie-heavy problem — the
+        # routing knob picks per (g, k) cost, so whichever is off-route
+        # would otherwise rot silently.
+        from knn_tpu.ops.pallas_knn import (
+            knn_pallas_stripe_candidates, stripe_prepare_queries,
+            stripe_prepare_train,
+        )
+        import jax.numpy as jnp
+
+        train_x = rng.integers(0, 3, (300, 5)).astype(np.float32)
+        test_x = rng.integers(0, 3, (40, 5)).astype(np.float32)
+        txT, d_pad = stripe_prepare_train(train_x, 128)
+        qx = jnp.asarray(stripe_prepare_queries(test_x, 8, d_pad))
+        outs = {}
+        for sel in ("rounds", "net"):
+            d, i = knn_pallas_stripe_candidates(
+                jnp.asarray(txT), qx, 300, k, block_q=8, block_n=128,
+                d_true=5, interpret=True, select=sel,
+            )
+            outs[sel] = (np.asarray(d), np.asarray(i))
+        np.testing.assert_array_equal(outs["rounds"][0], outs["net"][0])
+        np.testing.assert_array_equal(outs["rounds"][1], outs["net"][1])
+
     def test_auto_route_rule(self):
         # THE routing rule, pinned per (precision, d): narrow exact and
         # any-width bf16 since r3; wide "fast" added r4 (hoisted norms +
