@@ -7,6 +7,11 @@ would give, (b) the current round structure, (c) a cheaper-retirement round
 structure, across block-size configs, so the winning variant can be promoted
 into ops/pallas_knn.py with evidence.
 
+SUPERSEDED (r4): the round-based selection this probe tunes was replaced as
+the default by the truncated odd-even merge network (ops/topk_net.py,
+measured 1.39x on the headline shape interleaved — scripts/probe_select_r4.py);
+the rounds remain reachable at k <= 2 and via select="rounds".
+
 HISTORICAL RECORD (r2): the "lite" variant won (~16% off the step at
 bq=864/bn=2048) and ships in ops/pallas_knn.py gated on finite inputs
 (stripe_inputs_finite — NaN/overflow inputs need full index retirement; see
