@@ -70,6 +70,15 @@ class Dataset:
 
     _ARRAY_FIELDS = frozenset({"features", "labels", "raw_targets"})
 
+    @staticmethod
+    def _frozen_view(value):
+        """Read-only view of an ndarray (the caller's own flags are left
+        alone); non-arrays and already-frozen arrays pass through."""
+        if isinstance(value, np.ndarray) and value.flags.writeable:
+            value = value.view()
+            value.flags.writeable = False
+        return value
+
     def __setattr__(self, name, value):
         if name in self._ARRAY_FIELDS:
             if self.__dict__.get("_init_done"):
@@ -81,9 +90,7 @@ class Dataset:
                 value = self._coerce(name, value)
                 self._check_shape(name, value)
                 self.device_cache.clear()
-            if isinstance(value, np.ndarray) and value.flags.writeable:
-                value = value.view()  # leave the caller's own flags alone
-                value.flags.writeable = False
+            value = self._frozen_view(value)
         object.__setattr__(self, name, value)
 
     @staticmethod
@@ -123,6 +130,24 @@ class Dataset:
             # whose layouts may describe DIFFERENT arrays: start fresh.
             self.device_cache = {}
         object.__setattr__(self, "_init_done", True)
+
+    def __getstate__(self):
+        # Pickle carries the DATA, never the device cache: cached layouts
+        # are padded/transposed duplicates (~9x bloat on a narrow train
+        # set), and unpickled "device" arrays would silently live on
+        # whatever backend the loading process has, re-uploading per call.
+        state = dict(self.__dict__)
+        state["device_cache"] = {}
+        return state
+
+    def __setstate__(self, state):
+        state = dict(state)
+        state["device_cache"] = {}
+        for name in self._ARRAY_FIELDS:
+            # numpy pickling does not preserve writeable=False: re-freeze
+            # so the staleness contract survives a round trip.
+            state[name] = self._frozen_view(state.get(name))
+        self.__dict__.update(state)
 
     @property
     def targets(self) -> np.ndarray:

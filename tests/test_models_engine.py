@@ -147,6 +147,27 @@ class TestDeviceCache:
         with pytest.raises(ValueError, match="read-only"):
             train.labels[0] = 1
 
+    def test_pickle_round_trip_drops_cache_and_stays_frozen(self, rng):
+        # Model persistence contract: pickling a fitted model carries the
+        # data but NOT the device cache (padded/transposed duplicates that
+        # would re-home on whatever backend loads them); unpickled arrays
+        # stay read-only so the staleness contract survives the trip; and
+        # predictions are identical after reload.
+        import pickle
+
+        train_x, train_y, test_x, c = _tie_problem(rng)
+        train = Dataset(train_x.copy(), train_y)
+        test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+        m = KNNClassifier(k=3, engine="stripe").fit(train)
+        _, idx1 = m.kneighbors(test)
+        assert m.train_.device_cache  # populated by the retrieval
+        m2 = pickle.loads(pickle.dumps(m))
+        assert m2.train_.device_cache == {}
+        with pytest.raises(ValueError, match="read-only"):
+            m2.train_.features[:] = 0
+        _, idx2 = m2.kneighbors(test)
+        np.testing.assert_array_equal(idx1, idx2)
+
     def test_dataclasses_replace_gets_fresh_cache(self, rng):
         # dataclasses.replace passes the ORIGINAL instance's device_cache
         # dict to the new instance; its layouts describe the old arrays, so
