@@ -497,7 +497,9 @@ def bench_ingest():
     try:
         from knn_tpu.native import arff_native
 
-        t_native, rows, tr = timeit(lambda: arff_native.parse(train_path))
+        # reps=9: the 1-core host is contended right after heavy phases and
+        # a 6 ms parse min needs more draws than device-slope configs do.
+        t_native, rows, tr = timeit(lambda: arff_native.parse(train_path), reps=9)
         results["native_mb_per_s"] = round(size_mb / t_native, 1)
         results["native_rows_per_s"] = round(rows / t_native)
         results["native_ms_trials"] = [round(t * 1e3, 1) for t in tr]
