@@ -348,7 +348,11 @@ def _knn_stripe_kernel(
             preferred_element_type=jnp.float32,
         )
         d_full = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
-        d_full = jnp.where(jnp.isnan(d_full), jnp.inf, d_full)
+        if not lite_retire:
+            # NaN policy (missing values -> +inf distance). When the host
+            # guaranteed finite inputs (assume_finite), finite operands
+            # cannot produce NaN here — the check is provably dead, skip it.
+            d_full = jnp.where(jnp.isnan(d_full), jnp.inf, d_full)
         chunk_d = [d_full[:, c * lanes : (c + 1) * lanes] for c in range(g)]
     else:
         # Exact subtraction-form distance, accumulated over feature planes in
@@ -364,7 +368,12 @@ def _knn_stripe_kernel(
             for f in range(d_true):
                 diff = q[:, f : f + 1] - tT_ref[f, c * lanes : (c + 1) * lanes].reshape(1, lanes)
                 dc = dc + diff * diff
-            chunk_d.append(jnp.where(jnp.isnan(dc), jnp.inf, dc))
+            # NaN policy gated like the matmul form above: finite inputs
+            # (assume_finite) cannot produce NaN, so the per-chunk check is
+            # provably dead under the host guarantee.
+            chunk_d.append(
+                dc if lite_retire else jnp.where(jnp.isnan(dc), jnp.inf, dc)
+            )
 
     # Selection planes: the g tile chunks plus the k running candidate levels.
     # Index planes stay [BQ, 128] (a [BQ, BN] iota next to the broadcast
@@ -385,9 +394,11 @@ def _knn_stripe_kernel(
     # (distance, index) tie rule — first-seen-wins, main.cpp:47):
     #
     # 1. Truncated odd-even merge network (ops/topk_net.py): a tournament
-    #    of Batcher merges over (d, i) compare-exchanges. No retirement, no
-    #    finiteness gating; wins for k >= ~3 (r4 — recovered the xl k=10
-    #    regression and cut the headline selection cost ~25%).
+    #    of Batcher merges over (d, i) compare-exchanges. No retirement, and
+    #    no finiteness gating of its own (assume_finite still gates the
+    #    upstream NaN->inf distance policy for both formulations); wins for
+    #    k >= ~3 (r4 — recovered the xl k=10 regression and cut the
+    #    headline selection cost ~25%).
     # 2. k rounds of min-extraction across planes with retirement — cheaper
     #    only at k <= 2 where two thin passes beat fused comparators.
     from knn_tpu.ops import topk_net
@@ -491,13 +502,16 @@ def knn_pallas_stripe_candidates(
     matrix ``[D_pad, N_pad]`` (N padded to ``block_n``, D padded to a sublane
     multiple); ``test_x`` is ``[Q_pad, D_pad]``. Returns ``([Q,k] dists,
     [Q,k] int32 global indices)`` sorted ascending by (distance, index).
-    ``assume_finite`` — set ONLY when :func:`stripe_inputs_finite` holds for
-    the unpadded inputs — selects the cheaper index-retirement-free selection
-    rounds (see the exactness argument in _knn_stripe_kernel) when the
-    round-based formulation is in play. ``select`` overrides the trace-time
-    selection routing ("net" = merge network, "rounds" = min-extraction
-    rounds, None = route by op-count estimate) — a tuning/probe knob; both
-    formulations are exact."""
+    ``assume_finite`` — set ONLY when :func:`stripe_inputs_finite` holds
+    for the unpadded inputs — drops work that finite inputs make provably
+    dead: the NaN->+inf distance policy in BOTH distance forms (finite
+    operands cannot produce NaN), and the index-retirement writes when the
+    round-based selection is in play (see the exactness argument in
+    _knn_stripe_kernel). Setting it on inputs that violate the gate feeds
+    NaN keys straight into the selection. ``select`` overrides the
+    trace-time selection routing ("net" = merge network, "rounds" =
+    min-extraction rounds, None = route by op-count estimate) — a
+    tuning/probe knob; both formulations are exact."""
     d_pad, n_pad = train_xT.shape
     q_pad = test_x.shape[0]
     assert n_pad % block_n == 0 and q_pad % block_q == 0 and block_n % 128 == 0
@@ -601,7 +615,9 @@ def stripe_inputs_finite(*arrays: np.ndarray) -> bool:
     """Host-side gate for the kernel's ``assume_finite`` fast path: True when
     every array is NaN/inf-free AND small enough in magnitude that no squared
     euclidean distance can overflow f32 to +inf. Under that guarantee every
-    valid element's distance is finite, so the selection rounds may skip
+    valid element's distance is finite, so the kernel may skip the
+    NaN->+inf distance policy entirely (both distance forms, r4) and the
+    selection rounds may skip
     index retirement (see _knn_stripe_kernel). The scan is a few hundred
     microseconds on the headline config — noise next to one kernel step."""
     limit = None
