@@ -3,14 +3,17 @@
 The default run classifies large-test.arff (1,718 queries) against
 large-train.arff (30,803 rows, 11 features) at k=5 on the available
 accelerator, then also runs the secondary configs (mnist / xl / xxl /
-ingest / sharded / kneighbors / sweepk) and prints ONE JSON line — the
-headline record with every secondary config embedded under ``"configs"``
-so each round's BENCH_r*.json proves all claims (VERDICT r1 #7):
+ingest / sharded / kneighbors / sweepk). Two JSON lines go to stdout:
+first the FULL record (headline + every config with per-trial lists,
+also written to build/bench_full.json), then a compact summary as the
+FINAL line — headline value plus per-config medians, kept under
+``SUMMARY_BUDGET`` bytes so the driver's ~2 KB tail capture always parses
+it (VERDICT r4 #1; r4's single full-record line overflowed the capture
+and the round artifact lost its headline):
 
   {"metric": "large_k5_query_throughput", "value": N, "unit": "queries/sec",
-   "vs_baseline": N, ..., "configs": {"mnist784": {...}, "xl": {...},
-   "xxl": {...}, "ingest": {...}, "sharded": {...}, "kneighbors": {...},
-   "sweepk": {...}}}
+   "vs_baseline": N, "accuracy": A, "step_ms_median": M,
+   "configs": {"mnist784": {...medians...}, "xl": {...}, ...}}
 
 Diagnostics go to stderr. ``--config
 mnist|xl|xxl|ingest|sharded|kneighbors|sweepk|headline`` runs a single
@@ -649,9 +652,9 @@ def bench_kneighbors():
         big.shape, dtype=np.float32)
     big_ds = Dataset(big, np.zeros(len(big), np.int32))
     model = KNNClassifier(k=K, engine="auto").fit(train)
-    # Warm with the full set: the timed calls dispatch 64k-row chunks (the
-    # ragged last one padded to the same shape), so only a full-size call
-    # compiles the executable the trials actually run.
+    # Warm with the full set so the executable the trials run is compiled
+    # (110k queries fit one chunk at the 128k default cap; the 660k sweep
+    # below exercises the chunked path).
     model.kneighbors(big_ds)
     big_trials = []
     for _ in range(3):
@@ -662,6 +665,58 @@ def bench_kneighbors():
     big_qps = big_q / min(big_trials)
     log(f"kneighbors[auto] {big_q:,} queries: {min(big_trials)*1e3:.0f} ms "
         f"({big_qps:,.0f} q/s wall)")
+
+    # 6x larger sweep, where the fixed ~100 ms tunnel sync amortizes, plus
+    # the wall decomposition the number depends on: after any executable
+    # has run, the axon tunnel moves large host->device payloads at a
+    # phase-dependent 20 MB/s-1.5 GB/s (r5 probe) — the query upload, not
+    # the kernel, is the large-Q ceiling on bad days. upload_ms measures a
+    # bare same-payload transfer in this session so the artifact separates
+    # tunnel bandwidth from compute.
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    huge = np.tile(test.features, (384, 1))
+    huge += 1e-4 * np.random.default_rng(2).standard_normal(
+        huge.shape, dtype=np.float32)
+    huge_ds = Dataset(huge, np.zeros(len(huge), np.int32))
+    model.kneighbors(huge_ds)  # warm
+    huge_trials = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        model.kneighbors(huge_ds)
+        huge_trials.append(time.monotonic() - t0)
+    huge_q = huge.shape[0]
+    huge_qps = huge_q / min(huge_trials)
+    up_probe = _jnp.asarray(huge)
+    _jax.block_until_ready(up_probe)  # first transfer warms the shape path
+    huge_shifted = huge + np.float32(1.0)  # distinct content, built off-clock
+    t0 = time.monotonic()
+    up_probe2 = _jnp.asarray(huge_shifted)
+    _jax.block_until_ready(up_probe2)
+    upload_ms = (time.monotonic() - t0) * 1e3
+    del up_probe, up_probe2, huge_shifted
+    upload_mb = huge.nbytes / 1e6
+    log(f"kneighbors[auto] {huge_q:,} queries: {min(huge_trials)*1e3:.0f} ms "
+        f"({huge_qps:,.0f} q/s wall; bare {upload_mb:.0f} MB upload "
+        f"{upload_ms:.0f} ms this session)")
+
+    # Amortized interactive latency (VERDICT r4 #6): M default-shape calls
+    # through the async surface, resolved together, pay ~one ~100 ms tunnel
+    # sync instead of M. The sync==async equality is pinned in
+    # tests/test_async_api.py; here we measure the per-call wall cost.
+    model_async = KNNClassifier(k=K, engine="auto").fit(train)
+    model_async.kneighbors(test)  # warm compile + device cache
+    m_calls = 10
+    pipelined_trials = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        handles = [model_async.kneighbors_async(test) for _ in range(m_calls)]
+        for h in handles:
+            h.result()
+        pipelined_trials.append((time.monotonic() - t0) / m_calls)
+    log(f"kneighbors_async x{m_calls}: {_median(pipelined_trials)*1e3:.1f} "
+        f"ms/call median (vs {min(results['auto'])*1e3:.1f} sync)")
     return {
         "metric": "large_k5_kneighbors_wall_throughput",
         "value": round(q / min(results["auto"]), 1),
@@ -674,6 +729,14 @@ def bench_kneighbors():
         "large_q": big_q,
         "large_q_qps": round(big_qps, 1),
         "large_q_ms_trials": [round(t * 1e3, 1) for t in big_trials],
+        "huge_q": huge_q,
+        "huge_q_qps": round(huge_qps, 1),
+        "huge_q_ms_trials": [round(t * 1e3, 1) for t in huge_trials],
+        "upload_mb": round(upload_mb, 1),
+        "upload_ms": round(upload_ms, 1),
+        "pipelined_ms_per_call": round(_median(pipelined_trials) * 1e3, 2),
+        "pipelined_ms_trials": [round(t * 1e3, 2) for t in pipelined_trials],
+        "pipelined_calls": m_calls,
     }
 
 
@@ -879,9 +942,55 @@ _SECONDARY_CONFIGS = {
     "sweepk": bench_sweepk,
 }
 
+# Per-config whitelist of summary fields beyond the universal ones. The
+# FINAL stdout line must stay under the driver's ~2 KB tail capture or the
+# round artifact loses its machine-readable record entirely (r4: the
+# per-trial lists pushed the single JSON line past the capture window and
+# BENCH_r04.json came back with parsed=null and the headline cut off).
+# tests/test_bench_summary.py pins the compact line below SUMMARY_BUDGET.
+SUMMARY_BUDGET = 1500
+_SUMMARY_UNIVERSAL = (
+    "metric", "value", "unit", "vs_baseline", "accuracy", "step_ms_median",
+)
+_SUMMARY_EXTRA = {
+    "mnist784": ("tflops", "bf16_qps", "bf16_tflops", "bf16_step_ms_median",
+                 "bf16_recall_at_k", "bf16_matmul_tflops", "bf16_matmul_ms"),
+    "xl": ("dist_evals_per_sec", "approx_recall_at_k", "approx_wins"),
+    "xxl": ("dist_evals_per_sec", "paths_agree"),
+    "ingest": ("native_mb_per_s", "native_xl_mb_per_s"),
+    "sharded": (),
+    "kneighbors": ("auto_ms_per_call", "large_q_qps", "huge_q_qps",
+                   "upload_ms", "pipelined_ms_per_call"),
+    "sweepk": ("prefix_equivalence",),
+}
+
+
+def compact_summary(record):
+    """The machine-parseable round summary: the headline record's universal
+    fields plus each config reduced to its whitelisted medians. Everything
+    else (trial lists, tuning notes) lives in the full record, which is
+    printed on an earlier line and written to build/bench_full.json."""
+    out = {k: record[k] for k in _SUMMARY_UNIVERSAL if k in record}
+    configs = {}
+    for name, cfg in record.get("configs", {}).items():
+        if "error" in cfg:
+            configs[name] = {"error": cfg["error"][:120]}
+            continue
+        keep = _SUMMARY_UNIVERSAL + _SUMMARY_EXTRA.get(name, ())
+        configs[name] = {
+            k: cfg[k] for k in keep if k in cfg and cfg[k] is not None
+        }
+        # The config name implies both; the full record keeps them.
+        configs[name].pop("unit", None)
+        configs[name].pop("metric", None)
+    out["configs"] = configs
+    return out
+
 
 def main():
-    """Default run: headline + every secondary config, ONE JSON line."""
+    """Default run: headline + every secondary config. The full record (with
+    per-trial lists) goes to stdout first and to build/bench_full.json; the
+    FINAL line is the compact summary the driver's tail capture parses."""
     record = bench_headline()
     configs = {}
     for name, fn in _SECONDARY_CONFIGS.items():
@@ -891,7 +1000,19 @@ def main():
             log(f"config {name} FAILED: {type(e).__name__}: {e}")
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
     record["configs"] = configs
-    print(json.dumps(record))
+    full = json.dumps(record)
+    out = Path(__file__).parent / "build" / "bench_full.json"
+    try:
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(full + "\n")
+    except OSError as e:
+        log(f"could not write {out}: {e}")
+    print(full)
+    summary = json.dumps(compact_summary(record))
+    if len(summary) > SUMMARY_BUDGET:
+        log(f"WARNING: summary line {len(summary)} B exceeds the "
+            f"{SUMMARY_BUDGET} B budget — trim _SUMMARY_EXTRA")
+    print(summary)
 
 
 if __name__ == "__main__":

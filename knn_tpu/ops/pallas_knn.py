@@ -598,6 +598,54 @@ def knn_pallas_stripe_candidates(
     return _merge_topk_rounds(cand_d, cand_i, k)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "rows", "d_pad", "block_q", "block_n", "interpret", "d_true",
+        "precision", "assume_finite",
+    ),
+)
+def _stripe_candidates_sliced(
+    train_xT: jnp.ndarray,
+    q_full: jnp.ndarray,
+    start: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    k: int,
+    rows: int,
+    d_pad: int,
+    block_q: int,
+    block_n: int,
+    interpret: bool,
+    d_true: Optional[int],
+    precision: str,
+    assume_finite: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One chunk of :func:`knn_pallas_stripe_candidates` sliced ON DEVICE
+    from the resident UNPADDED query array ``[Q, d_true]``. The chunked
+    host entry uploads the raw query bytes once per super-chunk and
+    dispatches per-chunk with a traced ``start`` offset — one executable,
+    and the host->device traffic is exactly the query payload (44 B/query
+    at d=11 instead of 64 padded). That matters doubly on the tunneled
+    device: transfers interleaved between kernel dispatches stall the
+    stream, and once ANY executable has run, large uploads drop to
+    ~20-60 MB/s (r5 probe: the same 42 MB that lands in ~25 ms before the
+    first kernel takes 2-7 s after — an axon-layer behavior, not load
+    variance, reproduced with a plain XLA matmul). The feature pad to the
+    kernel's sublane multiple happens here, device-side, where the copy
+    rides HBM bandwidth instead of the tunnel."""
+    qb = jax.lax.dynamic_slice(
+        q_full, (start.astype(jnp.int32), jnp.int32(0)),
+        (rows, q_full.shape[1]),
+    )
+    if d_pad > q_full.shape[1]:
+        qb = jnp.pad(qb, ((0, 0), (0, d_pad - q_full.shape[1])))
+    return knn_pallas_stripe_candidates(
+        train_xT, qb, n_valid, k,
+        block_q=block_q, block_n=block_n, interpret=interpret,
+        d_true=d_true, precision=precision, assume_finite=assume_finite,
+    )
+
+
 def _resolve_stripe_precision(precision: str, d: int) -> str:
     """One contract for the stripe host entries (ADVICE r1): ``auto``
     resolves the same way backends/pallas.py does — exact for narrow
@@ -640,6 +688,21 @@ def stripe_inputs_finite(*arrays: np.ndarray) -> bool:
     return True
 
 
+def _wide_tile_fits(precision: str, d_pad: int, k: int) -> bool:
+    """Whether the wide-feature matmul stripe route can compile at ALL: even
+    the FLOOR train tile (block_n=128) must leave the minimum query block
+    (256 rows) inside the kernel's 64 MB VMEM budget once double-buffered.
+    Past that, Mosaic hard-fails — and the no-fallback dispatch points
+    (kneighbors, the distributed paths) have no merge path to rescue an
+    auto route (ADVICE r4). Mirrors stripe_block_sizes' cost model:
+    2 * block_n * d_pad train tiles at their store width, plus per-query-row
+    distance buffer + candidate scratch + query row."""
+    store_bytes = 2 if precision == "bf16" else 4
+    tiles = 2 * 128 * d_pad * store_bytes
+    per_row = 4 * 128 + 8 * 128 * k + 4 * d_pad
+    return tiles + 256 * per_row <= (48 << 20)
+
+
 def stripe_route_ok(precision: str, d: int, k: int) -> bool:
     """Platform-independent half of THE auto-engine rule: which problems
     belong on the lane-striped kernel. Exact euclidean with narrow features
@@ -651,7 +714,13 @@ def stripe_route_ok(precision: str, d: int, k: int) -> bool:
     hoisted and the 64 MB vmem budget, stripe fast at (1024, 2048) measured
     ~1.6x the merge kernel's medians on the same shape, interleaved).
     Narrow-feature fast stays on the merge/XLA paths — no measurement says
-    stripe wins there."""
+    stripe wins there. EXTREME widths (f32 fast d_pad ≳ 24k, bf16 ≳ 33k)
+    decline the route entirely: no block shape fits the kernel budget, so
+    auto dispatch must stay on the merge/XLA formulations."""
+    if precision in ("fast", "bf16") and d > STRIPE_MAX_D and not _wide_tile_fits(
+        precision, ((d + 7) // 8) * 8, k
+    ):
+        return False
     return (
         (
             precision == "bf16"
@@ -833,8 +902,13 @@ def stripe_block_sizes(
     else:
         block_n = ((max(128, block_n or 2048) + 127) // 128) * 128
         if block_q is None:
-            # scratch bytes ~= block_q * 128k * 8; keep under ~3.5 MB.
-            block_q = min(448, max(8, (3_500_000 // (128 * k * 8)) // 8 * 8))
+            # Candidate scratch (d+i) ~= block_q * 128k * 16 B; budget
+            # ~10.5 MB of the kernel's 64 MB vmem limit. Swept on v5e r5
+            # (110k-query retrieval, d=11 exact): k=5 best at 1024 (463 ->
+            # 534k q/s wall vs the old 448 cap), k=10 flat 224-432 then
+            # worse at 864, k=16 best near 264 — the budget lands 1024 /
+            # 512 / 320 respectively.
+            block_q = min(1024, max(8, (10_500_000 // (128 * k * 16)) // 8 * 8))
     block_q = min(block_q, ((q + 7) // 8) * 8)
     return block_q, block_n
 
@@ -891,7 +965,8 @@ def stripe_candidates_arrays(
     precision: str = "exact",
     cache: Optional[dict] = None,
     chunk_rows: Optional[int] = None,
-) -> Tuple[np.ndarray, np.ndarray]:
+    deferred: bool = False,
+):
     """Host entry for the lane-striped kernel: handles padding and the [D, N]
     train transposition, returns unpadded ``([Q,k] dists, [Q,k] indices)``.
     ``interpret`` defaults to on for non-TPU platforms so the same path is
@@ -908,13 +983,21 @@ def stripe_candidates_arrays(
     serial round trips — 27 s of wall for ~60 ms of device compute), so
     the wall-latency win comes from FEW fetches with the copies overlapped,
     not from many small overlapping dispatches. ``chunk_rows`` overrides
-    the per-chunk row cap (tests/tuning)."""
+    the per-chunk row cap (tests/tuning).
+
+    ``deferred`` returns a zero-arg ``resolve()`` closure instead of the
+    arrays: every chunk is dispatched (async copies started) before this
+    function returns, and the host-sync cost is paid when the caller
+    resolves — the primitive under the model layer's ``kneighbors_async``
+    (VERDICT r4 #6: M deferred calls resolved together pay ~one ~100 ms
+    tunnel round trip instead of M)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n, d_true = train_x.shape
     q = test_x.shape[0]
     if q == 0:
-        return np.empty((0, k), np.float32), np.empty((0, k), np.int32)
+        empty = (np.empty((0, k), np.float32), np.empty((0, k), np.int32))
+        return (lambda: empty) if deferred else empty
     precision = _resolve_stripe_precision(precision, d_true)
     block_q, block_n = stripe_block_sizes(
         block_q, block_n, q, k, d_pad=((d_true + 7) // 8) * 8,
@@ -924,32 +1007,78 @@ def stripe_candidates_arrays(
         train_x, block_n, cache, precision
     )
     assume_finite = train_finite and stripe_inputs_finite(test_x)
-    rows = max(block_q, (chunk_rows or 65536) // block_q * block_q)
+    # Chunk cap scaled down with k (ADVICE r4): each dispatch materializes a
+    # [rows, 128k] f32+i32 candidate buffer on device before the fused merge
+    # (~670 MB at the 128k-row/k=5 default; transient — executions are
+    # serial, so ~2 are ever live). 128k rows measured best at k=5 on v5e
+    # (r5: 863 ms vs 932 at 256k-row chunks for a 660k-query sweep);
+    # shrinking inversely with k keeps the transient bounded beyond k=8.
+    cap = max(8192, (131072 * 8 // max(k, 8)) // 1024 * 1024)
+    rows = max(block_q, (chunk_rows or cap) // block_q * block_q)
+    nv = jnp.asarray(n, jnp.int32)
 
-    def dispatch(s0):
-        chunk = test_x[s0 : s0 + rows]
-        qx = stripe_prepare_queries(chunk, block_q, d_pad)
-        if q > rows and qx.shape[0] < rows:
-            # Pad the ragged last chunk up to the shared chunk shape: one
-            # compiled executable for the whole sweep beats saving a few
-            # padded-row dispatches (a second compile is seconds).
-            qx = np.pad(qx, ((0, rows - qx.shape[0]), (0, 0)))
-        return knn_pallas_stripe_candidates(
-            txTj, jnp.asarray(qx), n, k,
-            block_q=block_q, block_n=block_n, interpret=interpret,
-            d_true=d_true, precision=precision, assume_finite=assume_finite,
+    # The query payload is uploaded ONCE per super-chunk, UNPADDED, then
+    # row-padded ON DEVICE to a chunk multiple and sliced+feature-padded
+    # per chunk (_stripe_candidates_sliced — see there for the tunnel
+    # pathologies this sidesteps). The device-side row pad quantizes the
+    # Pallas executable's input shape to the chunk grid, so varying query
+    # counts share one kernel compile per chunk-count (the pad itself is a
+    # cheap per-shape XLA op); pad rows compute garbage the fetch trims.
+    # SUPER-chunks bound device residency for query sets past ~1 GB of
+    # features — each super pays one upload.
+    super_rows = max(rows, (1 << 28) // (d_pad * 4) // rows * rows)
+
+    def run_super(qs0):
+        qsub = test_x[qs0 : qs0 + super_rows]
+        sq = qsub.shape[0]
+        chunk = min(rows, -(-sq // block_q) * block_q)
+        buf_rows = -(-sq // chunk) * chunk
+        qj = jnp.asarray(np.ascontiguousarray(qsub, np.float32))
+        if buf_rows > sq:
+            qj = jnp.pad(qj, ((0, buf_rows - sq), (0, 0)))
+
+        def dispatch(s0):
+            return _stripe_candidates_sliced(
+                txTj, qj, jnp.asarray(s0, jnp.int32), nv, k=k, rows=chunk,
+                d_pad=d_pad, block_q=block_q, block_n=block_n,
+                interpret=interpret, d_true=d_true, precision=precision,
+                assume_finite=assume_finite,
+            )
+
+        def fetch(out, s0):
+            d_h, i_h = jax.device_get(out)
+            return d_h[: min(chunk, sq - s0)], i_h[: min(chunk, sq - s0)]
+
+        from knn_tpu.utils.windowed import windowed_dispatch_deferred
+
+        return windowed_dispatch_deferred(
+            range(0, buf_rows, chunk), dispatch, fetch, window=16,
         )
 
-    def fetch(out, s0):
-        d_h, i_h = jax.device_get(out)
-        sz = min(rows, q - s0)
-        return d_h[:sz], i_h[:sz]
+    # First super dispatches now (so a deferred caller's device work is in
+    # flight when this returns); later supers launch lazily at resolve time,
+    # each after the previous drains, keeping one super's buffers resident.
+    first = run_super(0)
 
-    parts = windowed_dispatch(range(0, q, rows), dispatch, fetch)
-    return (
-        np.concatenate([p[0] for p in parts]),
-        np.concatenate([p[1] for p in parts]),
-    )
+    memo = []
+
+    def resolve():
+        if not memo:
+            # Copy before extending: the drain closure memoizes and returns
+            # its own results list, so appending in place would corrupt a
+            # repeated resolve() on multi-super query sets — and the later
+            # supers must not re-dispatch either, hence the whole-result
+            # memo.
+            parts = list(first())
+            for qs0 in range(super_rows, q, super_rows):
+                parts += run_super(qs0)()
+            memo.append((
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+            ))
+        return memo[0]
+
+    return resolve if deferred else resolve()
 
 
 @functools.partial(

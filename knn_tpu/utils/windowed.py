@@ -22,19 +22,20 @@ from __future__ import annotations
 from typing import Callable, Iterable, List
 
 
-def windowed_dispatch(
+def windowed_dispatch_deferred(
     items: Iterable,
     dispatch: Callable,
     fetch: Callable,
     window: int = 4,
-) -> List:
-    """``[fetch(dispatch(item), item) for item in items]`` with a bounded
-    number of dispatched results in flight (``window + 1``, matching the
-    original inline loops: draining starts once the window is exceeded)
-    and async host copies started at dispatch time. ``dispatch(item)``
-    returns a device array or tuple/list of device arrays; ``fetch(out,
-    item)`` converts one result to its host form (and is where padding is
-    trimmed)."""
+) -> Callable[[], List]:
+    """Dispatch every item NOW (async host copies started immediately) and
+    return a ``resolve()`` callable that drains the remaining fetches and
+    returns the result list. Items beyond ``window`` still drain eagerly
+    during dispatch, so in-flight residency keeps the same bound as the
+    synchronous path; the deferral buys overlap for the common small-call
+    case (one or two chunks) and for cross-call pipelining — M deferred
+    calls resolved together pay ~one device->host round trip instead of M
+    (the ~100 ms tunnel sync floor, VERDICT r4 #6)."""
     import jax
 
     pending: list = []
@@ -52,6 +53,26 @@ def windowed_dispatch(
         pending.append((out, item))
         if len(pending) > window:
             drain_one()
-    while pending:
-        drain_one()
-    return results
+
+    def resolve():
+        while pending:
+            drain_one()
+        return results
+
+    return resolve
+
+
+def windowed_dispatch(
+    items: Iterable,
+    dispatch: Callable,
+    fetch: Callable,
+    window: int = 4,
+) -> List:
+    """``[fetch(dispatch(item), item) for item in items]`` with a bounded
+    number of dispatched results in flight (``window + 1``, matching the
+    original inline loops: draining starts once the window is exceeded)
+    and async host copies started at dispatch time. ``dispatch(item)``
+    returns a device array or tuple/list of device arrays; ``fetch(out,
+    item)`` converts one result to its host form (and is where padding is
+    trimmed)."""
+    return windowed_dispatch_deferred(items, dispatch, fetch, window)()

@@ -1,0 +1,139 @@
+"""r5 probe: where does the large-Q kneighbors wall time go? (VERDICT r4 #4)
+
+Decomposes the 110k-query retrieval into host prepare / upload / compute /
+fetch, and compares chunking strategies:
+  A. current path (64k chunks, per-chunk device_get in drain order)
+  B. batched resolve (one jax.device_get over every pending chunk)
+  C. single monolithic chunk (no ragged padding, one fetch)
+Run ON the TPU. One-off measurement probe, not part of the test suite.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from knn_tpu.data.arff import load_arff
+from knn_tpu.ops.pallas_knn import (
+    knn_pallas_stripe_candidates, stripe_block_sizes, stripe_candidates_arrays,
+    stripe_prepare_queries, stripe_prepare_train,
+)
+
+REF = Path("/root/reference/datasets")
+
+
+def t(label, fn, reps=3):
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.monotonic()
+        out = fn()
+        best = min(best, time.monotonic() - t0)
+    print(f"{label:48s} {best*1e3:8.1f} ms", flush=True)
+    return out, best
+
+
+def main():
+    train = load_arff(str(REF / "large-train.arff"))
+    test = load_arff(str(REF / "large-test.arff"))
+    big = np.tile(test.features, (64, 1))
+    big += 1e-4 * np.random.default_rng(1).standard_normal(
+        big.shape, dtype=np.float32)
+    q = big.shape[0]
+    k = 5
+    n, d_true = train.features.shape
+    block_q, block_n = stripe_block_sizes(None, None, q, k, d_pad=16)
+    print(f"Q={q}, blocks=({block_q},{block_n})")
+
+    txT_h, d_pad = stripe_prepare_train(train.features, block_n)
+    txj = jnp.asarray(txT_h)
+    jax.block_until_ready(txj)
+
+    rows = 65536 // block_q * block_q
+    chunks = [big[s : s + rows] for s in range(0, q, rows)]
+    print(f"chunks: {[c.shape[0] for c in chunks]} (rows={rows})")
+
+    # 1. host prepare (pad to block_q/d_pad + ragged pad)
+    def prep():
+        outs = []
+        for c in chunks:
+            qx = stripe_prepare_queries(c, block_q, d_pad)
+            if qx.shape[0] < rows:
+                qx = np.pad(qx, ((0, rows - qx.shape[0]), (0, 0)))
+            outs.append(qx)
+        return outs
+
+    prepped, _ = t("host prepare (pad both chunks)", prep)
+
+    # 2. upload (enqueue + block)
+    def upload():
+        bufs = [jnp.asarray(p) for p in prepped]
+        jax.block_until_ready(bufs)
+        return bufs
+
+    bufs, _ = t("upload both chunks (blocked)", upload)
+
+    # 3. compute: warm then pipelined slope over the 2 chunks
+    def step(b):
+        return knn_pallas_stripe_candidates(
+            txj, b, n, k, block_q=block_q, block_n=block_n, d_true=d_true,
+            precision="exact", assume_finite=True,
+        )
+
+    t("compile+first chunk", lambda: np.asarray(step(bufs[0])[0]), reps=1)
+
+    def compute_all():
+        outs = [step(b) for b in bufs]
+        np.asarray(outs[-1][0])
+        return outs
+
+    t("compute 2 chunks (1 drain)", compute_all)
+
+    # 4. fetch cost once landed: dispatch, async-copy, wait, then device_get
+    def fetch_landed():
+        outs = [step(b) for b in bufs]
+        for o in outs:
+            o[0].copy_to_host_async()
+            o[1].copy_to_host_async()
+        np.asarray(outs[-1][0])  # drain compute + last copy
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        for o in outs:
+            jax.device_get(o)
+        return time.monotonic() - t0
+
+    for i in range(3):
+        print(f"  per-chunk device_get after landed: {fetch_landed()*1e3:.1f} ms")
+
+    def fetch_batched():
+        outs = [step(b) for b in bufs]
+        for o in outs:
+            o[0].copy_to_host_async()
+            o[1].copy_to_host_async()
+        np.asarray(outs[-1][0])
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        jax.device_get(outs)
+        return time.monotonic() - t0
+
+    for i in range(3):
+        print(f"  batched device_get after landed:   {fetch_batched()*1e3:.1f} ms")
+
+    # 5. end-to-end variants
+    cache = {}
+    t("A. current stripe_candidates_arrays", lambda: stripe_candidates_arrays(
+        train.features, big, k, cache=cache))
+    t("C. single monolithic chunk", lambda: stripe_candidates_arrays(
+        train.features, big, k, cache=cache, chunk_rows=1 << 20))
+    t("D. 32k chunks", lambda: stripe_candidates_arrays(
+        train.features, big, k, cache=cache, chunk_rows=32768))
+
+
+if __name__ == "__main__":
+    main()

@@ -281,6 +281,15 @@ class TestPallasKernel:
         assert stripe_route_ok("fast", 784, 16)
         assert not stripe_route_ok("fast", 64, 5)
         assert not stripe_route_ok("exact", 11, 17)
+        # Extreme widths decline the route (ADVICE r4): past ~24k features
+        # (f32 fast) / ~33k (bf16) no block shape fits the 64 MB kernel
+        # budget even at the floor train tile, and the no-fallback dispatch
+        # points would hard-fail in Mosaic. The threshold tracks the bf16
+        # operand's half-width store.
+        assert stripe_route_ok("fast", 16000, 5)
+        assert not stripe_route_ok("fast", 40000, 5)
+        assert stripe_route_ok("bf16", 30000, 5)
+        assert not stripe_route_ok("bf16", 40000, 5)
 
     def test_wide_fast_auto_matches_oracle(self, rng):
         # End-to-end pin for the r4 wide-fast stripe route: small-integer
