@@ -367,11 +367,15 @@ def bench_xl():
     # measurement that decides whether --approx earns its API surface.
     #
     # Run on a RANDOM 1M x 11 set of the same shape, not the tiled arrays:
-    # approx_max_k's recall guarantee assumes the true top-k land at
-    # ~random positions, and the 33x tiling places each query's top-k at a
-    # regular 30,803-row stride that is adversarial to its positional
-    # binning — measured recall collapses to 0.002 there (r4), an artifact
-    # of the synthetic duplication, not of real data.
+    # on the 33x tiling every query has ~33 near-identical candidates, so
+    # two selectors that see even slightly different distance values pick
+    # near-disjoint tie subsets — approx(matmul) scored against the exact
+    # STRIPE (subtraction-form) candidates measured 0.002 recall there
+    # (r4), which r5 re-measurement attributes to that cross-form tie
+    # divergence (same-values approx recall on the tiled set is ~0.99;
+    # predict_arrays' r5 sampled-recall guard measures the same-values
+    # form). Random data sidesteps the tie pathology so this row measures
+    # approx selection itself.
     from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
 
     rng = np.random.default_rng(7)
@@ -549,6 +553,11 @@ def bench_ingest():
         "unit": "MB/s",
         "vs_baseline": None,
         "file_mb": round(size_mb, 2),
+        # The r5 parallel @data scan engages at >= 2 cores; this box has
+        # one, so these are the serial path's numbers (the parallel path is
+        # pinned bit-identical in tests/test_native_parallel.py and scales
+        # on real hosts).
+        "host_cores": os.cpu_count(),
         **results,
     }
 

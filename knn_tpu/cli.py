@@ -108,10 +108,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--approx", action="store_true",
                    help="TPU hardware approximate top-k (not prediction-"
                    "exact). Measured r4 on 1M random rows, k=10: ~10x the "
-                   "exact stripe kernel at recall ~0.92; AVOID on data with "
-                   "regularly-strided duplicates, where the positional "
-                   "binning's recall guarantee collapses (measured 0.002 on "
-                   "a 33x-tiled set)")
+                   "exact stripe kernel at recall ~0.92. A sampled-recall "
+                   "guard (r5) scores 128 queries against exact top-k and "
+                   "falls back to exact selection with a warning when the "
+                   "measured recall misses --recall-target. (r4's headline "
+                   "hazard — 0.002 recall on a 33x-tiled set — re-measured "
+                   "r5 as mostly tie-order divergence between distance "
+                   "forms on duplicate rows; same-values selection recall "
+                   "there is ~0.99, worst observed 0.92 with contiguous "
+                   "duplicates. The guard measures the same-values recall, "
+                   "which is what approx selection actually loses)")
     p.add_argument("--recall-target", type=float, default=None,
                    help="per-candidate expected recall for --approx "
                    "(0 < r <= 1, default 0.95; higher = slower, closer to "

@@ -18,7 +18,7 @@ _ROOT = Path(__file__).parent
 _LIB_DIR = _ROOT / "lib"
 
 _SOURCES = {
-    "libknn_arff.so": (_ROOT / "arff" / "arff_c.cc", []),
+    "libknn_arff.so": (_ROOT / "arff" / "arff_c.cc", ["-lpthread"]),
     "libknn_runtime.so": (_ROOT / "runtime" / "knn_runtime.cc", ["-lpthread"]),
 }
 

@@ -45,6 +45,20 @@ def resolve_shard_engine(engine: str, precision: str, d: int, k: int) -> str:
     return "stripe" if stripe_auto_eligible(precision, d, k) else "xla"
 
 
+def xla_shard_layout(
+    n: int, n_t: int, train_tile: int, k: int
+) -> Tuple[int, int]:
+    """THE padded-shape rule for the XLA train-sharded path: clamp the tile
+    to the per-shard row quota (floored at k — the per-tile top-k needs
+    k <= tile width), then round the quota up to a tile multiple. One
+    definition shared by :func:`predict_train_sharded` and the dryrun's
+    collective-bytes audit, so the audited lowering is the executed one."""
+    shard_quota = -(-n // n_t)
+    train_tile = max(min(train_tile, shard_quota), k)
+    shard_rows = -(-shard_quota // train_tile) * train_tile
+    return train_tile, shard_rows
+
+
 def merge_candidates_vote(
     d: jnp.ndarray, i: jnp.ndarray, l: jnp.ndarray, k: int, num_classes: int
 ) -> jnp.ndarray:
@@ -233,9 +247,9 @@ def predict_train_sharded(
         )
 
     q = test_x.shape[0]
-    shard_quota = -(-train_x.shape[0] // n_t)  # ceil rows per shard
-    train_tile = max(min(train_tile, shard_quota), k)
-    shard_rows = -(-shard_quota // train_tile) * train_tile
+    train_tile, shard_rows = xla_shard_layout(
+        train_x.shape[0], n_t, train_tile, k
+    )
     tx, _ = pad_axis_to_multiple(train_x, shard_rows * n_t, axis=0)
     ty, _ = pad_axis_to_multiple(train_y, shard_rows * n_t, axis=0)
     qx, _ = pad_axis_to_multiple(test_x, n_q * query_tile, axis=0)
