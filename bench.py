@@ -244,6 +244,24 @@ def bench_mnist():
             precision="bf16", assume_finite=True,  # uniform [0,1) synthetic
         )
 
+    # Attribution row (VERDICT r4 #3): the bare MXU distance step — the
+    # same bf16 contraction via XLA with a min-reduce epilogue (kills the
+    # [q, n] output traffic) and NO selection fold. The delta to the full
+    # kernel step is the selection budget; with selection's VPU cost known
+    # from topk_net.program_cost, the composed ceiling is documented in
+    # docs/KERNELS.md (r5: ~118 TF/s on this shape — the kernel measures
+    # 93-96% of it, so the r4 "=>135 TF" aspiration is past the roofline).
+    tx_bf = jnp.asarray(train_x, jnp.bfloat16)
+
+    @jax.jit
+    def step_matmul(qb):
+        cross = jax.lax.dot_general(
+            qb[:, :d].astype(jnp.bfloat16), tx_bf,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.min(cross, axis=1)
+
     # Compile both, then check bf16-vs-f32 neighbor recall on one buffer
     # (the parity guard VERDICT r2 #1 keeps: the bf16 form must stay a
     # faithful retrieval, not just a fast one).
@@ -258,10 +276,16 @@ def bench_mnist():
     ])
     log(f"bf16 vs f32 stripe recall@{k}: {recall:.4f}")
 
+    np.asarray(step_matmul(sbufs[0]))  # compile
     slopes = _interleaved_slope_trials(
-        {"f32": (step_f32, bufs), "bf16": (step_bf16, sbufs)}, R_LO, R_HI,
+        {"f32": (step_f32, bufs), "bf16": (step_bf16, sbufs),
+         "matmul": (step_matmul, sbufs)}, R_LO, R_HI,
     )
     per_step, bf16_step = _median(slopes["f32"]), _median(slopes["bf16"])
+    mm_step = _median(slopes["matmul"])
+    log(f"bare bf16 matmul (attribution): {mm_step*1e3:.2f} ms "
+        f"({2*q*n*d/mm_step/1e12:.0f} Tflop/s); selection budget "
+        f"{(bf16_step-mm_step)*1e3:.2f} ms")
     qps = q / per_step
     tflops = 2 * q * n * d / per_step / 1e12
     log(f"f32 stripe kernel: {per_step*1e3:.2f} ms/step ({qps:.0f} q/s)")
@@ -279,6 +303,11 @@ def bench_mnist():
         **{f"bf16_{k2}": v for k2, v in _spread(slopes["bf16"]).items()},
         "bf16_engine": "stripe(1024,2048), train stored bf16",
         "bf16_recall_at_k": round(float(recall), 4),
+        "bf16_matmul_ms": round(mm_step * 1e3, 3),
+        "bf16_matmul_tflops": round(2 * q * n * d / mm_step / 1e12, 1),
+        "bf16_matmul_ms_trials": [
+            round(s * 1e3, 3) for s in slopes["matmul"]
+        ],
     }
 
 

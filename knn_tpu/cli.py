@@ -188,7 +188,17 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
     if args.platform:
         import jax
 
-        jax.config.update("jax_platforms", args.platform)
+        # Same discipline as init_from_env (multihost.py): skip the no-op
+        # write (jax.config.update clears initialized backends even for a
+        # same value) and keep the CLI's no-traceback contract if the
+        # backend is already pinned.
+        if getattr(jax.config, "jax_platforms", None) != args.platform:
+            try:
+                jax.config.update("jax_platforms", args.platform)
+            except RuntimeError as e:
+                print(f"error: --platform {args.platform}: {e}",
+                      file=sys.stderr)
+                return 1
 
     # Multi-host init (the MPI_Init analogue) — no-op unless a cluster
     # launcher set coordinator env vars.

@@ -1116,6 +1116,48 @@ def knn_stripe_classify(
     return vote(train_y[safe], num_classes)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "num_classes", "rows", "d_pad", "block_q", "block_n", "d_true",
+        "interpret", "precision", "assume_finite",
+    ),
+)
+def _stripe_classify_sliced(
+    train_xT: jnp.ndarray,
+    train_y: jnp.ndarray,
+    q_full: jnp.ndarray,
+    start: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    k: int,
+    num_classes: int,
+    rows: int,
+    d_pad: int,
+    block_q: int,
+    block_n: int,
+    d_true: Optional[int],
+    interpret: bool,
+    precision: str,
+    assume_finite: bool,
+) -> jnp.ndarray:
+    """One classify chunk sliced ON DEVICE from the resident unpadded query
+    array — the classify twin of :func:`_stripe_candidates_sliced` (see
+    there for the tunnel-transfer pathologies the single-upload design
+    sidesteps)."""
+    qb = jax.lax.dynamic_slice(
+        q_full, (start.astype(jnp.int32), jnp.int32(0)),
+        (rows, q_full.shape[1]),
+    )
+    if d_pad > q_full.shape[1]:
+        qb = jnp.pad(qb, ((0, 0), (0, d_pad - q_full.shape[1])))
+    return knn_stripe_classify(
+        train_xT, train_y, qb, n_valid, k=k, num_classes=num_classes,
+        block_q=block_q, block_n=block_n, d_true=d_true,
+        interpret=interpret, precision=precision,
+        assume_finite=assume_finite,
+    )
+
+
 def stripe_classify_arrays(
     train_x: np.ndarray,
     train_y: np.ndarray,
@@ -1164,24 +1206,37 @@ def stripe_classify_arrays(
     auto_rows = max(block_q, (4 << 20) // (128 * k * 8) // block_q * block_q)
     rows = min(auto_rows, max(block_q, max_rows)) if max_rows else auto_rows
 
-    def dispatch(s0):
-        qx = stripe_prepare_queries(test_x[s0 : s0 + rows], block_q, d_pad)
-        if q > rows and qx.shape[0] < rows:
-            # Pad the ragged last chunk up to the shared chunk shape: one
-            # compiled executable for the whole sweep (a second compile is
-            # seconds; a few padded rows are microseconds).
-            qx = np.pad(qx, ((0, rows - qx.shape[0]), (0, 0)))
-        return knn_stripe_classify(
-            txTj, tyj, jnp.asarray(qx), nv, k=k, num_classes=num_classes,
-            block_q=block_q, block_n=block_n, d_true=train_x.shape[1],
-            interpret=interpret, precision=precision,
-            assume_finite=assume_finite,
+    # Single upload of the raw query payload per SUPER-chunk + on-device row
+    # pad to a chunk multiple + dynamic-slice per chunk — the same design
+    # (and the same tunnel-transfer rationale and ~1 GB residency bound) as
+    # stripe_candidates_arrays above.
+    super_rows = max(rows, (1 << 28) // (d_pad * 4) // rows * rows)
+    parts = []
+    for qs0 in range(0, q, super_rows):
+        qsub = test_x[qs0 : qs0 + super_rows]
+        sq = qsub.shape[0]
+        chunk = min(rows, -(-sq // block_q) * block_q)
+        buf_rows = -(-sq // chunk) * chunk
+        qj = jnp.asarray(np.ascontiguousarray(qsub, np.float32))
+        if buf_rows > sq:
+            qj = jnp.pad(qj, ((0, buf_rows - sq), (0, 0)))
+
+        def dispatch(s0, qj=qj, chunk=chunk):
+            return _stripe_classify_sliced(
+                txTj, tyj, qj, jnp.asarray(s0, jnp.int32), nv, k=k,
+                num_classes=num_classes, rows=chunk, d_pad=d_pad,
+                block_q=block_q, block_n=block_n, d_true=train_x.shape[1],
+                interpret=interpret, precision=precision,
+                assume_finite=assume_finite,
+            )
+
+        def fetch(out, s0, sq=sq, chunk=chunk):
+            return np.asarray(out)[: min(chunk, sq - s0)]
+
+        parts.extend(
+            windowed_dispatch(range(0, buf_rows, chunk), dispatch, fetch)
         )
-
-    def fetch(out, s0):
-        return np.asarray(out)[: min(rows, q - s0)]
-
-    return np.concatenate(windowed_dispatch(range(0, q, rows), dispatch, fetch))
+    return np.concatenate(parts)
 
 
 def predict_pallas(

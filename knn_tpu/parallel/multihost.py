@@ -51,10 +51,19 @@ def init_from_env() -> bool:
     """
     import jax
 
-    # Honor an env-requested platform even where a sitecustomize forces one
+    # Honor a FRAMEWORK-requested platform (the launcher's KNN_TPU_PLATFORM,
+    # also the CLI --platform default) even where a sitecustomize forces one
     # programmatically (the axon TPU tunnel does; see .claude/skills/verify).
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
+    # Deliberately NOT JAX_PLATFORMS: on the axon box the tunnel exports
+    # JAX_PLATFORMS=axon ambiently, so re-applying the ENVIRONMENT here
+    # trampled configs set explicitly in-process (e.g. the test conftest's
+    # 8-device CPU mesh flipped to the 1-chip TPU the first time a CLI
+    # entry ran before backend init — r5). jax itself already reads
+    # JAX_PLATFORMS as the config default; nothing is lost by not
+    # re-applying it. Skip the no-op write too: jax.config.update clears
+    # initialized backends even for a same value.
+    plat = os.environ.get("KNN_TPU_PLATFORM")
+    if plat and getattr(jax.config, "jax_platforms", None) != plat:
         try:
             jax.config.update("jax_platforms", plat)
         except RuntimeError:

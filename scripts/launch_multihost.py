@@ -56,6 +56,12 @@ def main() -> int:
             KNN_TPU_PROC_ID=str(rank),
         )
         if args.platform == "cpu":
+            # KNN_TPU_PLATFORM is the framework's own knob: init_from_env
+            # applies it over a sitecustomize-forced platform. JAX_PLATFORMS
+            # is deliberately NOT used for this — on the axon box the
+            # tunnel exports JAX_PLATFORMS=axon ambiently, so honoring it
+            # in-process trampled explicitly-set configs (r5).
+            env["KNN_TPU_PLATFORM"] = "cpu"
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = (
                 env.get("XLA_FLAGS", "")

@@ -180,3 +180,40 @@ class TestDumpPredictions:
         assert "error:" in capsys.readouterr().err
         # The result line still printed — the compute is not discarded.
         assert LINE_RE.match(out.getvalue().strip())
+
+
+class TestPlatformStability:
+    def test_cli_entry_does_not_trample_explicit_platform_config(self, paths):
+        """Regression (r5): with an ambient JAX_PLATFORMS (the axon tunnel
+        exports 'axon'), a CLI entry running BEFORE the first backend
+        initialization re-applied the environment over an explicitly-set
+        jax_platforms config — flipping an 8-device CPU session to the
+        1-chip TPU mid-process. init_from_env must only honor the
+        framework's own KNN_TPU_PLATFORM knob."""
+        import os
+        import subprocess
+        import sys
+        import textwrap
+
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="bogus_ambient_platform",
+            XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                       + " --xla_force_host_platform_device_count=8").strip(),
+        )
+        env.pop("KNN_TPU_PLATFORM", None)
+        code = textwrap.dedent(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")  # explicit in-process
+            import io
+            from knn_tpu.cli import run
+            run(["/nope/train.arff", "/nope/test.arff", "1"],
+                stdout=io.StringIO())  # errors out AFTER init_from_env ran
+            assert len(jax.devices()) == 8, jax.devices()
+            print("DEVICES-OK")
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        assert "DEVICES-OK" in proc.stdout, (proc.stdout, proc.stderr)
