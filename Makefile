@@ -25,7 +25,7 @@ native: $(LIB_DIR)/libknn_arff.so $(LIB_DIR)/libknn_runtime.so
 
 $(LIB_DIR)/libknn_arff.so: knn_tpu/native/arff/arff_c.cc
 	@mkdir -p $(LIB_DIR)
-	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+	$(CXX) $(CXXFLAGS) -shared -o $@ $< -lpthread
 
 $(LIB_DIR)/libknn_runtime.so: knn_tpu/native/runtime/knn_runtime.cc
 	@mkdir -p $(LIB_DIR)

@@ -262,6 +262,17 @@ def bench_mnist():
         )
         return jnp.min(cross, axis=1)
 
+    tx_f32 = jnp.asarray(train_x)
+
+    @jax.jit
+    def step_matmul_f32(qb):
+        cross = jax.lax.dot_general(
+            qb[:, :d], tx_f32,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.min(cross, axis=1)
+
     # Compile both, then check bf16-vs-f32 neighbor recall on one buffer
     # (the parity guard VERDICT r2 #1 keeps: the bf16 form must stay a
     # faithful retrieval, not just a fast one).
@@ -277,15 +288,19 @@ def bench_mnist():
     log(f"bf16 vs f32 stripe recall@{k}: {recall:.4f}")
 
     np.asarray(step_matmul(sbufs[0]))  # compile
+    np.asarray(step_matmul_f32(sbufs[0]))
     slopes = _interleaved_slope_trials(
         {"f32": (step_f32, bufs), "bf16": (step_bf16, sbufs),
-         "matmul": (step_matmul, sbufs)}, R_LO, R_HI,
+         "matmul": (step_matmul, sbufs),
+         "matmul_f32": (step_matmul_f32, sbufs)}, R_LO, R_HI,
     )
     per_step, bf16_step = _median(slopes["f32"]), _median(slopes["bf16"])
     mm_step = _median(slopes["matmul"])
+    mm32_step = _median(slopes["matmul_f32"])
     log(f"bare bf16 matmul (attribution): {mm_step*1e3:.2f} ms "
         f"({2*q*n*d/mm_step/1e12:.0f} Tflop/s); selection budget "
-        f"{(bf16_step-mm_step)*1e3:.2f} ms")
+        f"{(bf16_step-mm_step)*1e3:.2f} ms; bare f32 matmul "
+        f"{mm32_step*1e3:.2f} ms ({2*q*n*d/mm32_step/1e12:.0f} Tflop/s)")
     qps = q / per_step
     tflops = 2 * q * n * d / per_step / 1e12
     log(f"f32 stripe kernel: {per_step*1e3:.2f} ms/step ({qps:.0f} q/s)")
@@ -308,6 +323,8 @@ def bench_mnist():
         "bf16_matmul_ms_trials": [
             round(s * 1e3, 3) for s in slopes["matmul"]
         ],
+        "f32_matmul_ms": round(mm32_step * 1e3, 3),
+        "f32_matmul_tflops": round(2 * q * n * d / mm32_step / 1e12, 1),
     }
 
 
