@@ -32,6 +32,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <sys/mman.h>
+
 #include <memory>
 #include <string>
 #include <string_view>
@@ -1122,11 +1124,15 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
   ParseState st;
   st.path = path;
 
-  // The parser runs over a read-only view of one uninitialized buffer:
-  // a single fread, no std::string zero-fill. (mmap was tried and measured
-  // SLOWER here — per-call soft page faults across the mapping cost more
-  // than one streaming copy of a page-cached file.)
+  // The parser runs over a read-only view of the file. Preferred path:
+  // mmap with MAP_POPULATE — the batch prefault makes a page-cached 90 MB
+  // file mappable in ~1-2 ms where one streaming fread copy costs ~55 ms
+  // (r5 measurement; plain mmap WITHOUT populate was slower than fread —
+  // per-access soft faults — which is what an earlier round measured).
+  // Falls back to the fread copy when mmap is unavailable (exotic FS).
   std::unique_ptr<char[]> file_buf;
+  void* mapped = nullptr;
+  size_t mapped_size = 0;
   std::string_view data;
   {
     FILE* f = fopen(path, "rb");
@@ -1138,16 +1144,29 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
     long size = ftell(f);
     fseek(f, 0, SEEK_SET);
     if (size > 0) {
-      file_buf.reset(new char[(size_t)size]);
-      if (fread(file_buf.get(), 1, (size_t)size, f) != (size_t)size) {
-        fclose(f);
-        out->error = dup_string(std::string(path) + ": short read");
-        return 1;
+      mapped = mmap(nullptr, (size_t)size, PROT_READ,
+                    MAP_PRIVATE | MAP_POPULATE, fileno(f), 0);
+      if (mapped != MAP_FAILED) {
+        mapped_size = (size_t)size;
+        data = std::string_view((const char*)mapped, (size_t)size);
+      } else {
+        mapped = nullptr;
+        file_buf.reset(new char[(size_t)size]);
+        if (fread(file_buf.get(), 1, (size_t)size, f) != (size_t)size) {
+          fclose(f);
+          out->error = dup_string(std::string(path) + ": short read");
+          return 1;
+        }
+        data = std::string_view(file_buf.get(), (size_t)size);
       }
-      data = std::string_view(file_buf.get(), (size_t)size);
     }
     fclose(f);
   }
+  struct Unmap {
+    void* p;
+    size_t n;
+    ~Unmap() { if (p) munmap(p, n); }
+  } unmap_guard{mapped, mapped_size};
 
   bool parsed;
   try {
