@@ -737,7 +737,7 @@ def bench_kneighbors():
     huge_ds = Dataset(huge, np.zeros(len(huge), np.int32))
     model.kneighbors(huge_ds)  # warm
     huge_trials = []
-    for _ in range(3):
+    for _ in range(5):  # wall is upload-phase-dependent; give the min a shot
         t0 = time.monotonic()
         model.kneighbors(huge_ds)
         huge_trials.append(time.monotonic() - t0)
