@@ -394,26 +394,37 @@ def _knn_stripe_kernel(
     # (distance, index) tie rule — first-seen-wins, main.cpp:47):
     #
     # 1. Truncated odd-even merge network (ops/topk_net.py): a tournament
-    #    of Batcher merges over (d, i) compare-exchanges. No retirement, and
-    #    no finiteness gating of its own (assume_finite still gates the
-    #    upstream NaN->inf distance policy for both formulations); wins for
-    #    k >= ~3 (r4 — recovered the xl k=10 regression and cut the
-    #    headline selection cost ~25%).
-    # 2. k rounds of min-extraction across planes with retirement — cheaper
-    #    only at k <= 2 where two thin passes beat fused comparators.
+    #    of Batcher merges over (d, i) compare-exchanges, most of whose tie
+    #    predicates resolve to a single compare via the compile-time
+    #    tie-dominance matrix (r5; `finite` admits the candidate-dominance
+    #    facts). Since that resolution it wins the cost race at EVERY k
+    #    (device-confirmed down to k=1), so auto routing always picks it.
+    # 2. k rounds of min-extraction across planes with retirement — kept
+    #    as the select="rounds" probe/A-B baseline.
     from knn_tpu.ops import topk_net
 
-    net_ops, net_out = topk_net.tile_topk_program(g, k)
+    # finite (== lite_retire == the host's assume_finite gate) admits the
+    # tie-dominance facts that prove most CEs' tie-break terms constant —
+    # see topk_net._prune; without the gate the NaN-policy +inf distances
+    # can carry real indices and only the fresh-plane facts hold.
+    net_ops, net_out = topk_net.tile_topk_program(g, k, finite=lite_retire)
     use_net = (
         topk_net.program_cost(net_ops) < topk_net.rounds_cost(g, k, lite_retire)
         if select is None
         else select == "net"
     )
     if use_net:
-        for a, b, kind, ordered in net_ops:
+        for a, b, kind, tie in net_ops:
             ad, bd = d_planes[a], d_planes[b]
             ai, bi = i_planes[a], i_planes[b]
-            swap = (bd < ad) if ordered else ((bd < ad) | ((bd == ad) & (bi < ai)))
+            if tie == "a":
+                swap = bd < ad
+            elif tie == "b":
+                # b tie-dominates a: on equal distances b must win the min
+                # slot, so the strict compare becomes <= — still one op.
+                swap = bd <= ad
+            else:
+                swap = (bd < ad) | ((bd == ad) & (bi < ai))
             if kind != "hi":
                 d_planes[a] = jnp.minimum(ad, bd)
                 i_planes[a] = jnp.where(swap, bi, ai)

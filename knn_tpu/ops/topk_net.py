@@ -14,12 +14,16 @@ compare-exchange whose outputs cannot reach the kept k wires pruned away
 A network is a list of compare-exchange (CE) ops over *wires*; each wire
 holds one ``(distance, index)`` plane. A CE orders two wires by the
 lexicographic ``(d, i)`` key — the reference's first-seen-wins tie rule
-(main.cpp:47) — so the network needs no retirement passes and no finiteness
-gating: ties, +inf padding and NaN-policy +inf distances all flow through
-the total order. Correctness is validated exhaustively in the test suite by
-the 0-1 principle (a comparator network that sorts every 0-1 input sorts
-every input), which covers the truncation because top-k of a union equals
-top-k of the unions' top-k's.
+(main.cpp:47) — so the network needs no retirement passes: ties, +inf
+padding and NaN-policy +inf distances all flow through the total order.
+(The ``finite=True`` program VARIANT goes further: it resolves tie
+predicates using dominance facts that hold only under the kernel's
+``assume_finite`` gate — see :func:`tile_topk_program`.) Correctness is
+validated exhaustively in the test suite by the 0-1 principle (a
+comparator network that sorts every 0-1 input sorts every input), which
+covers the truncation because top-k of a union equals top-k of the
+unions' top-k's, plus dense-tie fuzzing and multi-tile stream simulation
+for the tie modes.
 
 Programs are pure Python data generated at trace time and memoized per
 ``(g, k)``; the kernel emits the corresponding jnp ops.
@@ -30,15 +34,31 @@ from __future__ import annotations
 import functools
 from typing import List, Sequence, Tuple
 
-# A CE op: (wire_a, wire_b, kind, ordered). After the op, wire_a holds the
+# A CE op: (wire_a, wire_b, kind, tie). After the op, wire_a holds the
 # lexicographic min of the two inputs and wire_b the max. ``kind`` marks
 # which outputs later ops actually read: "full" (both), "lo" (only the
-# min — the max write may be skipped), "hi" (only the max). ``ordered``
-# marks leaf CEs between two untouched fresh wires: there the per-lane
-# indices are statically ascending (plane order IS index order within a
-# lane), so the tie-break half of the swap predicate is constant-false and
-# the kernel can emit ``swap = (b.d < a.d)`` alone.
-CeOp = Tuple[int, int, str, bool]
+# min — the max write may be skipped), "hi" (only the max).
+#
+# ``tie`` encodes what the kernel must emit for the swap predicate:
+#   "full" — the generic lexicographic predicate
+#            ``(b.d < a.d) | ((b.d == a.d) & (b.i < a.i))``  (4 VPU ops)
+#   "a"    — wire a is PROVEN to tie-dominate b (on equal distances a's
+#            index is <= b's in every lane for every input), so the
+#            tie-break term is constant-false: ``swap = (b.d < a.d)`` (1 op)
+#   "b"    — b tie-dominates a: on ties b must win the min slot:
+#            ``swap = (b.d <= a.d)``                          (1 op)
+#
+# Tie dominance is tracked exactly (the matrix pass inside _prune): it
+# starts from the kernel's input invariants — fresh planes' per-lane
+# indices ascend with the wire id; running levels are (d, i)-sorted; and
+# under the finite-inputs gate the running candidates additionally
+# tie-dominate every fresh plane (candidates carry earlier tiles'
+# indices, and +inf implies the INT_MAX sentinel on both sides) — and
+# propagates through each CE: both outputs of a correct CE are
+# tie-ordered (the min side takes the smaller index on ties), and a
+# third wire keeps its relation to an output only when it related the
+# same way to BOTH inputs.
+CeOp = Tuple[int, int, str, str]
 
 
 def _merge(a: Sequence[int], b: Sequence[int], ops: List[Tuple[int, int]]):
@@ -69,13 +89,15 @@ def _merge(a: Sequence[int], b: Sequence[int], ops: List[Tuple[int, int]]):
 
 
 def _prune(
-    ops: Sequence[Tuple[int, int]], keep: Sequence[int], n_fresh: int
+    ops: Sequence[Tuple[int, int]], keep: Sequence[int], n_fresh: int,
+    n_wires: int, finite: bool,
 ) -> List[CeOp]:
     """Drop CEs whose outputs can never reach the kept wires, mark the
     survivors with which side is consumed (a one-sided CE emits fewer
-    elementwise ops in the kernel), and flag ordered leaf CEs (see CeOp)."""
+    elementwise ops in the kernel), and resolve each survivor's tie mode
+    from the exact tie-dominance matrix (see CeOp)."""
     live = set(keep)
-    kept: List[CeOp] = []
+    kept: List[Tuple[int, int, str]] = []
     for a, b in reversed(ops):
         a_live, b_live = a in live, b in live
         if not (a_live or b_live):
@@ -85,27 +107,80 @@ def _prune(
         live.add(a)
         live.add(b)
     kept.reverse()
-    # Forward pass for the ordered flag: a CE is ordered when both wires are
-    # fresh planes (wire id < n_fresh), untouched so far, and a < b — per
-    # lane, fresh plane indices ascend with the wire id.
-    virgin = set(range(n_fresh))
+
+    # Tie-dominance matrix T: T[x][y] means "for every input and lane,
+    # equal distances on x and y imply x's index <= y's" at the current
+    # point of the program. Initial facts from the kernel's invariants:
+    #  - fresh wire indices ascend with wire id (base + w*128 + lane), so
+    #    T[x][y] for fresh x < y — unconditionally (this subsumes the old
+    #    virgin-leaf rule and survives propagation);
+    #  - with finite inputs (the kernel's assume_finite gate): running
+    #    candidates tie-dominate every fresh plane (their real indices come
+    #    from earlier tiles, and +inf distance implies the INT_MAX index
+    #    sentinel on BOTH sides — without the gate a NaN-policy +inf can
+    #    carry a real index and the relation breaks), and running levels
+    #    tie-dominate each other in level order (they are (d, i)-sorted).
+    T = [[False] * n_wires for _ in range(n_wires)]
+    for x in range(n_fresh):
+        for y in range(x + 1, n_fresh):
+            # Holds even under the NaN policy: within a lane, invalidity
+            # (the INT_MAX sentinel) is monotone in the wire id — a later
+            # fresh wire's global column is strictly larger, so it cannot
+            # be valid where an earlier one is not.
+            T[x][y] = True
+    for r1 in range(n_fresh, n_wires):
+        for r2 in range(r1 + 1, n_wires):
+            # Levels are (d, i)-sorted per lane: equal d implies i order.
+            T[r1][r2] = True
+    if finite:
+        for r in range(n_fresh, n_wires):
+            for f in range(n_fresh):
+                T[r][f] = True
+
     out: List[CeOp] = []
     for a, b, kind in kept:
-        ordered = a in virgin and b in virgin and a < b
-        virgin.discard(a)
-        virgin.discard(b)
-        out.append((a, b, kind, ordered))
+        if T[a][b]:
+            tie = "a"
+        elif T[b][a]:
+            tie = "b"
+        else:
+            tie = "full"
+        out.append((a, b, kind, tie))
+        # Propagate: outputs a' (lex min) and b' (lex max). A third wire c
+        # keeps a relation to an output only if it held it against BOTH
+        # inputs (the output's (d, i) pair is one of the two, data-
+        # dependently). The outputs themselves are always tie-ordered
+        # after a correct CE (on ties the min slot takes the smaller
+        # index), whatever the predicate used.
+        for c in range(n_wires):
+            if c == a or c == b:
+                continue
+            below = T[a][c] and T[b][c]
+            above = T[c][a] and T[c][b]
+            T[a][c] = T[b][c] = below
+            T[c][a] = T[c][b] = above
+        T[a][b] = True
+        T[b][a] = False
     return out
 
 
 @functools.lru_cache(maxsize=None)
-def tile_topk_program(g: int, k: int) -> Tuple[Tuple[CeOp, ...], Tuple[int, ...]]:
+def tile_topk_program(
+    g: int, k: int, finite: bool = False
+) -> Tuple[Tuple[CeOp, ...], Tuple[int, ...]]:
     """The per-train-tile selection program: wires ``0..g-1`` are the fresh
     distance planes (unsorted singletons), wires ``g..g+k-1`` the running
     candidate levels (sorted ascending per lane). Returns ``(ops,
     out_wires)``: after executing ``ops`` in order, the ``k`` wires in
     ``out_wires`` hold the new sorted running candidates — the per-lane
-    lexicographic top-k of all ``g + k`` inputs."""
+    lexicographic top-k of all ``g + k`` inputs.
+
+    ``finite`` — set iff the kernel's ``assume_finite`` gate holds — admits
+    the running-candidate tie-dominance facts (see _prune), which prove
+    most CEs' tie-break terms constant and shrink the program's VPU cost
+    ~2x. Programs generated with ``finite=True`` are only exact under the
+    gate's input guarantee (a NaN-policy +inf distance paired with a real
+    index violates the candidate/fresh dominance the proof uses)."""
     ops: List[Tuple[int, int]] = []
     lists: List[List[int]] = [[w] for w in range(g)]
     while len(lists) > 1:
@@ -120,19 +195,19 @@ def tile_topk_program(g: int, k: int) -> Tuple[Tuple[CeOp, ...], Tuple[int, ...]
     fresh = lists[0][:k]
     running = list(range(g, g + k))
     out = _merge(fresh, running, ops)[:k]
-    return tuple(_prune(ops, out, g)), tuple(out)
+    return tuple(_prune(ops, out, g, g + k, finite)), tuple(out)
 
 
 def program_cost(ops: Sequence[CeOp]) -> int:
     """Elementwise-op estimate for a program (full CE ~9 VPU ops, one-sided
-    ~7; ordered CEs save the 4-op tie-break predicate). This is HALF OF THE
-    KERNEL'S ROUTING PREDICATE: _knn_stripe_kernel picks the network iff
-    ``program_cost(ops) < rounds_cost(g, k, lite)`` at trace time, so the
-    weights here are load-bearing — change them and selection routing
-    flips."""
+    ~7; a resolved tie mode replaces the 4-op tie-break predicate with one
+    compare). This is HALF OF THE KERNEL'S ROUTING PREDICATE:
+    _knn_stripe_kernel picks the network iff ``program_cost(ops) <
+    rounds_cost(g, k, lite)`` at trace time, so the weights here are
+    load-bearing — change them and selection routing flips."""
     return sum(
-        (9 if kind == "full" else 7) - (4 if ordered else 0)
-        for _, _, kind, ordered in ops
+        (9 if kind == "full" else 7) - (4 if tie != "full" else 0)
+        for _, _, kind, tie in ops
     )
 
 
@@ -152,11 +227,17 @@ def simulate(ops: Sequence[CeOp], values: list) -> list:
     a list of (d, i) tuples indexed by wire. One-sided ops still write both
     wires — kind only marks which side later ops read, so writing both is
     semantics-preserving — keeping the simulation faithful to pruning. The
-    ordered flag is honored the way the kernel honors it (no index
-    tie-break), so a wrongly-flagged op would surface as a wrong result."""
+    tie mode is honored exactly the way the kernel emits it ("a": plain
+    strict compare; "b": <=; "full": lexicographic), so a wrongly-resolved
+    tie mode surfaces as a wrong result."""
     vals = list(values)
-    for a, b, kind, ordered in ops:
+    for a, b, kind, tie in ops:
         va, vb = vals[a], vals[b]
-        swap = (vb[0] < va[0]) if ordered else (vb < va)
+        if tie == "a":
+            swap = vb[0] < va[0]
+        elif tie == "b":
+            swap = vb[0] <= va[0]
+        else:
+            swap = vb < va
         vals[a], vals[b] = (vb, va) if swap else (va, vb)
     return vals
