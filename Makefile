@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test bench parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify bench parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -53,6 +53,14 @@ tpu:
 
 test:
 	python3 -m pytest tests/ -q
+
+# The tier-1 gate (ROADMAP.md): the not-slow suite on CPU with the 8-device
+# virtual mesh, plus a bytecode-compile of the package so syntax errors in
+# rarely-imported modules can't hide. CI runs exactly this target.
+verify:
+	python3 -m compileall -q knn_tpu bench.py
+	JAX_PLATFORMS=cpu python3 -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
 
 bench:
 	python3 bench.py

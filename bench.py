@@ -77,136 +77,18 @@ def load_large():
     )
 
 
-def _timed_batch(step, bufs, reps, block_fn=None):
-    """One pipelined batch: ``reps`` dispatches cycling the distinct buffer
-    pool, one drain, wall seconds. ``block_fn(out)`` drains; the default
-    pulls the (first) output to host via np.asarray (jax.block_until_ready
-    proved unreliable on the tunneled device). THE timing primitive — the
-    slope estimators and the tuning scripts all ride it so their ms/step
-    numbers stay methodology-comparable."""
-    if block_fn is None:
-        def block_fn(out):
-            np.asarray(out if not isinstance(out, (tuple, list)) else out[0])
-
-    t0 = time.monotonic()
-    out = None
-    for i in range(reps):
-        out = step(bufs[i % len(bufs)])
-    block_fn(out)
-    return time.monotonic() - t0
-
-
-def _pipelined_slope(mkstep, bufs, r_lo, r_hi, block_fn=None):
-    """Marginal per-dispatch seconds: time r_lo and r_hi pipelined dispatches
-    (one drain each, best of 3) and take the slope — subtracts the fixed
-    host-sync/tunnel round-trip that has nothing to do with device compute.
-    """
-    def timed(reps):
-        return min(
-            _timed_batch(mkstep, bufs, reps, block_fn) for _ in range(3)
-        )
-
-    t_lo, t_hi = timed(r_lo), timed(r_hi)
-    per_step = (t_hi - t_lo) / (r_hi - r_lo)
-    return per_step, t_lo - r_lo * per_step
-
-
-def _slope_trials(step, bufs, r_lo, r_hi, trials=5, inner=2):
-    """R independent slope estimates for ONE case (VERDICT r3 #1: one number
-    per session made every regression-vs-variance call guesswork). Thin
-    wrapper over _interleaved_slope_trials — see there for the
-    slope-of-minima rationale and the non-positive-trial guard."""
-    return _interleaved_slope_trials(
-        {"case": (step, bufs)}, r_lo, r_hi, trials=trials, rounds=inner,
-    )["case"]
-
-
-def _median(trials):
-    srt = sorted(trials)
-    m = len(srt)
-    return srt[m // 2] if m % 2 else (srt[m // 2 - 1] + srt[m // 2]) / 2
-
-
-def _spread(trials_s, scale=1e3, digits=3):
-    """Summary fields for a list of per-trial per-step seconds: best (min),
-    median, and the full list, in milliseconds. The MEDIAN is the central
-    estimate every headline value derives from (r4: minority stall-biased
-    trials produced minima past the chip's roofline — see
-    _interleaved_slope_trials); the min and full list stay recorded so
-    stability and best-case are visible."""
-    ms = [s * scale for s in trials_s]
-    return {
-        "step_ms": round(min(ms), digits),
-        "step_ms_median": round(_median(ms), digits),
-        # run order preserved so drift across a session stays visible
-        "step_ms_trials": [round(v, digits) for v in ms],
-    }
-
-
-def _drop_superroofline(trials_s, flops, peak_tf=207.0):
-    """Drop slope trials whose implied Tflop/s exceeds the chip's peak —
-    nothing computes faster than the hardware, so such a trial is a
-    measurement artifact by definition (a host stall inflating the r_lo
-    batch reads as an impossibly fast slope; observed 247-412 "Tflop/s"
-    on a 197-peak chip, and in one r5 session 3 of 5 trials stalled this
-    way and poisoned the MEDIAN too). ``peak_tf`` is the v5e bf16 peak
-    plus 5% margin. Returns the surviving trials; if none survive, the
-    raw list comes back (no signal beats fake signal, and the consumer's
-    min/median at least stays visibly absurd)."""
-    good = [s for s in trials_s if flops / s / 1e12 <= peak_tf]
-    if good and len(good) < len(trials_s):
-        log(f"dropped {len(trials_s) - len(good)} super-roofline slope "
-            f"trial(s): {[round(flops / s / 1e12) for s in trials_s]} Tflop/s")
-    return good or trials_s
-
-
-def _interleaved_slope_trials(cases, r_lo, r_hi, trials=5, rounds=2):
-    """Per-case slope TRIALS with the cases INTERLEAVED inside each trial:
-    every round times each case once at r_lo and r_hi dispatches before the
-    next round starts, so device-load drift (observed ~1.5x run-to-run on
-    the tunneled v5e) hits all cases alike instead of erasing a comparison
-    measured minutes apart. Within a trial the slope is taken between the
-    per-batch-size MINIMA over ``rounds`` rounds — NOT between paired
-    single timings, which a load spike during the r_lo batch would bias
-    low (fast), exactly the trials a min-of-R summary then cherry-picks.
-    ``cases`` maps name -> (step_fn, bufs); returns name -> list of
-    per-step seconds, one per trial (run order preserved). Batch order
-    alternates (lo,hi)/(hi,lo) per round so a position-correlated stall
-    (tunnel hiccup, GC) cannot systematically inflate one batch size —
-    an inflated t_lo reads as an impossibly FAST slope (observed beating
-    the chip's bf16 roofline), which a min-of-trials summary then
-    selects. Consumers should treat the MEDIAN as the central estimate
-    and sanity-check any min against the roofline."""
-    out = {name: [] for name in cases}
-    for _ in range(trials):
-        lo = {name: float("inf") for name in cases}
-        hi = {name: float("inf") for name in cases}
-        for r in range(rounds):
-            for name, (step, bufs) in cases.items():
-                if r % 2 == 0:
-                    lo[name] = min(lo[name], _timed_batch(step, bufs, r_lo))
-                    hi[name] = min(hi[name], _timed_batch(step, bufs, r_hi))
-                else:
-                    hi[name] = min(hi[name], _timed_batch(step, bufs, r_hi))
-                    lo[name] = min(lo[name], _timed_batch(step, bufs, r_lo))
-        for name in cases:
-            out[name].append((hi[name] - lo[name]) / (r_hi - r_lo))
-    # A load spike spanning every r_lo batch of a trial can push that
-    # trial's slope to <= 0; min() would then select the garbage and turn
-    # the whole record negative. Drop such trials loudly; a session where
-    # EVERY trial is non-positive has no usable signal at all.
-    for name, vals in out.items():
-        good = [v for v in vals if v > 0]
-        if not good:
-            raise RuntimeError(
-                f"all {len(vals)} slope trials for {name!r} are non-positive "
-                f"({vals}); device load noise swamped the measurement"
-            )
-        if len(good) < len(vals):
-            log(f"dropped {len(vals) - len(good)} non-positive slope "
-                f"trial(s) for {name!r}: {vals}")
-        out[name] = good
-    return out
+# The timing/slope primitives live in knn_tpu.obs.bench_timing (one
+# methodology for bench.py and every scripts/tune_* sweep); the private
+# aliases keep this file's call sites and historical probe scripts stable.
+from knn_tpu.obs.bench_timing import (  # noqa: E402
+    drop_superroofline as _drop_superroofline,
+    interleaved_slope_trials as _interleaved_slope_trials,
+    median as _median,
+    pipelined_slope as _pipelined_slope,
+    slope_trials as _slope_trials,
+    spread as _spread,
+    timed_batch as _timed_batch,
+)
 
 
 def bench_mnist():
@@ -1064,19 +946,42 @@ def compact_summary(record):
     return out
 
 
+def _span_breakdown(parent):
+    from knn_tpu import obs
+
+    return obs.tracer().phase_totals(parent)
+
+
 def main():
     """Default run: headline + every secondary config. The full record (with
     per-trial lists) goes to stdout first and to build/bench_full.json; the
-    FINAL line is the compact summary the driver's tail capture parses."""
-    record = bench_headline()
+    FINAL line is the compact summary the driver's tail capture parses.
+
+    The obs tracer runs for the whole session, so every config row carries
+    ``span_breakdown`` (its direct instrumented phases) and the record ends
+    with the global span aggregate + metric dump — future super-roofline /
+    host-stall artifacts arrive self-diagnosing instead of needing the
+    hand-forensics of rounds 4-5 (commit de19290)."""
+    from knn_tpu import obs
+
+    obs.enable()
+    with obs.span("config", config="headline") as hspan:
+        record = bench_headline()
+    record["span_breakdown"] = _span_breakdown(hspan)
     configs = {}
     for name, fn in _SECONDARY_CONFIGS.items():
         try:
-            configs[name] = fn()
+            with obs.span("config", config=name) as cspan:
+                configs[name] = fn()
+            configs[name]["span_breakdown"] = _span_breakdown(cspan)
         except Exception as e:  # a secondary config must not sink the headline
             log(f"config {name} FAILED: {type(e).__name__}: {e}")
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
     record["configs"] = configs
+    record["obs"] = {
+        "spans": obs.tracer().aggregate(),
+        "metrics": obs.registry().to_json(),
+    }
     full = json.dumps(record)
     out = Path(__file__).parent / "build" / "bench_full.json"
     try:

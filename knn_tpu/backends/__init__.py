@@ -29,8 +29,15 @@ _REGISTRY: Dict[str, Callable] = {}
 
 
 def register(name: str):
+    """Register a backend predict fn, wrapped with the observability layer
+    (a ``predict`` span + per-backend call/query/wall metrics — no-ops
+    while ``knn_tpu.obs`` is disabled). The module-level fn stays unwrapped
+    so direct imports (tests, scripts) see the raw strategy."""
+
     def deco(fn):
-        _REGISTRY[name] = fn
+        from knn_tpu.obs.instrument import observed_backend
+
+        _REGISTRY[name] = observed_backend(name, fn)
         return fn
 
     return deco

@@ -45,12 +45,32 @@ def knn_native(
     num_classes: int,
     num_threads: int = 1,
 ) -> np.ndarray:
+    import time
+
+    from knn_tpu import obs
+
     train_x = np.ascontiguousarray(train_x, np.float32)
     train_y = np.ascontiguousarray(train_y, np.int32)
     test_x = np.ascontiguousarray(test_x, np.float32)
     q = test_x.shape[0]
     out = np.empty(q, np.int32)
-    rc = _lib.knn_native_predict(
+    t0 = time.monotonic()
+    with obs.span("kernel", backend="native", threads=num_threads):
+        rc = _call_native(train_x, train_y, test_x, k, num_classes,
+                          num_threads, out)
+    if obs.enabled():
+        obs.histogram_observe(
+            "knn_kernel_ms", (time.monotonic() - t0) * 1e3,
+            help="native C++ kernel wall ms", backend="native",
+        )
+    if rc != 0:
+        raise ValueError(f"knn_native_predict failed (rc={rc})")
+    return out
+
+
+def _call_native(train_x, train_y, test_x, k, num_classes, num_threads, out):
+    q = test_x.shape[0]
+    return _lib.knn_native_predict(
         train_x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         train_y.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         train_x.shape[0],
@@ -62,9 +82,6 @@ def knn_native(
         num_threads,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
-    if rc != 0:
-        raise ValueError(f"knn_native_predict failed (rc={rc})")
-    return out
 
 
 @register("native")

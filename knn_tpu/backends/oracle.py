@@ -70,19 +70,28 @@ def knn_oracle(
     # Process queries in chunks so the [chunk, N] distance block stays cache-friendly.
     d_feat = max(train_x.shape[1], 1)
     chunk = max(1, min(q, int(4e7) // max(n * d_feat, 1)))
+    from knn_tpu import obs
+
     for s in range(0, q, chunk):
         e = min(q, s + chunk)
-        dists = _metric_dists(test_x[s:e], train_x, metric)
-        # Framework-wide policy: NaN distances count as +inf (the reference is
-        # UB here — SURVEY.md §3.5.5); +inf candidates are admitted in
-        # (distance, index) order.
-        np.nan_to_num(dists, copy=False, nan=np.inf)
-        for row in range(e - s):
-            d = dists[row]
-            # Stable (distance, index) ordering == first-seen-wins insertion.
-            order = np.lexsort((arange_n, d))[:k]
-            counts = np.bincount(train_y[order], minlength=num_classes)
-            preds[s + row] = np.argmax(counts)
+        with obs.span("distance", metric=metric, backend="oracle"):
+            dists = _metric_dists(test_x[s:e], train_x, metric)
+            # Framework-wide policy: NaN distances count as +inf (the
+            # reference is UB here — SURVEY.md §3.5.5); +inf candidates are
+            # admitted in (distance, index) order.
+            np.nan_to_num(dists, copy=False, nan=np.inf)
+        with obs.span("top-k", backend="oracle"):
+            order = np.empty((e - s, k), np.int64)
+            for row in range(e - s):
+                # Stable (distance, index) ordering == first-seen-wins
+                # insertion.
+                order[row] = np.lexsort((arange_n, dists[row]))[:k]
+        with obs.span("vote", backend="oracle"):
+            for row in range(e - s):
+                counts = np.bincount(
+                    train_y[order[row]], minlength=num_classes
+                )
+                preds[s + row] = np.argmax(counts)
     return preds
 
 

@@ -34,10 +34,19 @@ def predict(
     if metric != "euclidean":
         raise ValueError("the pallas kernels implement euclidean only")
     train.validate_for_knn(k, test)
+    from knn_tpu import obs
+    from knn_tpu.obs.instrument import record_transfer
+
+    if obs.enabled():
+        record_transfer(
+            train.features.nbytes + train.labels.nbytes
+            + test.features.nbytes, backend="tpu-pallas",
+        )
     # precision="auto" resolves inside predict_pallas (exact for narrow
     # features, fast for wide — ops/pallas_knn._resolve_stripe_precision).
-    return predict_pallas(
-        train.features, train.labels, test.features, k, train.num_classes,
-        block_q=block_q, block_n=block_n, interpret=interpret,
-        precision=precision, engine=engine,
-    )
+    with obs.span("kernel", backend="tpu-pallas", engine=engine):
+        return predict_pallas(
+            train.features, train.labels, test.features, k, train.num_classes,
+            block_q=block_q, block_n=block_n, interpret=interpret,
+            precision=precision, engine=engine,
+        )

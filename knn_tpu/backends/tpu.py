@@ -415,25 +415,43 @@ def predict_arrays(
             force_tiled=force_tiled, approx=approx, query_batch=query_batch,
             recall_target=recall_target,
         )
+    from knn_tpu import obs
+    from knn_tpu.obs.instrument import record_transfer
+
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
-        out = knn_forward(
-            jnp.asarray(train_x), jnp.asarray(train_y), jnp.asarray(test_x),
-            k=k, num_classes=num_classes, precision=precision, approx=approx,
-            recall_target=recall_target,
-        )
-        return np.asarray(out)
+        with obs.span("prepare", engine="xla-full"):
+            txj, tyj, qxj = (
+                jnp.asarray(train_x), jnp.asarray(train_y),
+                jnp.asarray(test_x),
+            )
+        if obs.enabled():
+            record_transfer(train_x.nbytes + train_y.nbytes + test_x.nbytes)
+        with obs.span("dispatch", engine="xla-full", approx=approx):
+            out = knn_forward(
+                txj, tyj, qxj,
+                k=k, num_classes=num_classes, precision=precision,
+                approx=approx, recall_target=recall_target,
+            )
+        with obs.span("fetch", engine="xla-full"):
+            return np.asarray(out)
 
     train_tile = max(train_tile, k)  # per-tile top-k needs k <= tile width
-    tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
-    ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
-    qx, _ = pad_axis_to_multiple(test_x, query_tile, axis=0)
-    out = knn_forward_tiled(
-        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
-        jnp.asarray(n, jnp.int32),
-        k=k, num_classes=num_classes, precision=precision,
-        query_tile=query_tile, train_tile=train_tile,
-    )
-    return np.asarray(out)[:q]
+    with obs.span("prepare", engine="xla-tiled"):
+        tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
+        ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
+        qx, _ = pad_axis_to_multiple(test_x, query_tile, axis=0)
+        txj, tyj, qxj = jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx)
+    if obs.enabled():
+        record_transfer(tx.nbytes + ty.nbytes + qx.nbytes)
+    with obs.span("dispatch", engine="xla-tiled"):
+        out = knn_forward_tiled(
+            txj, tyj, qxj,
+            jnp.asarray(n, jnp.int32),
+            k=k, num_classes=num_classes, precision=precision,
+            query_tile=query_tile, train_tile=train_tile,
+        )
+    with obs.span("fetch", engine="xla-tiled"):
+        return np.asarray(out)[:q]
 
 
 @register("tpu")

@@ -20,6 +20,7 @@ import os
 import sys
 from typing import Optional, Sequence
 
+from knn_tpu import obs
 from knn_tpu.data.arff import load_arff
 from knn_tpu.utils.cli_format import result_line, result_json
 from knn_tpu.utils.evaluate import confusion_matrix, accuracy
@@ -102,6 +103,19 @@ def build_parser() -> argparse.ArgumentParser:
         "accuracy field",
     )
     p.add_argument("--json", action="store_true", help="emit structured JSON metrics")
+    p.add_argument(
+        "--metrics-out", default=None, metavar="FILE",
+        help="write the observability metrics document (per-phase span "
+        "totals + counters/gauges/histograms) to FILE as JSON; a .prom/.txt "
+        "suffix selects the Prometheus text exposition. Implies enabling "
+        "the knn_tpu.obs tracer for this run",
+    )
+    p.add_argument(
+        "--trace-out", default=None, metavar="FILE",
+        help="write a Chrome/Perfetto trace_event JSON of the run's nested "
+        "spans to FILE (open in chrome://tracing or ui.perfetto.dev). "
+        "Implies enabling the knn_tpu.obs tracer for this run",
+    )
     p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--warmup", action="store_true",
                    help="run once before timing (excludes compile time)")
@@ -139,13 +153,80 @@ def _dump_predictions(path: str, preds) -> bool:
         return False
 
 
+def _setup_obs(args) -> Optional[str]:
+    """Enable the span tracer when observability artifacts were requested,
+    failing fast (before any parse/compute) on unwritable destinations.
+    Returns an error message or None."""
+    if not (args.metrics_out or args.trace_out):
+        return None
+    from knn_tpu.obs.export import check_parent_dir
+
+    for path in (args.metrics_out, args.trace_out):
+        if path:
+            try:
+                check_parent_dir(path)
+            except OSError as e:
+                return str(e)
+    obs.enable()
+    obs.reset()  # artifacts describe THIS run, not ambient prior spans
+    return None
+
+
+def _phase_breakdown(classify_span) -> dict:
+    """``{phase: total_ms}`` over the direct children of the timed classify
+    region — sequential children partition the region, so the totals sum
+    to ~the headline wall time (docs/OBSERVABILITY.md)."""
+    return obs.tracer().phase_totals(classify_span)
+
+
+def _write_obs_artifacts(args, classify_span, wall_ms) -> bool:
+    """Write --trace-out / --metrics-out. Runs AFTER the result line so a
+    failed save can't discard the computed output (the --dump-predictions
+    contract)."""
+    if not (args.metrics_out or args.trace_out):
+        return True
+    from knn_tpu.obs.export import write_metrics, write_trace
+
+    try:
+        if args.trace_out:
+            write_trace(args.trace_out, obs.tracer())
+        if args.metrics_out:
+            write_metrics(
+                args.metrics_out, obs.tracer(), obs.registry(),
+                phase_parent=classify_span, wall_ms=wall_ms,
+            )
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return False
+    return True
+
+
 def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
+    """CLI entry. Observability enabled via --metrics-out/--trace-out is
+    scoped to this call: the prior global on/off state is restored on the
+    way out, so a long-lived embedder that invokes the CLI once with
+    artifacts does not keep paying tracing cost (or growing the span
+    buffer) on every later predict."""
+    was_enabled = obs.enabled()
+    try:
+        return _run(argv, stdout)
+    finally:
+        if not was_enabled and obs.enabled():
+            obs.disable()
+
+
+def _run(argv: Optional[Sequence[str]], stdout) -> int:
     stdout = stdout or sys.stdout
     parser = build_parser()
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
         return e.code if isinstance(e.code, int) else 2
+
+    obs_err = _setup_obs(args)
+    if obs_err is not None:
+        print(f"error: {obs_err}", file=sys.stderr)
+        return 1
 
     # --sweep-k argument validation happens BEFORE any backend resolution or
     # file loading: the sweep never touches a backend (so backend fallback
@@ -222,13 +303,16 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
                         engine=args.engine)
             with maybe_profile(args.trace_dir):
                 with RegionTimer() as t:
-                    preds_by_k = sweep_k(
-                        train, test, sweep_ks, metric=args.metric,
-                        engine=args.engine,
-                    )
+                    with obs.span("classify", mode="sweep",
+                                  engine=args.engine) as classify_span:
+                        preds_by_k = sweep_k(
+                            train, test, sweep_ks, metric=args.metric,
+                            engine=args.engine,
+                        )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        phases = _phase_breakdown(classify_span) if obs.enabled() else None
         base = args.dump_predictions
         if base and base.endswith(".npy"):
             base = base[:-4]
@@ -242,12 +326,16 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
             if args.json:
                 print(
                     result_json(k, test.num_instances, train.num_instances,
-                                t.ms, acc, f"sweep:{args.engine}"),
+                                t.ms, acc, f"sweep:{args.engine}",
+                                phases=phases),
                     file=stdout,
                 )
             if base:
                 if not _dump_predictions(f"{base}.k{k}.npy", preds_by_k[k]):
                     return 1
+        if not _write_obs_artifacts(args, classify_span,
+                                    round(t.ns / 1e6, 3)):
+            return 1
         return 0
 
     backend_name = args.backend or _PERSONAS[args.persona][0]
@@ -317,7 +405,9 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
             fn(train, test, args.k, **opts)
         with maybe_profile(args.trace_dir):
             with RegionTimer() as t:
-                predictions = fn(train, test, args.k, **opts)
+                with obs.span("classify",
+                              backend=backend_name) as classify_span:
+                    predictions = fn(train, test, args.k, **opts)
     except ValueError as e:  # e.g. metric unsupported by this backend
         print(f"error: {e}", file=sys.stderr)
         return 1
@@ -333,11 +423,16 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
     ):
         return 1
     if args.json:
+        phases = _phase_breakdown(classify_span) if obs.enabled() else None
         print(
             result_json(args.k, test.num_instances, train.num_instances, t.ms, acc,
-                        backend_name),
+                        backend_name, phases=phases),
             file=stdout,
         )
+    # The artifact records the precise region wall (float ms); the result
+    # line above keeps the reference's integer-floor contract.
+    if not _write_obs_artifacts(args, classify_span, round(t.ns / 1e6, 3)):
+        return 1
     return 0
 
 

@@ -49,6 +49,33 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
     ``use_native``: force the C++ parser (True), force pure Python (False), or
     auto-detect (None, default).
     """
+    from knn_tpu import obs
+
+    cached = False
+    if obs.enabled():
+        # Determine cache-hit BEFORE the load (the load itself may write
+        # the cache), so the counters can distinguish a real parse from an
+        # .npz fast path. ``cached`` is pre-initialized above because
+        # enabled() is re-read after the load and may flip mid-call.
+        c = _cache_path(path)
+        cached = bool(c is not None and c.exists())
+    with obs.span("ingest", file=os.path.basename(path)):
+        ds = _load_arff(path, use_native)
+    if obs.enabled():
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        label = "true" if cached else "false"
+        obs.counter_add("knn_ingest_bytes_total", size,
+                        help="ARFF bytes ingested (cached=true: served from "
+                             "the .npz cache, not re-parsed)", cached=label)
+        obs.counter_add("knn_ingest_rows_total", ds.num_instances,
+                        help="ARFF data rows ingested", cached=label)
+    return ds
+
+
+def _load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
     cache = _cache_path(path)
     if cache is not None and cache.exists():
         with np.load(cache, allow_pickle=False) as z:

@@ -11,6 +11,7 @@ from typing import Optional
 
 import numpy as np
 
+from knn_tpu import obs
 from knn_tpu.backends import get_backend
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.utils.evaluate import confusion_matrix, accuracy
@@ -65,10 +66,18 @@ def _kneighbors_arrays(
             raise ValueError("the stripe engine implements euclidean only")
         from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
 
-        return stripe_candidates_arrays(
-            train_x, test_x, k, precision="exact", cache=cache,
-            deferred=deferred,
-        )
+        with obs.span("distance", engine="stripe", note="fused distance+top-k"):
+            out = stripe_candidates_arrays(
+                train_x, test_x, k, precision="exact", cache=cache,
+                deferred=deferred,
+            )
+        if deferred and obs.enabled():
+            def resolve_stripe(inner=out):
+                with obs.span("fetch", engine="stripe"):
+                    return inner()
+
+            return resolve_stripe
+        return out
     from knn_tpu.ops.pallas_knn import memo_device
 
     n, q = train_x.shape[0], test_x.shape[0]
@@ -80,23 +89,30 @@ def _kneighbors_arrays(
         # retrieval never reads the gathered values.
         return jnp.asarray(tx), jnp.asarray(np.zeros(tx.shape[0], np.int32))
 
-    txj, tyj = memo_device(cache, ("xla_candidates_train", train_tile), make)
-    qx, _ = pad_axis_to_multiple(test_x, 128, axis=0)
+    with obs.span("prepare", engine="xla"):
+        txj, tyj = memo_device(
+            cache, ("xla_candidates_train", train_tile), make
+        )
+        qx, _ = pad_axis_to_multiple(test_x, 128, axis=0)
     import jax
 
-    d, i, _ = knn_forward_candidates(
-        txj, tyj, jnp.asarray(qx),
-        jnp.asarray(n, jnp.int32),
-        k=k, train_tile=train_tile, precision=form,
-    )
-    for leaf in (d, i):
-        if hasattr(leaf, "copy_to_host_async"):
-            leaf.copy_to_host_async()
+    # The fused distance + running-top-k dispatch (one executable; the two
+    # logical phases are inseparable on the XLA path — docs/OBSERVABILITY.md).
+    with obs.span("distance", engine="xla", note="fused distance+top-k"):
+        d, i, _ = knn_forward_candidates(
+            txj, tyj, jnp.asarray(qx),
+            jnp.asarray(n, jnp.int32),
+            k=k, train_tile=train_tile, precision=form,
+        )
+        for leaf in (d, i):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
 
     def resolve():
         # One batched fetch — two sequential np.asarray calls each pay a full
         # device->host round trip (~100 ms on a tunneled device).
-        d_h, i_h = jax.device_get((d, i))
+        with obs.span("fetch", engine="xla"):
+            d_h, i_h = jax.device_get((d, i))
         return d_h[:q], i_h[:q]
 
     return resolve if deferred else resolve()
@@ -215,20 +231,23 @@ def sweep_k(train: Dataset, test: Dataset, ks, metric="euclidean", engine="auto"
         raise ValueError(f"ks must be positive integers, got {sorted(ks)}")
     kmax = ks[-1]
     train.validate_for_knn(kmax, test)
-    _, idx = _kneighbors_arrays(
-        train.features, test.features, kmax, metric=metric, engine=engine,
-        cache=train.device_cache,
-    )
-    import jax
+    with obs.span("sweep_k", kmax=kmax, num_ks=len(ks)):
+        _, idx = _kneighbors_arrays(
+            train.features, test.features, kmax, metric=metric, engine=engine,
+            cache=train.device_cache,
+        )
+        import jax
 
-    labels = jnp.asarray(
-        train.labels[np.minimum(idx, train.num_instances - 1)]
-    )
-    # One batched fetch for every k's vote — per-k np.asarray would pay a
-    # device->host round trip per k (~100 ms each on a tunneled device).
-    return jax.device_get(
-        {k: vote(labels[:, :k], train.num_classes) for k in ks}
-    )
+        with obs.span("vote", num_ks=len(ks)):
+            labels = jnp.asarray(
+                train.labels[np.minimum(idx, train.num_instances - 1)]
+            )
+            # One batched fetch for every k's vote — per-k np.asarray would
+            # pay a device->host round trip per k (~100 ms each on a
+            # tunneled device).
+            return jax.device_get(
+                {k: vote(labels[:, :k], train.num_classes) for k in ks}
+            )
 
 
 class KNNClassifier:
@@ -271,8 +290,9 @@ class KNNClassifier:
         self._train: Optional[Dataset] = None
 
     def fit(self, train: Dataset) -> "KNNClassifier":
-        train.validate_for_knn(self.k)
-        self._train = train
+        with obs.span("fit", k=self.k):
+            train.validate_for_knn(self.k)
+            self._train = train
         return self
 
     @property
@@ -286,9 +306,9 @@ class KNNClassifier:
             # Weighted vote (opt-in extension; the reference vote is an
             # unweighted bincount, main.cpp:65-67): per-class inverse-distance
             # weight sums, ties to the lowest class id like the reference.
-            return np.argmax(self._weighted_class_scores(test), axis=1).astype(
-                np.int32
-            )
+            scores = self._weighted_class_scores(test)
+            with obs.span("vote", weighted=True):
+                return np.argmax(scores, axis=1).astype(np.int32)
         fn = get_backend(self.backend_name)
         return fn(self.train_, test, self.k, metric=self.metric, **self.backend_opts)
 
@@ -352,9 +372,13 @@ class KNNClassifier:
             dists, idx = resolve()
             if self.weights == "distance":
                 scores = self._weighted_class_scores(test, (dists, idx))
-                return np.argmax(scores, axis=1).astype(np.int32)
-            labels = train.labels[np.minimum(idx, train.num_instances - 1)]
-            return _host_vote(labels, train.num_classes)
+                with obs.span("vote", weighted=True):
+                    return np.argmax(scores, axis=1).astype(np.int32)
+            with obs.span("vote"):
+                labels = train.labels[
+                    np.minimum(idx, train.num_instances - 1)
+                ]
+                return _host_vote(labels, train.num_classes)
 
         return AsyncResult(finish)
 
@@ -435,12 +459,13 @@ class KNNRegressor:
         self._train: Optional[Dataset] = None
 
     def fit(self, train: Dataset) -> "KNNRegressor":
-        if self.k > train.num_instances:
-            raise ValueError(
-                f"k={self.k} exceeds the number of train instances "
-                f"({train.num_instances})"
-            )
-        self._train = train
+        with obs.span("fit", k=self.k):
+            if self.k > train.num_instances:
+                raise ValueError(
+                    f"k={self.k} exceeds the number of train instances "
+                    f"({train.num_instances})"
+                )
+            self._train = train
         return self
 
     @property

@@ -312,8 +312,12 @@ bool cell_view_to_float(const char* p, size_t len, Attr& attr, float* out,
     // hex floats, inf/nan spellings, and over/underflow (from_chars
     // reports out_of_range, strtof clamps and accepts) — so the dialect
     // is unchanged.
+#if defined(__cpp_lib_to_chars)
+    // libstdc++ < 11 declares only the integer overloads; the strtof
+    // fallback below is the whole general path there.
     auto res = std::from_chars(p, p + len, *out);
     if (res.ec == std::errc() && res.ptr == p + len) return true;
+#endif
     std::string tok(p, len);
     char* endp = nullptr;
     *out = strtof(tok.c_str(), &endp);

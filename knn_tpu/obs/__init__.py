@@ -1,0 +1,135 @@
+"""Observability subsystem: span tracer + metrics registry.
+
+The reference's entire observability story is one ``CLOCK_MONOTONIC_RAW``
+pair around the KNN loop printed as a single milliseconds number
+(main.cpp:133-144). This package replaces that with:
+
+- :mod:`knn_tpu.obs.tracer`  — nested, thread-safe wall-time spans,
+  exportable as Chrome/Perfetto ``trace_event`` JSON (chrome://tracing or
+  https://ui.perfetto.dev load the file directly);
+- :mod:`knn_tpu.obs.metrics` — counters, gauges, and fixed-bucket
+  histograms with JSON and Prometheus text exposition;
+- :mod:`knn_tpu.obs.instrument` — the helpers that weave both through the
+  model layer, the backends, and the sharded paths (collective-traffic
+  counters reusing ``parallel/comm_audit.py``'s byte model);
+- :mod:`knn_tpu.obs.export`  — file writers for ``--trace-out`` /
+  ``--metrics-out``;
+- :mod:`knn_tpu.obs.bench_timing` — the pipelined-slope measurement
+  primitives shared by ``bench.py`` and ``scripts/tune_*.py``.
+
+Everything is OFF by default and zero-cost when off: ``span()`` returns a
+shared no-op context manager and the metric helpers return immediately, so
+the default path pays one predicate per call site (measured ≤1% on the
+bench medium preset — docs/OBSERVABILITY.md). Enable programmatically with
+:func:`enable`, from the CLI with ``--metrics-out``/``--trace-out``, or
+ambiently with ``KNN_TPU_OBS=1``.
+
+The module-level :func:`span` / :func:`counter_add` / :func:`gauge_set` /
+:func:`histogram_observe` helpers operate on one process-global tracer and
+registry — instrumented library code calls those, while tests and embedders
+that want isolation construct their own :class:`SpanTracer` /
+:class:`MetricsRegistry`.
+"""
+
+from __future__ import annotations
+
+import os
+
+from knn_tpu.obs.tracer import SpanTracer, Span
+from knn_tpu.obs.metrics import MetricsRegistry, Counter, Gauge, Histogram
+
+__all__ = [
+    "SpanTracer", "Span", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "enable", "disable", "enabled", "reset", "span", "tracer", "registry",
+    "counter_add", "gauge_set", "histogram_observe",
+]
+
+_ENABLED = False
+_JAX_ANNOTATIONS = False
+
+_TRACER = SpanTracer()
+_REGISTRY = MetricsRegistry()
+
+
+class _NullSpan:
+    """The disabled-path span: one shared instance, no state, no work."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def enable(jax_annotations: bool = False) -> None:
+    """Turn the global tracer + registry on. ``jax_annotations=True``
+    additionally wraps every span in a ``jax.profiler.TraceAnnotation`` so
+    host spans line up with device timelines in a jax profiler trace."""
+    global _ENABLED, _JAX_ANNOTATIONS
+    _ENABLED = True
+    _JAX_ANNOTATIONS = bool(jax_annotations)
+    _TRACER.jax_annotations = _JAX_ANNOTATIONS
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def reset() -> None:
+    """Drop all recorded spans and metric values (state stays on/off).
+    Also clears the instrumentation layer's first-call memory so the next
+    predict per backend records ``knn_first_call_wall_ms`` again."""
+    _TRACER.reset()
+    _REGISTRY.reset()
+    from knn_tpu.obs import instrument
+
+    with instrument._first_call_lock:
+        instrument._first_call_seen.clear()
+
+
+def tracer() -> SpanTracer:
+    return _TRACER
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def span(name: str, **attrs):
+    """Context manager recording a nested span on the global tracer; a
+    shared no-op when observability is disabled."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _TRACER.span(name, **attrs)
+
+
+def counter_add(name: str, value=1, *, help: str = "", **labels) -> None:
+    if _ENABLED:
+        _REGISTRY.counter(name, help=help, **labels).add(value)
+
+
+def gauge_set(name: str, value, *, help: str = "", **labels) -> None:
+    if _ENABLED:
+        _REGISTRY.gauge(name, help=help, **labels).set(value)
+
+
+def histogram_observe(
+    name: str, value, *, buckets=None, help: str = "", **labels
+) -> None:
+    if _ENABLED:
+        _REGISTRY.histogram(name, buckets=buckets, help=help, **labels) \
+            .observe(value)
+
+
+if os.environ.get("KNN_TPU_OBS", "") not in ("", "0"):
+    enable(jax_annotations=os.environ.get("KNN_TPU_OBS") == "jax")

@@ -1,0 +1,184 @@
+"""Pipelined-slope measurement primitives.
+
+THE timing methodology shared by ``bench.py`` and every ``scripts/tune_*`` /
+``scripts/probe_*`` sweep (formerly private copies inside ``bench.py``): on
+a tunneled device each blocking host sync costs a fixed ~75-100 ms round
+trip regardless of compute, so per-step device time is measured as the
+SLOPE between two pipelined batch sizes (one drain each), with the
+stall-artifact guards the bench rounds accumulated:
+
+- :func:`timed_batch`              — one pipelined batch, one drain.
+- :func:`pipelined_slope`          — marginal seconds/dispatch from two
+  batch sizes (best-of-3 each).
+- :func:`interleaved_slope_trials` — R independent slope trials with the
+  compared cases interleaved inside each trial (device-load drift hits
+  all cases alike) and non-positive trials dropped loudly.
+- :func:`slope_trials`             — the one-case wrapper.
+- :func:`drop_superroofline`       — discard trials whose implied Tflop/s
+  beats the chip peak (host-stall artifacts by definition).
+- :func:`median` / :func:`spread`  — the summary reducers every BENCH
+  record uses.
+
+Kept dependency-free (no jax import at module level) so host-only tools
+can use it.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def timed_batch(step, bufs, reps, block_fn=None):
+    """One pipelined batch: ``reps`` dispatches cycling the distinct buffer
+    pool, one drain, wall seconds. ``block_fn(out)`` drains; the default
+    pulls the (first) output to host via np.asarray (jax.block_until_ready
+    proved unreliable on the tunneled device). THE timing primitive — the
+    slope estimators and the tuning scripts all ride it so their ms/step
+    numbers stay methodology-comparable."""
+    if block_fn is None:
+        import numpy as np
+
+        def block_fn(out):
+            np.asarray(out if not isinstance(out, (tuple, list)) else out[0])
+
+    t0 = time.monotonic()
+    out = None
+    for i in range(reps):
+        out = step(bufs[i % len(bufs)])
+    block_fn(out)
+    return time.monotonic() - t0
+
+
+def pipelined_slope(mkstep, bufs, r_lo, r_hi, block_fn=None):
+    """Marginal per-dispatch seconds: time r_lo and r_hi pipelined dispatches
+    (one drain each, best of 3) and take the slope — subtracts the fixed
+    host-sync/tunnel round-trip that has nothing to do with device compute.
+    Returns ``(per_step_seconds, fixed_overhead_seconds)``."""
+    def timed(reps):
+        return min(
+            timed_batch(mkstep, bufs, reps, block_fn) for _ in range(3)
+        )
+
+    t_lo, t_hi = timed(r_lo), timed(r_hi)
+    per_step = (t_hi - t_lo) / (r_hi - r_lo)
+    return per_step, t_lo - r_lo * per_step
+
+
+def interleaved_slope_trials(cases, r_lo, r_hi, trials=5, rounds=2):
+    """Per-case slope TRIALS with the cases INTERLEAVED inside each trial:
+    every round times each case once at r_lo and r_hi dispatches before the
+    next round starts, so device-load drift (observed ~1.5x run-to-run on
+    the tunneled v5e) hits all cases alike instead of erasing a comparison
+    measured minutes apart. Within a trial the slope is taken between the
+    per-batch-size MINIMA over ``rounds`` rounds — NOT between paired
+    single timings, which a load spike during the r_lo batch would bias
+    low (fast), exactly the trials a min-of-R summary then cherry-picks.
+    ``cases`` maps name -> (step_fn, bufs); returns name -> list of
+    per-step seconds, one per trial (run order preserved). Batch order
+    alternates (lo,hi)/(hi,lo) per round so a position-correlated stall
+    (tunnel hiccup, GC) cannot systematically inflate one batch size —
+    an inflated t_lo reads as an impossibly FAST slope (observed beating
+    the chip's bf16 roofline), which a min-of-trials summary then
+    selects. Consumers should treat the MEDIAN as the central estimate
+    and sanity-check any min against the roofline."""
+    out = {name: [] for name in cases}
+    for _ in range(trials):
+        lo = {name: float("inf") for name in cases}
+        hi = {name: float("inf") for name in cases}
+        for r in range(rounds):
+            for name, (step, bufs) in cases.items():
+                if r % 2 == 0:
+                    lo[name] = min(lo[name], timed_batch(step, bufs, r_lo))
+                    hi[name] = min(hi[name], timed_batch(step, bufs, r_hi))
+                else:
+                    hi[name] = min(hi[name], timed_batch(step, bufs, r_hi))
+                    lo[name] = min(lo[name], timed_batch(step, bufs, r_lo))
+        for name in cases:
+            out[name].append((hi[name] - lo[name]) / (r_hi - r_lo))
+    # A load spike spanning every r_lo batch of a trial can push that
+    # trial's slope to <= 0; min() would then select the garbage and turn
+    # the whole record negative. Drop such trials loudly; a session where
+    # EVERY trial is non-positive has no usable signal at all.
+    for name, vals in out.items():
+        good = [v for v in vals if v > 0]
+        if not good:
+            raise RuntimeError(
+                f"all {len(vals)} slope trials for {name!r} are non-positive "
+                f"({vals}); device load noise swamped the measurement"
+            )
+        if len(good) < len(vals):
+            _log(f"dropped {len(vals) - len(good)} non-positive slope "
+                 f"trial(s) for {name!r}: {vals}")
+            from knn_tpu import obs
+
+            obs.counter_add(
+                "bench_nonpositive_trials_dropped_total",
+                len(vals) - len(good),
+                help="slope trials discarded for non-positive slope "
+                     "(device-load spikes during the r_lo batch)",
+            )
+        out[name] = good
+    return out
+
+
+def slope_trials(step, bufs, r_lo, r_hi, trials=5, inner=2):
+    """R independent slope estimates for ONE case (VERDICT r3 #1: one number
+    per session made every regression-vs-variance call guesswork). Thin
+    wrapper over interleaved_slope_trials — see there for the
+    slope-of-minima rationale and the non-positive-trial guard."""
+    return interleaved_slope_trials(
+        {"case": (step, bufs)}, r_lo, r_hi, trials=trials, rounds=inner,
+    )["case"]
+
+
+def drop_superroofline(trials_s, flops, peak_tf=207.0):
+    """Drop slope trials whose implied Tflop/s exceeds the chip's peak —
+    nothing computes faster than the hardware, so such a trial is a
+    measurement artifact by definition (a host stall inflating the r_lo
+    batch reads as an impossibly fast slope; observed 247-412 "Tflop/s"
+    on a 197-peak chip, and in one r5 session 3 of 5 trials stalled this
+    way and poisoned the MEDIAN too). ``peak_tf`` is the v5e bf16 peak
+    plus 5% margin. Returns the surviving trials; if none survive, the
+    raw list comes back (no signal beats fake signal, and the consumer's
+    min/median at least stays visibly absurd)."""
+    good = [s for s in trials_s if flops / s / 1e12 <= peak_tf]
+    if good and len(good) < len(trials_s):
+        _log(f"dropped {len(trials_s) - len(good)} super-roofline slope "
+             f"trial(s): {[round(flops / s / 1e12) for s in trials_s]} "
+             f"Tflop/s")
+        from knn_tpu import obs
+
+        obs.counter_add(
+            "bench_superroofline_trials_dropped_total",
+            len(trials_s) - len(good),
+            help="slope trials discarded for implying > chip-peak Tflop/s "
+                 "(host-stall artifacts)",
+        )
+    return good or trials_s
+
+
+def median(trials):
+    srt = sorted(trials)
+    m = len(srt)
+    return srt[m // 2] if m % 2 else (srt[m // 2 - 1] + srt[m // 2]) / 2
+
+
+def spread(trials_s, scale=1e3, digits=3):
+    """Summary fields for a list of per-trial per-step seconds: best (min),
+    median, and the full list, in milliseconds. The MEDIAN is the central
+    estimate every headline value derives from (r4: minority stall-biased
+    trials produced minima past the chip's roofline — see
+    interleaved_slope_trials); the min and full list stay recorded so
+    stability and best-case are visible."""
+    ms = [s * scale for s in trials_s]
+    return {
+        "step_ms": round(min(ms), digits),
+        "step_ms_median": round(median(ms), digits),
+        # run order preserved so drift across a session stays visible
+        "step_ms_trials": [round(v, digits) for v in ms],
+    }

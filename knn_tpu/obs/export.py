@@ -1,0 +1,89 @@
+"""File writers for the observability artifacts.
+
+``write_trace`` emits the Chrome/Perfetto ``trace_event`` JSON;
+``write_metrics`` emits either the combined JSON document (the
+``--metrics-out`` payload: metric values + span aggregates + the per-phase
+breakdown) or, for ``.prom``/``.txt`` paths, the Prometheus text format.
+Both validate the destination directory up front so a bad path fails with
+a clean error before any compute is discarded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from knn_tpu.obs.metrics import MetricsRegistry
+from knn_tpu.obs.tracer import Span, SpanTracer
+
+
+def check_parent_dir(path: str) -> None:
+    """Raise OSError (with a clean message) when ``path``'s directory is
+    missing or not writable — called up front by the CLI so a bad
+    ``--metrics-out`` / ``--trace-out`` fails before any compute runs."""
+    from knn_tpu.utils.timing import ensure_writable_dir
+
+    ensure_writable_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def write_trace(path: str, tracer: SpanTracer) -> None:
+    """Write the tracer's spans as Perfetto-loadable trace JSON."""
+    check_parent_dir(path)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(tracer.to_chrome_trace(), f)
+        f.write("\n")
+
+
+def metrics_document(
+    tracer: SpanTracer,
+    registry: MetricsRegistry,
+    phase_parent: Optional[Span] = None,
+    wall_ms: Optional[float] = None,
+) -> dict:
+    """The combined metrics JSON document.
+
+    ``phases`` aggregates the direct children of ``phase_parent`` (the
+    timed classify region in the CLI) — sequential children partition the
+    region, so their ``total_ms`` values sum to ~the region's wall time.
+    ``spans`` aggregates every completed span by name; ``metrics`` is the
+    registry dump. ``wall_ms`` records the caller's headline number so the
+    document is self-contained.
+    """
+    doc = {
+        "spans": tracer.aggregate(),
+        "metrics": registry.to_json(),
+    }
+    if tracer.dropped:
+        # The buffer cap truncated recording; say so rather than letting
+        # the aggregates read as complete.
+        doc["spans_dropped"] = tracer.dropped
+    if phase_parent is not None:
+        # Flat {phase: total_ms} — the same shape the CLI's --json "phases"
+        # key carries (one definition: SpanTracer.phase_totals), so the two
+        # artifacts compare with plain equality and sum(phases.values()) is
+        # the region's covered wall time.
+        doc["phases"] = tracer.phase_totals(phase_parent)
+    if wall_ms is not None:
+        doc["wall_ms"] = wall_ms
+    return doc
+
+
+def write_metrics(
+    path: str,
+    tracer: SpanTracer,
+    registry: MetricsRegistry,
+    phase_parent: Optional[Span] = None,
+    wall_ms: Optional[float] = None,
+) -> None:
+    """Write the metrics document; ``.prom``/``.txt`` suffixes select the
+    Prometheus text exposition instead of JSON."""
+    check_parent_dir(path)
+    if path.endswith((".prom", ".txt")):
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(registry.to_prometheus())
+        return
+    doc = metrics_document(tracer, registry, phase_parent, wall_ms)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")

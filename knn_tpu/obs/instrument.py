@@ -1,0 +1,120 @@
+"""Instrumentation weave: the helpers that put spans + metrics into the
+model layer, the backends, and the sharded paths.
+
+Backend instrumentation happens at the registry (``backends/__init__.py``
+wraps every registered predict fn with :func:`observed_backend`), so every
+backend — including the sharded ones — uniformly reports:
+
+- ``knn_predict_calls_total{backend=...}``   calls through the registry
+- ``knn_queries_total{backend=...}``         query rows classified
+- ``knn_predict_wall_ms{backend=...}``       per-call wall histogram
+- ``knn_predict_qps{backend=...}``           last call's queries/s gauge
+- ``knn_first_call_wall_ms{backend=...}``    first-call wall (compile +
+  dispatch upper bound — XLA compiles on first dispatch, so this is the
+  honest "compile ms" a host-side tracer can report without jax internals)
+
+plus a ``predict`` span wrapping the call. The collective-traffic helpers
+turn ``parallel/comm_audit.py``'s analytic byte model into live counters
+(``knn_collective_bytes_total{path=...,op=...}``): the sharded predict
+entries compute the model bytes for the call they are about to dispatch
+and record them here, so the static StableHLO audit and the runtime
+counter can be cross-checked for exact equality (tests/test_obs.py).
+
+Everything here is a no-op while ``knn_tpu.obs`` is disabled.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+from knn_tpu import obs
+
+# Wall-time histogram ladder for predict calls: sub-ms cached dispatches
+# through multi-minute first-call compiles.
+PREDICT_MS_BUCKETS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0,
+    5000.0, 15000.0, 60000.0,
+)
+
+_first_call_lock = threading.Lock()
+_first_call_seen = set()
+
+
+def observed_backend(name: str, fn):
+    """Wrap a backend predict fn with a span + the per-backend metrics."""
+
+    @functools.wraps(fn)
+    def wrapped(train, test, k, *args, **kwargs):
+        if not obs.enabled():
+            return fn(train, test, k, *args, **kwargs)
+        q = getattr(test, "num_instances", None)
+        t0 = time.monotonic()
+        with obs.span("predict", backend=name, k=k):
+            out = fn(train, test, k, *args, **kwargs)
+        wall_ms = (time.monotonic() - t0) * 1e3
+        with _first_call_lock:
+            first = name not in _first_call_seen
+            _first_call_seen.add(name)
+        obs.counter_add(
+            "knn_predict_calls_total", 1,
+            help="predict calls through the backend registry", backend=name,
+        )
+        if first:
+            obs.gauge_set(
+                "knn_first_call_wall_ms", round(wall_ms, 3),
+                help="first predict call wall ms (compile + dispatch upper "
+                     "bound)", backend=name,
+            )
+        else:
+            obs.histogram_observe(
+                "knn_predict_wall_ms", wall_ms, buckets=PREDICT_MS_BUCKETS,
+                help="predict call wall ms (post-first-call)", backend=name,
+            )
+        if q:
+            obs.counter_add(
+                "knn_queries_total", int(q),
+                help="query rows classified", backend=name,
+            )
+            if wall_ms > 0:
+                obs.gauge_set(
+                    "knn_predict_qps", round(q / (wall_ms / 1e3), 1),
+                    help="last predict call's steady-state queries/s",
+                    backend=name,
+                )
+        return out
+
+    wrapped.__wrapped_backend__ = fn
+    return wrapped
+
+
+def record_transfer(nbytes: int, direction: str = "h2d",
+                    backend: str = "tpu") -> None:
+    """Count host<->device payload bytes (the arrays a predict call moves)."""
+    if nbytes:
+        obs.counter_add(
+            "knn_transfer_bytes_total", int(nbytes),
+            help="host<->device payload bytes moved by predict calls",
+            direction=direction, backend=backend,
+        )
+
+
+def record_collective(path: str, op: str, nbytes: int) -> None:
+    """Count modeled collective-traffic bytes for one sharded predict call.
+
+    ``nbytes`` must come from the matching ``parallel/comm_audit.py`` model
+    fn (``model_train_sharded_bytes`` / ``model_ring_bytes`` /
+    ``model_query_sharded_bytes``) so the runtime counter and the static
+    lowering audit agree exactly.
+    """
+    if nbytes:
+        obs.counter_add(
+            "knn_collective_bytes_total", int(nbytes),
+            help="modeled collective payload bytes on the sharded paths "
+                 "(comm_audit byte model)", path=path, op=op,
+        )
+    obs.counter_add(
+        "knn_collective_calls_total", 1,
+        help="sharded predict dispatches", path=path, op=op,
+    )

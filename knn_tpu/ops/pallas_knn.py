@@ -43,6 +43,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (~0.4.38); accept both.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 from knn_tpu.utils.padding import pad_axis_to_multiple
 from knn_tpu.utils.windowed import windowed_dispatch
 
@@ -259,7 +264,7 @@ def knn_pallas_candidates(
             jax.ShapeDtypeStruct((q_pad, k), jnp.float32),
             jax.ShapeDtypeStruct((q_pad, k), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -584,7 +589,7 @@ def knn_pallas_stripe_candidates(
             jax.ShapeDtypeStruct((q_pad, k * 128), jnp.float32),
             jax.ShapeDtypeStruct((q_pad, k * 128), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "arbitrary"),
             # v5e has 128 MB of VMEM; the 16 MB scoped default is what XLA's
             # output-placement heuristic budgets against, and it flips the

@@ -63,6 +63,41 @@ def summarize(ops) -> str:
     )
 
 
+# --- The analytic byte model -------------------------------------------
+#
+# ONE definition serving two consumers: the static StableHLO audits below
+# assert the lowering against it, and the live collective-traffic counters
+# (knn_tpu/obs/instrument.py::record_collective, called from the sharded
+# predict entries) record the same numbers at runtime — so
+# ``knn_collective_bytes_total`` can be cross-checked for EXACT equality
+# with the spec (tests/test_obs.py).
+
+
+def model_train_sharded_bytes(q_local: int, k: int, n_t: int) -> int:
+    """Post-gather candidate buffer bytes per device per call: three
+    all-gathers (distances f32, global indices i32, labels i32), each
+    ``[q_local, k * n_t]`` — the Gatherv analogue of mpi.cpp:186."""
+    return q_local * k * n_t * (4 + 4 + 4)
+
+
+def model_ring_bytes(shard_bytes: int, label_bytes: int, n_dev: int) -> int:
+    """Bytes moved per device per ring call: the resident train shard + its
+    labels permute once per scan step, P-1 steps."""
+    return (shard_bytes + label_bytes) * (n_dev - 1)
+
+
+def model_query_sharded_bytes(q_pad: int, d: int,
+                              feat_bytes: int = 4,
+                              pred_bytes: int = 4) -> int:
+    """Data-movement spec of the query-sharded path: no collective runs in
+    the shard_map body (train is replicated up front, exactly as every MPI
+    rank loads both files — mpi.cpp:136-139); what crosses the wire per
+    call is the scatter of the padded query block in (the in_spec ==
+    MPI_Scatter) and the gather of the predictions out (the out_spec ==
+    MPI_Gatherv)."""
+    return q_pad * d * feat_bytes + q_pad * pred_bytes
+
+
 def audit_train_sharded(lowered_text: str, q_local: int, k: int, n_t: int):
     """Assert the train-sharded lowering's collectives match the model:
     exactly three all-gathers (d, i, l) of ``[q_local, k*n_t]`` 4-byte
@@ -87,7 +122,7 @@ def audit_train_sharded(lowered_text: str, q_local: int, k: int, n_t: int):
                 f"all-gather shape {shape} != model ({q_local}, {k * n_t})"
             )
     measured = sum(o[3] for o in gathers)
-    expected = q_local * k * n_t * (4 + 4 + 4)
+    expected = model_train_sharded_bytes(q_local, k, n_t)
     if measured != expected:
         raise AssertionError(f"gathered bytes {measured} != model {expected}")
     return measured, expected
@@ -117,4 +152,6 @@ def audit_ring(lowered_text: str, shard_bytes: int, label_bytes: int, n_dev: int
             f"ring per-step payload {per_step}B != model {expected_step}B "
             f"({summarize(permutes)})"
         )
-    return per_step * (n_dev - 1), expected_step * (n_dev - 1)
+    return per_step * (n_dev - 1), model_ring_bytes(
+        shard_bytes, label_bytes, n_dev
+    )

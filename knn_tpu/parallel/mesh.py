@@ -17,6 +17,27 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across the supported jax range: the top-level name
+    when present, else the 0.4.x ``jax.experimental.shard_map`` spelling.
+    The replication-check knob is introspected because its rename
+    (``check_rep`` -> ``check_vma``) postdates the top-level promotion —
+    some versions have ``jax.shard_map(..., check_rep=...)``."""
+    import inspect
+
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as fn
+    params = inspect.signature(fn).parameters
+    knob = "check_vma" if "check_vma" in params else "check_rep"
+    return fn(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{knob: check_vma},
+    )
+
+
+
 def maybe_init_distributed() -> None:
     """Initialize multi-host JAX when launched under a cluster runtime.
 

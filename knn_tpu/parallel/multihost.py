@@ -95,7 +95,9 @@ def _global_fn_from_per_shard(per_shard):
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()), axis_names=("q",))
-    sharded = jax.shard_map(
+    from knn_tpu.parallel.mesh import shard_map_compat
+
+    sharded = shard_map_compat(
         per_shard,
         mesh=mesh,
         in_specs=(P(), P(), P("q"), P()),

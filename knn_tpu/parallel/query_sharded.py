@@ -19,10 +19,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from knn_tpu import obs
 from knn_tpu.backends import register
 from knn_tpu.backends.tpu import forward_tiled_core
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.parallel.mesh import make_mesh
+from knn_tpu.obs.instrument import record_collective
+from knn_tpu.parallel.mesh import make_mesh, shard_map_compat
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
 
@@ -49,7 +51,7 @@ def build_query_sharded_fn(
             query_tile=query_tile, train_tile=train_tile,
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         per_shard,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P()),
@@ -138,7 +140,7 @@ def build_query_sharded_stripe_fn(
         assume_finite,
     )
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         per_shard,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P()),
@@ -175,27 +177,37 @@ def _predict_query_sharded_stripe(
     mesh=None, block_q=None, block_n=None, interpret=None,
 ):
     q, n = test_x.shape[0], train_x.shape[0]
-    txT, ty, qx, block_q, block_n, interpret, assume_finite = (
-        stripe_query_sharded_prep(
-            train_x, train_y, test_x, k, n_dev, interpret,
-            block_q=block_q, block_n=block_n, precision=precision,
+    with obs.span("prepare", path="query-sharded", engine="stripe"):
+        txT, ty, qx, block_q, block_n, interpret, assume_finite = (
+            stripe_query_sharded_prep(
+                train_x, train_y, test_x, k, n_dev, interpret,
+                block_q=block_q, block_n=block_n, precision=precision,
+            )
         )
-    )
-    if mesh is not None:
-        fn = build_query_sharded_stripe_fn(
-            mesh, k, num_classes, precision, block_q, block_n,
-            train_x.shape[1], interpret, assume_finite=assume_finite,
+        if mesh is not None:
+            fn = build_query_sharded_stripe_fn(
+                mesh, k, num_classes, precision, block_q, block_n,
+                train_x.shape[1], interpret, assume_finite=assume_finite,
+            )
+        else:
+            fn = _cached_stripe_fn(
+                n_dev, k, num_classes, precision, block_q, block_n,
+                train_x.shape[1], interpret, assume_finite,
+            )
+    if obs.enabled():
+        from knn_tpu.parallel.comm_audit import model_query_sharded_bytes
+
+        record_collective(
+            "query-sharded", "scatter_gather",
+            model_query_sharded_bytes(qx.shape[0], qx.shape[1]),
         )
-    else:
-        fn = _cached_stripe_fn(
-            n_dev, k, num_classes, precision, block_q, block_n,
-            train_x.shape[1], interpret, assume_finite,
+    with obs.span("dispatch", path="query-sharded", engine="stripe"):
+        out = fn(
+            jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
+            jnp.asarray(n, jnp.int32),
         )
-    out = fn(
-        jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
-        jnp.asarray(n, jnp.int32),
-    )
-    return np.asarray(out)[:q]
+    with obs.span("fetch", path="query-sharded"):
+        return np.asarray(out)[:q]
 
 
 def predict_query_sharded(
@@ -224,23 +236,35 @@ def predict_query_sharded(
             mesh=mesh, interpret=interpret,
         )
     q = test_x.shape[0]
-    train_tile = max(min(train_tile, train_x.shape[0]), k)
-    if mesh is not None:
-        n_dev = mesh.shape["q"]
-        fn = build_query_sharded_fn(
-            mesh, k, num_classes, precision, query_tile, train_tile
+    with obs.span("prepare", path="query-sharded", engine="xla"):
+        train_tile = max(min(train_tile, train_x.shape[0]), k)
+        if mesh is not None:
+            n_dev = mesh.shape["q"]
+            fn = build_query_sharded_fn(
+                mesh, k, num_classes, precision, query_tile, train_tile
+            )
+        else:
+            n_dev = num_devices or len(jax.devices())
+            fn = _cached_fn(
+                n_dev, k, num_classes, precision, query_tile, train_tile
+            )
+        qx, _ = pad_axis_to_multiple(test_x, n_dev * query_tile, axis=0)
+        tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
+        ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
+    if obs.enabled():
+        from knn_tpu.parallel.comm_audit import model_query_sharded_bytes
+
+        record_collective(
+            "query-sharded", "scatter_gather",
+            model_query_sharded_bytes(qx.shape[0], qx.shape[1]),
         )
-    else:
-        n_dev = num_devices or len(jax.devices())
-        fn = _cached_fn(n_dev, k, num_classes, precision, query_tile, train_tile)
-    qx, _ = pad_axis_to_multiple(test_x, n_dev * query_tile, axis=0)
-    tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
-    ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
-    out = fn(
-        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
-        jnp.asarray(train_x.shape[0], jnp.int32),
-    )
-    return np.asarray(out)[:q]
+    with obs.span("dispatch", path="query-sharded", engine="xla"):
+        out = fn(
+            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+            jnp.asarray(train_x.shape[0], jnp.int32),
+        )
+    with obs.span("fetch", path="query-sharded"):
+        return np.asarray(out)[:q]
 
 
 @register("tpu-sharded")

@@ -36,13 +36,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from knn_tpu import obs
 from knn_tpu.backends import register
 from knn_tpu.backends.tpu import forward_candidates_core
 from knn_tpu.data.dataset import Dataset
+from knn_tpu.obs.instrument import record_collective
 from knn_tpu.ops.distance import _DIST_FNS
 from knn_tpu.ops.topk import merge_topk_labeled
 from knn_tpu.ops.vote import vote
-from knn_tpu.parallel.mesh import make_mesh
+from knn_tpu.parallel.mesh import make_mesh, shard_map_compat
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
 # [q_local, shard_rows] cells above which ``engine="auto"`` abandons the
@@ -164,7 +166,7 @@ def build_ring_fn(
         return vote(run_l, num_classes)
 
     train_spec = P(None, axis) if engine == "stripe" else P(axis)
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         per_shard,
         mesh=mesh,
         in_specs=(train_spec, P(axis), P(axis), P()),
@@ -216,44 +218,72 @@ def predict_ring(
             stripe_inputs_finite, stripe_prepare_sharded,
         )
 
-        txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
-            train_x, train_y, test_x, k, n_dev, n_dev,
-            precision=precision,
-        )
+        with obs.span("prepare", path="ring", engine="stripe"):
+            txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
+                train_x, train_y, test_x, k, n_dev, n_dev,
+                precision=precision,
+            )
+            fn = _cached_fn(
+                n_dev, k, num_classes, precision, "stripe", query_tile,
+                train_tile, block_q, block_n, d, interpret,
+                stripe_inputs_finite(train_x, test_x),
+            )
+        if obs.enabled():
+            from knn_tpu.parallel.comm_audit import model_ring_bytes
+
+            shard_cols = txT.shape[1] // n_dev
+            record_collective(
+                "ring", "collective_permute",
+                model_ring_bytes(
+                    txT.shape[0] * shard_cols * txT.itemsize,
+                    shard_cols * ty.itemsize, n_dev,
+                ),
+            )
+        with obs.span("dispatch", path="ring", engine="stripe"):
+            out = fn(
+                jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
+                jnp.asarray(n, jnp.int32),
+            )
+        with obs.span("fetch", path="ring"):
+            return np.asarray(out)[:q]
+
+    with obs.span("prepare", path="ring", engine=engine):
+        if engine == "tiled":
+            shard_quota = -(-n // n_dev)  # ceil train rows per shard
+            train_tile = max(min(train_tile, shard_quota), 1)
+            shard_rows = -(-shard_quota // train_tile) * train_tile
+            q_quota = -(-q // n_dev)  # ceil queries per shard
+            query_tile = max(8, min(query_tile, -(-q_quota // 8) * 8))
+            q_local = -(-q_quota // query_tile) * query_tile
+            tx, _ = pad_axis_to_multiple(train_x, shard_rows * n_dev, axis=0)
+            ty, _ = pad_axis_to_multiple(train_y, shard_rows * n_dev, axis=0)
+            qx, _ = pad_axis_to_multiple(test_x, q_local * n_dev, axis=0)
+        else:  # full
+            tx, _ = pad_axis_to_multiple(train_x, n_dev, axis=0)
+            ty, _ = pad_axis_to_multiple(train_y, n_dev, axis=0)
+            qx, _ = pad_axis_to_multiple(test_x, n_dev, axis=0)
         fn = _cached_fn(
-            n_dev, k, num_classes, precision, "stripe", query_tile,
-            train_tile, block_q, block_n, d, interpret,
-            stripe_inputs_finite(train_x, test_x),
+            n_dev, k, num_classes, precision, engine, query_tile, train_tile,
+            448, 2048, d, interpret,
         )
+    if obs.enabled():
+        from knn_tpu.parallel.comm_audit import model_ring_bytes
+
+        shard_rows_eff = tx.shape[0] // n_dev
+        record_collective(
+            "ring", "collective_permute",
+            model_ring_bytes(
+                shard_rows_eff * tx.shape[1] * tx.itemsize,
+                shard_rows_eff * ty.itemsize, n_dev,
+            ),
+        )
+    with obs.span("dispatch", path="ring", engine=engine):
         out = fn(
-            jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
+            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
         )
+    with obs.span("fetch", path="ring"):
         return np.asarray(out)[:q]
-
-    if engine == "tiled":
-        shard_quota = -(-n // n_dev)  # ceil train rows per shard
-        train_tile = max(min(train_tile, shard_quota), 1)
-        shard_rows = -(-shard_quota // train_tile) * train_tile
-        q_quota = -(-q // n_dev)  # ceil queries per shard
-        query_tile = max(8, min(query_tile, -(-q_quota // 8) * 8))
-        q_local = -(-q_quota // query_tile) * query_tile
-        tx, _ = pad_axis_to_multiple(train_x, shard_rows * n_dev, axis=0)
-        ty, _ = pad_axis_to_multiple(train_y, shard_rows * n_dev, axis=0)
-        qx, _ = pad_axis_to_multiple(test_x, q_local * n_dev, axis=0)
-    else:  # full
-        tx, _ = pad_axis_to_multiple(train_x, n_dev, axis=0)
-        ty, _ = pad_axis_to_multiple(train_y, n_dev, axis=0)
-        qx, _ = pad_axis_to_multiple(test_x, n_dev, axis=0)
-    fn = _cached_fn(
-        n_dev, k, num_classes, precision, engine, query_tile, train_tile,
-        448, 2048, d, interpret,
-    )
-    out = fn(
-        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
-        jnp.asarray(n, jnp.int32),
-    )
-    return np.asarray(out)[:q]
 
 
 @register("tpu-ring")

@@ -21,11 +21,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from knn_tpu import obs
 from knn_tpu.backends import register
 from knn_tpu.backends.tpu import forward_candidates_core
 from knn_tpu.data.dataset import Dataset
+from knn_tpu.obs.instrument import record_collective
 from knn_tpu.ops.vote import vote
-from knn_tpu.parallel.mesh import make_mesh, make_mesh_2d, default_mesh_shape
+from knn_tpu.parallel.mesh import make_mesh, make_mesh_2d, default_mesh_shape, shard_map_compat
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
 
@@ -106,7 +108,7 @@ def build_train_sharded_fn(
         all_l = lax.all_gather(lbl, t_axis, axis=1, tiled=True)
         return merge_candidates_vote(all_d, all_i, all_l, k, num_classes)
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         per_shard,
         mesh=mesh,
         in_specs=(P(t_axis), P(t_axis), q_spec, P()),
@@ -159,7 +161,7 @@ def build_train_sharded_stripe_fn(
         all_l = lax.all_gather(lbl, t_axis, axis=1, tiled=True)
         return merge_candidates_vote(all_d, all_i, all_l, k, num_classes)
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         per_shard,
         mesh=mesh,
         # Train is sharded over its column (row-index) axis because it is
@@ -202,19 +204,29 @@ def _predict_train_sharded_stripe(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     q, n = test_x.shape[0], train_x.shape[0]
-    txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
-        train_x, train_y, test_x, k, n_t, n_q,
-        block_q=block_q, block_n=block_n, precision=precision,
-    )
-    fn = _cached_stripe_fn(
-        n_q, n_t, k, num_classes, precision, block_q, block_n,
-        train_x.shape[1], interpret, stripe_inputs_finite(train_x, test_x),
-    )
-    out = fn(
-        jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
-        jnp.asarray(n, jnp.int32),
-    )
-    return np.asarray(out)[:q]
+    with obs.span("prepare", path="train-sharded", engine="stripe"):
+        txT, ty, qx, block_q, block_n = stripe_prepare_sharded(
+            train_x, train_y, test_x, k, n_t, n_q,
+            block_q=block_q, block_n=block_n, precision=precision,
+        )
+        fn = _cached_stripe_fn(
+            n_q, n_t, k, num_classes, precision, block_q, block_n,
+            train_x.shape[1], interpret, stripe_inputs_finite(train_x, test_x),
+        )
+    if obs.enabled():
+        from knn_tpu.parallel.comm_audit import model_train_sharded_bytes
+
+        record_collective(
+            "train-sharded", "all_gather",
+            model_train_sharded_bytes(qx.shape[0] // n_q, k, n_t),
+        )
+    with obs.span("dispatch", path="train-sharded", engine="stripe"):
+        out = fn(
+            jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
+            jnp.asarray(n, jnp.int32),
+        )
+    with obs.span("fetch", path="train-sharded"):
+        return np.asarray(out)[:q]
 
 
 def predict_train_sharded(
@@ -247,18 +259,30 @@ def predict_train_sharded(
         )
 
     q = test_x.shape[0]
-    train_tile, shard_rows = xla_shard_layout(
-        train_x.shape[0], n_t, train_tile, k
-    )
-    tx, _ = pad_axis_to_multiple(train_x, shard_rows * n_t, axis=0)
-    ty, _ = pad_axis_to_multiple(train_y, shard_rows * n_t, axis=0)
-    qx, _ = pad_axis_to_multiple(test_x, n_q * query_tile, axis=0)
-    fn = _cached_fn(n_q, n_t, k, num_classes, precision, query_tile, train_tile)
-    out = fn(
-        jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
-        jnp.asarray(train_x.shape[0], jnp.int32),
-    )
-    return np.asarray(out)[:q]
+    with obs.span("prepare", path="train-sharded", engine="xla"):
+        train_tile, shard_rows = xla_shard_layout(
+            train_x.shape[0], n_t, train_tile, k
+        )
+        tx, _ = pad_axis_to_multiple(train_x, shard_rows * n_t, axis=0)
+        ty, _ = pad_axis_to_multiple(train_y, shard_rows * n_t, axis=0)
+        qx, _ = pad_axis_to_multiple(test_x, n_q * query_tile, axis=0)
+        fn = _cached_fn(
+            n_q, n_t, k, num_classes, precision, query_tile, train_tile
+        )
+    if obs.enabled():
+        from knn_tpu.parallel.comm_audit import model_train_sharded_bytes
+
+        record_collective(
+            "train-sharded", "all_gather",
+            model_train_sharded_bytes(qx.shape[0] // n_q, k, n_t),
+        )
+    with obs.span("dispatch", path="train-sharded", engine="xla"):
+        out = fn(
+            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+            jnp.asarray(train_x.shape[0], jnp.int32),
+        )
+    with obs.span("fetch", path="train-sharded"):
+        return np.asarray(out)[:q]
 
 
 @register("tpu-train-sharded")

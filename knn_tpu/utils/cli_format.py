@@ -20,14 +20,19 @@ def result_line(k: int, num_test: int, num_train: int, ms: int, acc: float) -> s
 
 
 def result_json(k: int, num_test: int, num_train: int, ms: int, acc: float,
-                backend: str) -> str:
-    return json.dumps(
-        {
-            "k": k,
-            "num_test": num_test,
-            "num_train": num_train,
-            "ms": ms,
-            "accuracy": round(acc, 6),
-            "backend": backend,
-        }
-    )
+                backend: str, phases: "dict | None" = None) -> str:
+    """``phases`` (present when the obs tracer is on) carries the per-phase
+    span totals of the timed region in milliseconds — the same numbers
+    ``--metrics-out`` writes under ``"phases"``, so the two artifacts can
+    be cross-checked (tests/test_obs.py)."""
+    rec = {
+        "k": k,
+        "num_test": num_test,
+        "num_train": num_train,
+        "ms": ms,
+        "accuracy": round(acc, 6),
+        "backend": backend,
+    }
+    if phases is not None:
+        rec["phases"] = phases
+    return json.dumps(rec)

@@ -1,19 +1,44 @@
 """Region timing, mirroring the reference's CLOCK_MONOTONIC_RAW pair around the
 KNN region only — parsing excluded (main.cpp:133-137). Also exposes an opt-in
-``jax.profiler`` trace for TPU runs (SURVEY.md §5.1)."""
+``jax.profiler`` trace for TPU runs (SURVEY.md §5.1). Fine-grained phase
+timing lives in :mod:`knn_tpu.obs` — this module keeps only the headline
+region clock the reference-parity result line reports."""
 
 from __future__ import annotations
 
 import contextlib
+import os
+import tempfile
 import time
 from typing import Optional
+
+
+def ensure_writable_dir(d: str, create: bool = False) -> None:
+    """Raise OSError when directory ``d`` is missing (unless ``create``) or
+    not writable. The probe file gets a per-process unique name (tempfile)
+    so concurrent probers of one directory cannot race each other's
+    cleanup. ONE definition — shared by :func:`maybe_profile` and
+    ``knn_tpu/obs/export.py::check_parent_dir``."""
+    if create:
+        os.makedirs(d, exist_ok=True)
+    elif not os.path.isdir(d):
+        raise OSError(f"directory does not exist: {d!r}")
+    with tempfile.NamedTemporaryFile(
+        dir=d, prefix=".knn_tpu_write_probe_"
+    ):
+        pass
 
 
 class RegionTimer:
     """``with RegionTimer() as t: ...`` then ``t.ms`` (integer ms, matching the
     reference's ns→ms integer division, main.cpp:144)."""
 
+    def __init__(self):
+        self._start: Optional[int] = None
+        self._end: Optional[int] = None
+
     def __enter__(self):
+        self._end = None  # a reused timer must not expose a stale region
         self._start = time.monotonic_ns()
         return self
 
@@ -23,6 +48,11 @@ class RegionTimer:
 
     @property
     def ns(self) -> int:
+        if self._start is None or self._end is None:
+            raise RuntimeError(
+                "RegionTimer region not finished: read .ns/.ms after the "
+                "`with RegionTimer() as t:` block exits"
+            )
         return self._end - self._start
 
     @property
@@ -32,10 +62,19 @@ class RegionTimer:
 
 @contextlib.contextmanager
 def maybe_profile(trace_dir: Optional[str]):
-    """Wrap a region in a jax.profiler trace when ``trace_dir`` is set."""
+    """Wrap a region in a jax.profiler trace when ``trace_dir`` is set.
+
+    The directory is validated/created UP FRONT so an unwritable path fails
+    before the region runs (as a ``ValueError`` with a clear message — the
+    CLI's clean-error contract) instead of discarding the computed region
+    in the profiler's teardown."""
     if not trace_dir:
         yield
         return
+    try:
+        ensure_writable_dir(trace_dir, create=True)
+    except OSError as e:
+        raise ValueError(f"--trace-dir {trace_dir!r} is not writable: {e}")
     import jax
 
     with jax.profiler.trace(trace_dir):

@@ -24,7 +24,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 sys.path.insert(0, "/root/repo")
-from bench import _pipelined_slope  # noqa: E402
+from knn_tpu.obs.bench_timing import pipelined_slope as _pipelined_slope  # noqa: E402
 from knn_tpu.ops.pallas_knn import (  # noqa: E402
     knn_pallas_candidates,
     knn_pallas_stripe_candidates,

@@ -19,7 +19,8 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from bench import _interleaved_slope_trials, load_large, log  # noqa: E402
+from bench import load_large, log
+from knn_tpu.obs.bench_timing import interleaved_slope_trials as _interleaved_slope_trials  # noqa: E402
 
 
 def make_cases(config):

@@ -13,7 +13,7 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from bench import _pipelined_slope
+from knn_tpu.obs.bench_timing import pipelined_slope as _pipelined_slope
 
 K = 5
 

@@ -10,10 +10,25 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 from tests import fixtures
 
 REPO = Path(__file__).resolve().parent.parent
+
+# Older jaxlib CPU clients (e.g. 0.4.37) cannot run cross-process
+# collectives at all — the worker dies with this exact runtime error. That
+# is an environment limitation, not a regression in the launch path, so the
+# tier-1 gate skips rather than fails on it.
+_CPU_MULTIPROC_UNSUPPORTED = "Multiprocess computations aren't implemented"
+
+
+def _skip_if_cpu_multiprocess_unsupported(proc):
+    if proc.returncode != 0 and _CPU_MULTIPROC_UNSUPPORTED in proc.stderr:
+        pytest.skip(
+            "this jaxlib's CPU backend does not implement multi-process "
+            "collectives"
+        )
 
 
 def test_two_process_launch_matches_oracle(tmp_path):
@@ -35,6 +50,7 @@ def test_two_process_launch_matches_oracle(tmp_path):
         text=True,
         timeout=240,
     )
+    _skip_if_cpu_multiprocess_unsupported(proc)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "Accuracy was" in proc.stdout
     if fixtures.using_reference_datasets():
@@ -72,6 +88,7 @@ def test_two_process_stripe_engine_matches_oracle(tmp_path):
         text=True,
         timeout=240,
     )
+    _skip_if_cpu_multiprocess_unsupported(proc)
     assert proc.returncode == 0, proc.stderr[-2000:]
     train = load_arff(str(datasets / "small-train.arff"))
     test = load_arff(str(datasets / "small-test.arff"))

@@ -1,0 +1,479 @@
+"""Observability subsystem tests: span nesting/ordering, histogram bucket
+edges, Perfetto trace schema, Prometheus text exposition, the CLI artifact
+round-trip, and the live collective-traffic counters' exact agreement with
+``parallel/comm_audit.py``'s analytic byte model."""
+
+import io
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.obs.metrics import Histogram, MetricsRegistry
+from knn_tpu.obs.tracer import SpanTracer
+
+
+@pytest.fixture()
+def global_obs():
+    """Enable the global tracer/registry for one test, restoring the
+    disabled default (and empty state) afterwards."""
+    obs.reset()
+    obs.enable()
+    yield obs
+    obs.disable()
+    obs.reset()
+
+
+class TestSpanTracer:
+    def test_nesting_parent_depth(self):
+        tr = SpanTracer()
+        with tr.span("outer"):
+            with tr.span("mid"):
+                with tr.span("inner"):
+                    pass
+            with tr.span("mid2"):
+                pass
+        spans = {s.name: s for s in tr.spans()}
+        assert spans["outer"].parent is None and spans["outer"].depth == 0
+        assert spans["mid"].parent is spans["outer"]
+        assert spans["inner"].parent is spans["mid"]
+        assert spans["inner"].depth == 2
+        assert spans["mid2"].parent is spans["outer"]
+
+    def test_completion_order_children_first(self):
+        tr = SpanTracer()
+        with tr.span("a"):
+            with tr.span("b"):
+                pass
+        assert [s.name for s in tr.spans()] == ["b", "a"]
+
+    def test_durations_nested_within_parent(self):
+        tr = SpanTracer()
+        with tr.span("outer"):
+            with tr.span("inner"):
+                pass
+        spans = {s.name: s for s in tr.spans()}
+        assert 0 <= spans["inner"].dur_ns <= spans["outer"].dur_ns
+        assert spans["inner"].start_ns >= spans["outer"].start_ns
+
+    def test_aggregate_by_name_and_by_parent(self):
+        tr = SpanTracer()
+        with tr.span("region") as region:
+            with tr.span("x"):
+                pass
+            with tr.span("x"):
+                with tr.span("y"):
+                    pass
+        agg = tr.aggregate()
+        assert agg["x"]["count"] == 2 and agg["y"]["count"] == 1
+        children = tr.aggregate(parent=region)
+        assert set(children) == {"x"}  # y is a grandchild
+        assert children["x"]["count"] == 2
+
+    def test_threads_nest_independently(self):
+        tr = SpanTracer()
+        done = threading.Event()
+
+        def worker():
+            with tr.span("worker_root"):
+                with tr.span("worker_child"):
+                    done.wait(5)
+
+        t = threading.Thread(target=worker)
+        with tr.span("main_root"):
+            t.start()
+            done.set()
+            t.join()
+        spans = {s.name: s for s in tr.spans()}
+        assert spans["worker_root"].parent is None
+        assert spans["worker_child"].parent is spans["worker_root"]
+        assert spans["worker_root"].tid != spans["main_root"].tid
+
+    def test_buffer_cap_counts_drops(self):
+        tr = SpanTracer(max_spans=2)
+        for _ in range(4):
+            with tr.span("s"):
+                pass
+        assert len(tr.spans()) == 2 and tr.dropped == 2
+        assert tr.to_chrome_trace()["otherData"]["spans_dropped"] == 2
+        tr.reset()
+        assert tr.dropped == 0
+
+    def test_attrs_survive_to_trace_args(self):
+        tr = SpanTracer()
+        with tr.span("s", backend="tpu", k=5):
+            pass
+        [b, _] = tr.trace_events()
+        assert b["args"] == {"backend": "tpu", "k": 5}
+
+
+class TestPerfettoExport:
+    def _check_trace(self, doc):
+        assert isinstance(doc["traceEvents"], list)
+        stack = []
+        last_ts = -math.inf
+        for e in doc["traceEvents"]:
+            assert e["ph"] in ("B", "E")
+            assert e["ts"] >= last_ts, "timestamps must be monotonic"
+            last_ts = e["ts"]
+            if e["ph"] == "B":
+                stack.append(e["name"])
+            else:
+                assert stack and stack[-1] == e["name"], "mismatched B/E"
+                stack.pop()
+        assert not stack, "unclosed B events"
+
+    def test_schema_loadable_monotonic_matched(self):
+        tr = SpanTracer()
+        with tr.span("run"):
+            with tr.span("ingest"):
+                pass
+            with tr.span("classify"):
+                with tr.span("predict"):
+                    pass
+        doc = json.loads(json.dumps(tr.to_chrome_trace()))
+        self._check_trace(doc)
+        names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "B"]
+        assert names == ["run", "ingest", "classify", "predict"]
+
+    def test_sibling_subtrees_ordered_by_start(self):
+        tr = SpanTracer()
+        with tr.span("root"):
+            with tr.span("first"):
+                pass
+            with tr.span("second"):
+                pass
+        ev = tr.trace_events()
+        assert [e["name"] for e in ev] == [
+            "root", "first", "first", "second", "second", "root",
+        ]
+        assert [e["ph"] for e in ev] == ["B", "B", "E", "B", "E", "E"]
+
+
+class TestHistogram:
+    def test_bucket_edges_le_semantics(self):
+        h = Histogram("h", (), buckets=(1.0, 5.0, 10.0))
+        for v in (0.5, 1.0):   # both land in the first bucket (le=1.0)
+            h.observe(v)
+        h.observe(1.0000001)   # just past the edge -> second bucket
+        h.observe(10.0)        # exactly the last finite edge
+        h.observe(10.0000001)  # overflow -> +Inf bucket
+        assert h.bucket_counts() == [2, 1, 1, 1]
+        assert h.count == 5
+        assert h.cumulative() == [
+            (1.0, 2), (5.0, 3), (10.0, 4), (math.inf, 5),
+        ]
+
+    def test_sum_tracks_observations(self):
+        h = Histogram("h", (), buckets=(1.0,))
+        h.observe(0.25)
+        h.observe(4.0)
+        assert h.sum == pytest.approx(4.25)
+
+    def test_none_buckets_use_default_ladder(self):
+        from knn_tpu.obs.metrics import DEFAULT_BUCKETS
+
+        assert Histogram("h", (), buckets=None).buckets == DEFAULT_BUCKETS
+
+    def test_rejects_bad_buckets(self):
+        with pytest.raises(ValueError):
+            Histogram("h", (), buckets=(1.0, 1.0))
+        with pytest.raises(ValueError):
+            Histogram("h", (), buckets=(1.0, math.inf))
+
+
+class TestRegistry:
+    def test_get_or_create_same_instrument(self):
+        reg = MetricsRegistry()
+        a = reg.counter("c_total", backend="tpu")
+        b = reg.counter("c_total", backend="tpu")
+        assert a is b
+        other = reg.counter("c_total", backend="oracle")
+        assert other is not a
+
+    def test_counter_rejects_decrease(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="cannot decrease"):
+            reg.counter("c_total").add(-1)
+
+    def test_kind_collision_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("x")
+
+    def test_histogram_bucket_conflict_rejected(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(1.0, 10.0))
+        assert reg.histogram("h_ms", buckets=(10.0, 1.0)) is h  # same ladder
+        assert reg.histogram("h_ms") is h  # None defers to the existing one
+        with pytest.raises(ValueError, match="conflicting"):
+            reg.histogram("h_ms", buckets=(1.0, 5.0))
+
+    def test_json_dump(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", backend="tpu").add(3)
+        reg.histogram("h_ms", buckets=(1.0,)).observe(0.5)
+        doc = json.loads(json.dumps(reg.to_json()))
+        assert doc["c_total"][0] == {
+            "labels": {"backend": "tpu"}, "kind": "counter", "value": 3,
+        }
+        hrec = doc["h_ms"][0]
+        assert hrec["count"] == 1
+        assert hrec["buckets"][-1]["le"] == "+Inf"
+
+    def test_prometheus_text_format(self):
+        reg = MetricsRegistry()
+        reg.counter("knn_queries_total", help="rows classified",
+                    backend="tpu").add(42)
+        reg.gauge("knn_qps").set(1234.5)
+        h = reg.histogram("knn_wall_ms", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(99.0)
+        text = reg.to_prometheus()
+        lines = text.strip().splitlines()
+        assert "# HELP knn_queries_total rows classified" in lines
+        assert "# TYPE knn_queries_total counter" in lines
+        assert 'knn_queries_total{backend="tpu"} 42' in lines
+        assert "# TYPE knn_qps gauge" in lines
+        assert "knn_qps 1234.5" in lines
+        assert 'knn_wall_ms_bucket{le="1"} 1' in lines
+        assert 'knn_wall_ms_bucket{le="10"} 1' in lines
+        assert 'knn_wall_ms_bucket{le="+Inf"} 2' in lines
+        assert "knn_wall_ms_sum 99.5" in lines
+        assert "knn_wall_ms_count 2" in lines
+        # TYPE precedes samples for each family.
+        assert lines.index("# TYPE knn_queries_total counter") < lines.index(
+            'knn_queries_total{backend="tpu"} 42'
+        )
+
+
+class TestDisabledIsNoop:
+    def test_span_is_shared_null(self):
+        assert not obs.enabled()
+        s1 = obs.span("anything", big="attr")
+        s2 = obs.span("else")
+        assert s1 is s2  # the shared singleton: no allocation per call
+        with s1:
+            pass
+        assert obs.tracer().spans() == []
+
+    def test_metric_helpers_record_nothing(self):
+        assert not obs.enabled()
+        obs.counter_add("c_total", 5)
+        obs.gauge_set("g", 1)
+        obs.histogram_observe("h", 2)
+        assert obs.registry().instruments() == []
+
+
+class TestCliRoundTrip:
+    @pytest.fixture(scope="class")
+    def paths(self):
+        from tests import fixtures
+
+        d = fixtures.datasets_dir()
+        return str(d / "small-train.arff"), str(d / "small-test.arff")
+
+    def test_metrics_json_and_trace(self, paths, tmp_path):
+        from knn_tpu.cli import run
+
+        m_path = tmp_path / "m.json"
+        t_path = tmp_path / "t.json"
+        out = io.StringIO()
+        rc = run([paths[0], paths[1], "3", "--metrics-out", str(m_path),
+                  "--trace-out", str(t_path), "--json"], stdout=out)
+        # run() scopes the flag-driven enablement to the call.
+        assert not obs.enabled()
+        obs.reset()
+        assert rc == 0
+        m = json.loads(m_path.read_text())
+        cli_rec = json.loads(out.getvalue().strip().splitlines()[-1])
+        # --metrics-out and --json agree on the per-phase totals.
+        assert cli_rec["phases"] == m["phases"]
+        # Per-phase totals sum to within 5% of the headline wall time.
+        wall = m["wall_ms"]
+        assert wall > 0
+        assert sum(m["phases"].values()) == pytest.approx(wall, rel=0.05)
+        # Perfetto trace: loadable, monotonic ts, matched B/E, >= 4 distinct
+        # nested phases.
+        trace = json.loads(t_path.read_text())
+        TestPerfettoExport()._check_trace(trace)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert len(names) >= 4
+        assert {"ingest", "classify", "predict"} <= names
+        max_depth = depth = 0
+        for e in trace["traceEvents"]:
+            depth += 1 if e["ph"] == "B" else -1
+            max_depth = max(max_depth, depth)
+        assert max_depth >= 3  # e.g. classify > predict > dispatch
+
+    def test_prometheus_out(self, paths, tmp_path):
+        from knn_tpu.cli import run
+
+        m_path = tmp_path / "m.prom"
+        rc = run([paths[0], paths[1], "1", "--metrics-out", str(m_path)],
+                 stdout=io.StringIO())
+        obs.disable()
+        obs.reset()
+        assert rc == 0
+        text = m_path.read_text()
+        assert "# TYPE knn_queries_total counter" in text
+        assert 'knn_queries_total{backend="tpu"}' in text
+
+    def test_unwritable_out_fails_fast(self, paths, capsys):
+        from knn_tpu.cli import run
+
+        rc = run([paths[0], paths[1], "1",
+                  "--metrics-out", "/no/such/dir/m.json"])
+        obs.disable()
+        obs.reset()
+        assert rc == 1
+        assert "error:" in capsys.readouterr().err
+
+    def test_sweep_carries_phases(self, paths, tmp_path):
+        from knn_tpu.cli import run
+
+        m_path = tmp_path / "m.json"
+        out = io.StringIO()
+        rc = run([paths[0], paths[1], "1", "--sweep-k", "1,5", "--engine",
+                  "xla", "--metrics-out", str(m_path), "--json"], stdout=out)
+        obs.disable()
+        obs.reset()
+        assert rc == 0
+        m = json.loads(m_path.read_text())
+        assert "sweep_k" in m["phases"]
+        assert sum(m["phases"].values()) == pytest.approx(
+            m["wall_ms"], rel=0.05
+        )
+
+
+class TestCollectiveCounters:
+    """The live counters must equal comm_audit's analytic model EXACTLY."""
+
+    def _problem(self, rng, n=400, q=96, d=6, c=5):
+        train_x = rng.random((n, d), np.float32)
+        train_y = rng.integers(0, c, n).astype(np.int32)
+        test_x = rng.random((q, d), np.float32)
+        return train_x, train_y, test_x, c
+
+    def _counter_value(self, path):
+        total = 0
+        for inst in obs.registry().instruments():
+            if inst.name != "knn_collective_bytes_total":
+                continue
+            if dict(inst.labels).get("path") == path:
+                total += inst.value
+        return total
+
+    def test_train_sharded_bytes_match_model(self, global_obs, rng):
+        from knn_tpu.parallel.comm_audit import model_train_sharded_bytes
+        from knn_tpu.parallel.train_sharded import (
+            predict_train_sharded, xla_shard_layout,
+        )
+
+        train_x, train_y, test_x, c = self._problem(rng)
+        k, n_q, n_t, query_tile, train_tile = 5, 2, 2, 16, 64
+        predict_train_sharded(
+            train_x, train_y, test_x, k, c, mesh_shape=(n_q, n_t),
+            query_tile=query_tile, train_tile=train_tile, engine="xla",
+        )
+        q_pad = -(-test_x.shape[0] // (n_q * query_tile)) * n_q * query_tile
+        expected = model_train_sharded_bytes(q_pad // n_q, k, n_t)
+        assert self._counter_value("train-sharded") == expected
+
+    def test_ring_bytes_match_model(self, global_obs, rng):
+        from knn_tpu.parallel.comm_audit import model_ring_bytes
+        from knn_tpu.parallel.ring import predict_ring
+
+        train_x, train_y, test_x, c = self._problem(rng)
+        n_dev = 4
+        predict_ring(
+            train_x, train_y, test_x, 3, c, num_devices=n_dev, engine="full",
+        )
+        n_pad = -(-train_x.shape[0] // n_dev) * n_dev
+        shard_rows = n_pad // n_dev
+        expected = model_ring_bytes(
+            shard_rows * train_x.shape[1] * 4, shard_rows * 4, n_dev
+        )
+        assert self._counter_value("ring") == expected
+
+    def test_query_sharded_bytes_match_model(self, global_obs, rng):
+        from knn_tpu.parallel.comm_audit import model_query_sharded_bytes
+        from knn_tpu.parallel.query_sharded import predict_query_sharded
+
+        train_x, train_y, test_x, c = self._problem(rng)
+        n_dev, query_tile = 2, 16
+        predict_query_sharded(
+            train_x, train_y, test_x, 3, c, num_devices=n_dev,
+            query_tile=query_tile, engine="xla",
+        )
+        q_pad = -(-test_x.shape[0] // (n_dev * query_tile)) * n_dev * query_tile
+        expected = model_query_sharded_bytes(q_pad, train_x.shape[1])
+        assert self._counter_value("query-sharded") == expected
+
+    def test_static_audit_agrees_with_runtime_model(self, rng):
+        """The lowering-derived byte count and the model fn the runtime
+        counter uses are the same number — the audit asserts internally."""
+        import jax.numpy as jnp
+
+        from knn_tpu.parallel.comm_audit import audit_train_sharded
+        from knn_tpu.parallel.mesh import make_mesh_2d
+        from knn_tpu.parallel.train_sharded import build_train_sharded_fn
+
+        train_x, train_y, test_x, c = self._problem(rng, n=256, q=64)
+        k, query_tile, train_tile = 3, 32, 128
+        mesh = make_mesh_2d(2, 2)
+        fn = build_train_sharded_fn(
+            mesh, k, c, "exact", query_tile, train_tile
+        )
+        lowered = fn.lower(
+            jnp.zeros((256, 6), jnp.float32), jnp.zeros(256, jnp.int32),
+            jnp.zeros((64, 6), jnp.float32), jnp.asarray(256, jnp.int32),
+        ).as_text(dialect="stablehlo")
+        measured, expected = audit_train_sharded(lowered, 32, k, 2)
+        assert measured == expected
+
+
+class TestTimingSatellites:
+    def test_region_timer_early_read_raises(self):
+        from knn_tpu.utils.timing import RegionTimer
+
+        t = RegionTimer()
+        with pytest.raises(RuntimeError, match="not finished"):
+            t.ns
+        with t:
+            with pytest.raises(RuntimeError, match="not finished"):
+                t.ms
+        assert t.ms >= 0
+
+    def test_region_timer_reuse_does_not_expose_stale_end(self):
+        from knn_tpu.utils.timing import RegionTimer
+
+        t = RegionTimer()
+        with t:
+            pass
+        t.__enter__()  # reused: mid-region again
+        with pytest.raises(RuntimeError, match="not finished"):
+            t.ns
+        t.__exit__()
+        assert t.ns >= 0
+
+    def test_maybe_profile_rejects_unwritable_dir(self, tmp_path):
+        from knn_tpu.utils.timing import maybe_profile
+
+        blocker = tmp_path / "a_file"
+        blocker.write_text("")
+        with pytest.raises(ValueError, match="not writable"):
+            with maybe_profile(str(blocker / "trace")):
+                pass
+
+    def test_maybe_profile_creates_dir(self, tmp_path):
+        from knn_tpu.utils.timing import maybe_profile
+
+        target = tmp_path / "traces" / "run1"
+        with maybe_profile(str(target)):
+            pass
+        assert target.is_dir()
