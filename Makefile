@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify bench parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos bench parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -61,6 +61,16 @@ verify:
 	python3 -m compileall -q knn_tpu bench.py
 	JAX_PLATFORMS=cpu python3 -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# The chaos gate (docs/RESILIENCE.md): the deterministic fault-injection
+# suite — every (fault point, mode) pair must end in recovery with
+# bit-identical predictions or a typed error, never a raw traceback.
+# KNN_TPU_RETRY_BASE_MS=0 removes backoff sleeps so chaos runs at full
+# speed; the schedule itself is covered by unit tests.
+chaos:
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 -m pytest \
+		tests/test_resilience.py tests/test_arff_malformed.py -q \
+		-p no:cacheprovider
 
 bench:
 	python3 bench.py

@@ -20,6 +20,9 @@ datasets) as a JAX / XLA / shard_map / Pallas framework:
 - ``knn_tpu.models``    — the high-level ``KNNClassifier`` / ``KNNRegressor``
   APIs (kneighbors / radius_neighbors retrieval, uniform or inverse-distance
   weighting, pluggable metric).
+- ``knn_tpu.resilience`` — fault injection, retry/backoff, the graceful
+  backend-degradation ladder, and the typed error taxonomy
+  (docs/RESILIENCE.md).
 - ``knn_tpu.utils``     — timing, padding, evaluation, output formatting.
 
 The behavioral contract (SURVEY.md §3.5) is preserved exactly: squared

@@ -17,6 +17,13 @@ follow the reference's Makefile-target convention (Makefile:1-9):
 - ``tpu-ring``     — ring schedule over train shards (ring-attention shape).
 - ``tpu-pallas``   — hand-tiled Pallas kernel, VMEM-resident running top-k
                      (the wide-feature / BASELINE config-5 path).
+
+Because every backend implements the same reference-exact contract, the
+registry doubles as a degradation ladder: persistent typed failures walk
+``tpu-sharded → tpu → tpu-pallas → native → oracle`` with bit-identical
+predictions at every rung (``knn_tpu.resilience.degrade`` — the CLI's
+default execution path; ``--no-fallback`` opts out). See
+docs/RESILIENCE.md.
 """
 
 from __future__ import annotations

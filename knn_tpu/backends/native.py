@@ -48,6 +48,8 @@ def knn_native(
     import time
 
     from knn_tpu import obs
+    from knn_tpu.resilience.errors import DataError
+    from knn_tpu.resilience.retry import guarded_call
 
     train_x = np.ascontiguousarray(train_x, np.float32)
     train_y = np.ascontiguousarray(train_y, np.int32)
@@ -56,15 +58,23 @@ def knn_native(
     out = np.empty(q, np.int32)
     t0 = time.monotonic()
     with obs.span("kernel", backend="native", threads=num_threads):
-        rc = _call_native(train_x, train_y, test_x, k, num_classes,
-                          num_threads, out)
+        # ``native.load`` covers the runtime library failing at call time
+        # (unloadable .so, ABI break) — injected or real; OSErrors retry
+        # then classify to DeviceError so the ladder degrades to oracle.
+        rc = guarded_call(
+            "native.load",
+            lambda: _call_native(train_x, train_y, test_x, k, num_classes,
+                                 num_threads, out),
+        )
     if obs.enabled():
         obs.histogram_observe(
             "knn_kernel_ms", (time.monotonic() - t0) * 1e3,
             help="native C++ kernel wall ms", backend="native",
         )
     if rc != 0:
-        raise ValueError(f"knn_native_predict failed (rc={rc})")
+        # Nonzero rc is the kernel's argument validation (bad k/shapes):
+        # input data, not device failure.
+        raise DataError(f"knn_native_predict failed (rc={rc})")
     return out
 
 

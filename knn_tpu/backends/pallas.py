@@ -42,11 +42,17 @@ def predict(
             train.features.nbytes + train.labels.nbytes
             + test.features.nbytes, backend="tpu-pallas",
         )
+    from knn_tpu.resilience.retry import guarded_call
+
     # precision="auto" resolves inside predict_pallas (exact for narrow
     # features, fast for wide — ops/pallas_knn._resolve_stripe_precision).
+    # Nested guards: the kernel entry transfers AND compiles internally, so
+    # both fault points (and both failure classes) cover the one call.
     with obs.span("kernel", backend="tpu-pallas", engine=engine):
-        return predict_pallas(
-            train.features, train.labels, test.features, k, train.num_classes,
-            block_q=block_q, block_n=block_n, interpret=interpret,
-            precision=precision, engine=engine,
-        )
+        return guarded_call("device.put", lambda: guarded_call(
+            "backend.compile", lambda: predict_pallas(
+                train.features, train.labels, test.features, k,
+                train.num_classes,
+                block_q=block_q, block_n=block_n, interpret=interpret,
+                precision=precision, engine=engine,
+            )))

@@ -281,6 +281,7 @@ def _predict_query_batched(
         tx, ty = jnp.asarray(txp), jnp.asarray(typ)
         nv = jnp.asarray(n, jnp.int32)
 
+    from knn_tpu.resilience.retry import guarded_call
     from knn_tpu.utils.windowed import windowed_dispatch
 
     def dispatch(s):
@@ -288,21 +289,22 @@ def _predict_query_batched(
         if chunk.shape[0] < query_batch:  # pad: one shape, one executable
             chunk = np.pad(chunk, ((0, query_batch - chunk.shape[0]), (0, 0)))
         if use_full or approx:
-            return knn_forward(
+            return guarded_call("backend.compile", lambda: knn_forward(
                 tx, ty, jnp.asarray(chunk), k=k, num_classes=num_classes,
                 precision=precision, approx=approx, recall_target=recall_target,
-            )
+            ))
         qp, _ = pad_axis_to_multiple(chunk, query_tile, axis=0)
-        return knn_forward_tiled(
+        return guarded_call("backend.compile", lambda: knn_forward_tiled(
             tx, ty, jnp.asarray(qp), nv,
             k=k, num_classes=num_classes, precision=precision,
             query_tile=query_tile, train_tile=train_tile,
-        )
+        ))
 
     def fetch(out, s):
         # Fetching frees our reference to the device buffers; trim tile
         # padding per chunk so concatenation preserves global query order.
-        return np.asarray(out)[:query_batch]
+        # Execution errors from the async dispatch surface here.
+        return guarded_call("device.put", lambda: np.asarray(out)[:query_batch])
 
     results = windowed_dispatch(range(0, q, query_batch), dispatch, fetch)
     return np.concatenate(results)[:q]
@@ -383,11 +385,16 @@ def predict_arrays(
         if approx or force_tiled:
             raise ValueError("engine='stripe' is incompatible with approx/force_tiled")
         from knn_tpu.ops.pallas_knn import stripe_classify_arrays
+        from knn_tpu.resilience.retry import guarded_call
 
-        return stripe_classify_arrays(
-            train_x, train_y, test_x, k, num_classes, precision=precision,
-            max_rows=query_batch, cache=device_cache,
-        )
+        # The stripe host entry transfers + compiles + fetches internally:
+        # nested guards give both fault points (and both failure classes)
+        # coverage over the one call.
+        return guarded_call("device.put", lambda: guarded_call(
+            "backend.compile", lambda: stripe_classify_arrays(
+                train_x, train_y, test_x, k, num_classes, precision=precision,
+                max_rows=query_batch, cache=device_cache,
+            )))
     # Shared auto-engine rule (ops/pallas_knn.py::stripe_auto_eligible):
     # exact euclidean, narrow features, small k, real TPU. Checked BEFORE the
     # query_batch streaming path — the stripe host entry chunks queries
@@ -403,11 +410,13 @@ def predict_arrays(
         and stripe_auto_eligible(precision, train_x.shape[1], k)
     ):
         from knn_tpu.ops.pallas_knn import stripe_classify_arrays
+        from knn_tpu.resilience.retry import guarded_call
 
-        return stripe_classify_arrays(
-            train_x, train_y, test_x, k, num_classes, precision=precision,
-            max_rows=query_batch, cache=device_cache,
-        )
+        return guarded_call("device.put", lambda: guarded_call(
+            "backend.compile", lambda: stripe_classify_arrays(
+                train_x, train_y, test_x, k, num_classes, precision=precision,
+                max_rows=query_batch, cache=device_cache,
+            )))
     if query_batch is not None and q > query_batch:
         return _predict_query_batched(
             train_x, train_y, test_x, k, num_classes,
@@ -417,41 +426,46 @@ def predict_arrays(
         )
     from knn_tpu import obs
     from knn_tpu.obs.instrument import record_transfer
+    from knn_tpu.resilience.retry import guarded_call
 
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
         with obs.span("prepare", engine="xla-full"):
-            txj, tyj, qxj = (
+            txj, tyj, qxj = guarded_call("device.put", lambda: (
                 jnp.asarray(train_x), jnp.asarray(train_y),
                 jnp.asarray(test_x),
-            )
+            ))
         if obs.enabled():
             record_transfer(train_x.nbytes + train_y.nbytes + test_x.nbytes)
         with obs.span("dispatch", engine="xla-full", approx=approx):
-            out = knn_forward(
+            out = guarded_call("backend.compile", lambda: knn_forward(
                 txj, tyj, qxj,
                 k=k, num_classes=num_classes, precision=precision,
                 approx=approx, recall_target=recall_target,
-            )
+            ))
         with obs.span("fetch", engine="xla-full"):
-            return np.asarray(out)
+            # Async dispatch surfaces execution errors (incl. OOM) at the
+            # blocking fetch: classify them as device failures.
+            return guarded_call("device.put", lambda: np.asarray(out))
 
     train_tile = max(train_tile, k)  # per-tile top-k needs k <= tile width
     with obs.span("prepare", engine="xla-tiled"):
         tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
         ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)
         qx, _ = pad_axis_to_multiple(test_x, query_tile, axis=0)
-        txj, tyj, qxj = jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx)
+        txj, tyj, qxj = guarded_call("device.put", lambda: (
+            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
+        ))
     if obs.enabled():
         record_transfer(tx.nbytes + ty.nbytes + qx.nbytes)
     with obs.span("dispatch", engine="xla-tiled"):
-        out = knn_forward_tiled(
+        out = guarded_call("backend.compile", lambda: knn_forward_tiled(
             txj, tyj, qxj,
             jnp.asarray(n, jnp.int32),
             k=k, num_classes=num_classes, precision=precision,
             query_tile=query_tile, train_tile=train_tile,
-        )
+        ))
     with obs.span("fetch", engine="xla-tiled"):
-        return np.asarray(out)[:q]
+        return guarded_call("device.put", lambda: np.asarray(out)[:q])
 
 
 @register("tpu")

@@ -26,6 +26,15 @@ from knn_tpu.utils.cli_format import result_line, result_json
 from knn_tpu.utils.evaluate import confusion_matrix, accuracy
 from knn_tpu.utils.timing import RegionTimer, maybe_profile
 
+# Exit-code contract (pinned by tests/test_cli.py::TestExitCodes):
+# 0 = success; EXIT_USAGE (2) = the user's input was rejected before any
+# classification ran (bad flags, bad k, missing/malformed files, unknown
+# backend, --no-fallback against an unavailable backend); EXIT_RUNTIME (1)
+# = the computation itself failed (every ladder rung exhausted, artifact
+# write failures). One-line messages on stderr, never a traceback.
+EXIT_USAGE = 2
+EXIT_RUNTIME = 1
+
 # persona -> (default backend, usage string modeled on the reference's)
 _PERSONAS = {
     "main": ("native", "Usage: ./main datasets/train.arff datasets/test.arff k"),
@@ -55,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--persona", choices=sorted(_PERSONAS), default="tpu")
     p.add_argument("--backend", default=None, help="override the persona's backend")
+    p.add_argument(
+        "--no-fallback", action="store_true",
+        help="disable the graceful-degradation ladder (docs/RESILIENCE.md): "
+        "an unavailable backend exits 2 instead of substituting a rung, and "
+        "a failing one exits 1 with its typed error instead of degrading "
+        "(transient-fault retry stays on)",
+    )
     p.add_argument(
         "--metric",
         choices=["euclidean", "manhattan", "chebyshev", "cosine"],
@@ -221,12 +237,24 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
-        return e.code if isinstance(e.code, int) else 2
+        return e.code if isinstance(e.code, int) else EXIT_USAGE
+
+    # Re-read KNN_TPU_FAULTS so env-armed chaos runs work for in-process
+    # run() calls too (the import-time arm only sees the spawn env);
+    # inject()-armed plans are preserved. A malformed spec is user input:
+    # one-line message, usage exit code.
+    from knn_tpu.resilience import faults
+
+    try:
+        faults.install_from_env()
+    except ValueError as e:
+        print(f"error: {faults.FAULT_ENV}: {e}", file=sys.stderr)
+        return EXIT_USAGE
 
     obs_err = _setup_obs(args)
     if obs_err is not None:
         print(f"error: {obs_err}", file=sys.stderr)
-        return 1
+        return EXIT_USAGE
 
     # --sweep-k argument validation happens BEFORE any backend resolution or
     # file loading: the sweep never touches a backend (so backend fallback
@@ -241,7 +269,7 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         except ValueError:
             print(f"error: --sweep-k wants positive integers, got "
                   f"{args.sweep_k!r}", file=sys.stderr)
-            return 1
+            return EXIT_USAGE
         # Reject options the retrieval path cannot honor rather than
         # silently computing something else (the backends' own rule,
         # backends/tpu.py forced-stripe branch).
@@ -264,7 +292,7 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
                 f"incompatible with {', '.join(rejected)}",
                 file=sys.stderr,
             )
-            return 1
+            return EXIT_USAGE
 
     if args.platform:
         import jax
@@ -296,7 +324,7 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
             train.validate_for_knn(max(sweep_ks), test)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
-            return 1
+            return EXIT_USAGE
         try:
             if args.warmup:
                 sweep_k(train, test, sweep_ks, metric=args.metric,
@@ -311,7 +339,7 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
                         )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
-            return 1
+            return EXIT_RUNTIME
         phases = _phase_breakdown(classify_span) if obs.enabled() else None
         base = args.dump_predictions
         if base and base.endswith(".npy"):
@@ -339,21 +367,28 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         return 0
 
     backend_name = args.backend or _PERSONAS[args.persona][0]
-    # Graceful degradation when the native runtime isn't built.
-    from knn_tpu.backends import available_backends, get_backend
+    # Static rung of the degradation ladder (docs/RESILIENCE.md): a known
+    # but unbuilt/unregistered backend substitutes its first available rung
+    # up front — unless --no-fallback, where asking for an unavailable
+    # backend and forbidding substitution is a contradiction (exit 2).
+    from knn_tpu.backends import available_backends
+    from knn_tpu.resilience import degrade
 
     if backend_name not in available_backends():
-        fallback = {
-            "native": "oracle",
-            "native-mt": "tpu",
-            "tpu-sharded": "tpu",
-            "tpu-train-sharded": "tpu",
-            "tpu-ring": "tpu",
-            "tpu-pallas": "tpu",
-        }.get(backend_name)
+        if not degrade.known_backend(backend_name):
+            print(f"error: backend '{backend_name}' unavailable", file=sys.stderr)
+            return EXIT_USAGE
+        fallback = degrade.fallback_for(backend_name, available_backends())
         if fallback is None:
             print(f"error: backend '{backend_name}' unavailable", file=sys.stderr)
-            return 1
+            return EXIT_USAGE
+        if args.no_fallback:
+            print(
+                f"error: backend '{backend_name}' unavailable and "
+                f"--no-fallback forbids degrading to '{fallback}'",
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
         reason = (
             "native runtime unavailable (run `make native`)"
             if backend_name.startswith("native")
@@ -372,7 +407,7 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         train.validate_for_knn(args.k, test)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return EXIT_USAGE
 
     opts = dict(
         query_tile=args.query_tile,
@@ -392,25 +427,42 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         if not args.approx:
             print("error: --recall-target only applies with --approx",
                   file=sys.stderr)
-            return 1
+            return EXIT_USAGE
         opts["recall_target"] = args.recall_target
     if args.threads is not None:
         opts["num_threads"] = args.threads
     if args.devices is not None:
         opts["num_devices"] = args.devices
 
-    fn = get_backend(backend_name)
+    from knn_tpu.resilience.errors import ResilienceError
+
     try:
         if args.warmup:
-            fn(train, test, args.k, **opts)
+            warm = degrade.predict_with_ladder(
+                backend_name, train, test, args.k, opts,
+                no_fallback=args.no_fallback,
+            )
+            # Start the timed run from the rung (and query_batch) the
+            # warmup survived on, so the timed region measures the serving
+            # configuration rather than re-walking the failures.
+            backend_name, opts = warm.backend, warm.opts
         with maybe_profile(args.trace_dir):
             with RegionTimer() as t:
                 with obs.span("classify",
                               backend=backend_name) as classify_span:
-                    predictions = fn(train, test, args.k, **opts)
+                    result = degrade.predict_with_ladder(
+                        backend_name, train, test, args.k, opts,
+                        no_fallback=args.no_fallback,
+                    )
+        predictions = result.predictions
+        backend_name = result.backend  # report where it actually ran
+    except ResilienceError as e:
+        # Ladder exhausted (or --no-fallback): one line, typed, exit 1.
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return EXIT_RUNTIME
     except ValueError as e:  # e.g. metric unsupported by this backend
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return EXIT_RUNTIME
 
     cm = confusion_matrix(predictions, test.labels, test.num_classes)
     acc = accuracy(cm)

@@ -50,6 +50,8 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
     auto-detect (None, default).
     """
     from knn_tpu import obs
+    from knn_tpu.resilience.errors import DataError
+    from knn_tpu.resilience.retry import guarded_call
 
     cached = False
     if obs.enabled():
@@ -60,7 +62,20 @@ def load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
         c = _cache_path(path)
         cached = bool(c is not None and c.exists())
     with obs.span("ingest", file=os.path.basename(path)):
-        ds = _load_arff(path, use_native)
+        # ``arff.parse``: the ingest fault point. OSErrors (injected or a
+        # real transient FS blip) retry with backoff; what survives is
+        # typed — parse failures are already DataError (ArffError / the
+        # native binding), and a missing/unreadable file classifies into
+        # one — so callers branch on DataError, not libc message text.
+        try:
+            ds = guarded_call(
+                "arff.parse", lambda: _load_arff(path, use_native),
+                classify=False,
+            )
+        except DataError:
+            raise
+        except OSError as e:
+            raise DataError(f"{path}: {e.strerror or e}") from e
     if obs.enabled():
         try:
             size = os.path.getsize(path)
@@ -97,6 +112,11 @@ def _load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
     ds: Optional[Dataset] = None
     if use_native is not False:
         try:
+            from knn_tpu.resilience.faults import fault_point
+
+            # Losing the native parser degrades to the pure-Python twin —
+            # its own mini-ladder (identical arrays, slower parse).
+            fault_point("native.load")
             from knn_tpu.native import arff_native
 
             ds = arff_native.parse(path)

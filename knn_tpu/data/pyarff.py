@@ -47,6 +47,7 @@ from typing import Iterable, Optional
 import numpy as np
 
 from knn_tpu.data.dataset import Attribute, Dataset
+from knn_tpu.resilience.errors import DataError
 
 _NUMERIC_TYPES = {"numeric", "real", "integer"}
 
@@ -122,8 +123,11 @@ def _strtof(tok: str) -> float:
     return float(s)
 
 
-class ArffError(ValueError):
-    """Parse error with file:line context, mirroring libarff's THROW style."""
+class ArffError(DataError):
+    """Parse error with file:line context, mirroring libarff's THROW style.
+    A :class:`knn_tpu.resilience.errors.DataError` (and still a ValueError),
+    so resilience-aware callers branch on the taxonomy while pre-existing
+    ``except ValueError`` handling keeps working."""
 
     def __init__(self, path: str, line: int, msg: str):
         super().__init__(f"{path}:{line}: {msg}")

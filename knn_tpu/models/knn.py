@@ -65,12 +65,14 @@ def _kneighbors_arrays(
         if not euclidean:
             raise ValueError("the stripe engine implements euclidean only")
         from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
+        from knn_tpu.resilience.retry import guarded_call
 
         with obs.span("distance", engine="stripe", note="fused distance+top-k"):
-            out = stripe_candidates_arrays(
-                train_x, test_x, k, precision="exact", cache=cache,
-                deferred=deferred,
-            )
+            out = guarded_call("device.put", lambda: guarded_call(
+                "backend.compile", lambda: stripe_candidates_arrays(
+                    train_x, test_x, k, precision="exact", cache=cache,
+                    deferred=deferred,
+                )))
         if deferred and obs.enabled():
             def resolve_stripe(inner=out):
                 with obs.span("fetch", engine="stripe"):
@@ -89,21 +91,23 @@ def _kneighbors_arrays(
         # retrieval never reads the gathered values.
         return jnp.asarray(tx), jnp.asarray(np.zeros(tx.shape[0], np.int32))
 
+    from knn_tpu.resilience.retry import guarded_call
+
     with obs.span("prepare", engine="xla"):
-        txj, tyj = memo_device(
+        txj, tyj = guarded_call("device.put", lambda: memo_device(
             cache, ("xla_candidates_train", train_tile), make
-        )
+        ))
         qx, _ = pad_axis_to_multiple(test_x, 128, axis=0)
     import jax
 
     # The fused distance + running-top-k dispatch (one executable; the two
     # logical phases are inseparable on the XLA path — docs/OBSERVABILITY.md).
     with obs.span("distance", engine="xla", note="fused distance+top-k"):
-        d, i, _ = knn_forward_candidates(
+        d, i, _ = guarded_call("backend.compile", lambda: knn_forward_candidates(
             txj, tyj, jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
             k=k, train_tile=train_tile, precision=form,
-        )
+        ))
         for leaf in (d, i):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()

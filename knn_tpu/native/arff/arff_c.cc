@@ -31,8 +31,10 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <cerrno>
 #include <cstring>
 #include <sys/mman.h>
+#include <sys/stat.h>
 
 #include <memory>
 #include <string>
@@ -1141,7 +1143,17 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
   {
     FILE* f = fopen(path, "rb");
     if (!f) {
-      out->error = dup_string(std::string(path) + ": cannot open file");
+      out->error = dup_string(std::string(path) + ": cannot open file (" +
+                              strerror(errno) + ")");
+      return 1;
+    }
+    // A directory opens fine on Linux but reads garbage (EISDIR on fread,
+    // ENODEV on mmap) and its ftell size is fs-dependent: reject up front
+    // with a truthful message instead of "no @attribute declarations".
+    struct stat stbuf;
+    if (fstat(fileno(f), &stbuf) == 0 && S_ISDIR(stbuf.st_mode)) {
+      fclose(f);
+      out->error = dup_string(std::string(path) + ": is a directory");
       return 1;
     }
     fseek(f, 0, SEEK_END);
@@ -1155,10 +1167,20 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
         data = std::string_view((const char*)mapped, (size_t)size);
       } else {
         mapped = nullptr;
-        file_buf.reset(new char[(size_t)size]);
+        // bad_alloc here must not escape extern "C" (that aborts the host
+        // interpreter): a truncated-allocation error is a parse error.
+        try {
+          file_buf.reset(new char[(size_t)size]);
+        } catch (const std::bad_alloc&) {
+          fclose(f);
+          out->error = dup_string(std::string(path) +
+                                  ": out of memory reading file");
+          return 1;
+        }
         if (fread(file_buf.get(), 1, (size_t)size, f) != (size_t)size) {
           fclose(f);
-          out->error = dup_string(std::string(path) + ": short read");
+          out->error = dup_string(std::string(path) +
+                                  ": short read (truncated or unreadable file)");
           return 1;
         }
         data = std::string_view(file_buf.get(), (size_t)size);
@@ -1194,6 +1216,16 @@ int knn_arff_parse(const char* path, KnnArffResult* out) {
   out->features = (float*)malloc(sizeof(float) * n * (df ? df : 1));
   out->labels = (int32_t*)malloc(sizeof(int32_t) * (n ? n : 1));
   out->raw_targets = (float*)malloc(sizeof(float) * (n ? n : 1));
+  if (!out->features || !out->labels || !out->raw_targets) {
+    // A NULL from malloc fed to memcpy below is a segfault, not an error:
+    // surface allocation failure through the ABI like every other failure.
+    free(out->features);
+    free(out->labels);
+    free(out->raw_targets);
+    memset(out, 0, sizeof(*out));
+    out->error = dup_string(st.path + ": out of memory materializing arrays");
+    return 1;
+  }
   int32_t max_label = -1;
   for (size_t i = 0; i < n; ++i) {
     const float* row = &st.cells[i * d];

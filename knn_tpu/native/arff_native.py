@@ -13,6 +13,7 @@ import numpy as np
 
 from knn_tpu.data.dataset import Attribute, Dataset
 from knn_tpu.native import build_if_missing
+from knn_tpu.resilience.errors import DataError
 
 
 class _KnnArffResult(ctypes.Structure):
@@ -63,7 +64,9 @@ def parse(path: str) -> Dataset:
     try:
         if rc != 0:
             msg = res.error.decode() if res.error else f"parse failed (rc={rc})"
-            raise ValueError(msg)
+            # Typed like the pure-Python twin's ArffError: both parsers
+            # surface malformed input as DataError with file:line context.
+            raise DataError(msg)
         n, df = res.n, res.d_features
         features = np.ctypeslib.as_array(res.features, shape=(n, df)).copy() \
             if n and df else np.zeros((n, df), np.float32)

@@ -79,11 +79,17 @@ def init_from_env() -> bool:
             f"{_COORD_ENV} is set but {_NPROC_ENV}/{_PROCID_ENV} are not; the "
             f"launcher must export all three (see scripts/launch_multihost.py)"
         )
-    jax.distributed.initialize(
+    from knn_tpu.resilience.retry import guarded_call
+
+    # MPI_Init with MPI's failure mode removed: a coordinator that isn't up
+    # yet retries with backoff; a dead one surfaces as WorkerLostError (via
+    # classify_exception's multihost.init rule) instead of a raw RPC
+    # traceback, so _worker_main can degrade to solo.
+    guarded_call("multihost.init", lambda: jax.distributed.initialize(
         coordinator_address=coord,
         num_processes=int(nproc),
         process_id=int(procid),
-    )
+    ))
     return True
 
 
@@ -216,10 +222,24 @@ def predict_query_sharded_global(
     g_test_x = make_global(np.ascontiguousarray(qx), P("q"))
     g_nv = make_global(np.asarray(n, np.int32), P())
 
-    out = fn(g_train_x, g_train_y, g_test_x, g_nv)
-    # Replicated output: every process holds addressable copies.
-    local = out.addressable_data(0)
-    return np.asarray(local)[:q]
+    from knn_tpu.resilience.retry import guarded_call
+
+    out = guarded_call(
+        "collective.step", lambda: fn(g_train_x, g_train_y, g_test_x, g_nv)
+    )
+
+    def fetch():
+        if out.is_fully_addressable:
+            # Single-process (incl. the degraded-to-solo path): some jax
+            # versions keep the output q-sharded despite the replication
+            # constraint, making addressable_data(0) ONE SHARD; assembling
+            # from all local shards is correct either way.
+            return np.asarray(out)[:q]
+        # Multi-process: the replication constraint guarantees every
+        # process holds a full copy as its addressable data.
+        return np.asarray(out.addressable_data(0))[:q]
+
+    return guarded_call("collective.step", fetch)
 
 
 def _worker_main(argv) -> int:
@@ -241,18 +261,55 @@ def _worker_main(argv) -> int:
 
     import jax
 
-    if not init_from_env():
-        # No explicit launcher env: fall back to jax's cluster auto-detection
-        # (Cloud TPU pods, Slurm, Open MPI). On a plain host this fails —
-        # continue single-process, but say so.
+    from knn_tpu import obs
+    from knn_tpu.resilience import faults
+    from knn_tpu.resilience.errors import WorkerLostError, classify_exception
+
+    def degrade_to_solo(e: Exception) -> None:
+        err = classify_exception(e, "multihost.init")
+        if not isinstance(err, WorkerLostError):
+            err = WorkerLostError(str(err), reason=type(e).__name__)
+        # The reference's MPI answer to a lost rank is a dead job
+        # (mpi.cpp has no recovery at all); ours is a logged, counted
+        # degradation to single-process on the local devices.
+        obs.counter_add(
+            "knn_worker_lost_total",
+            help="multihost workers lost or never joined (degraded to solo)",
+            reason=err.reason,
+        )
+        obs.counter_add(
+            "knn_fallback_total",
+            help="degradation-ladder moves (backend -> fallback backend)",
+            from_backend="multihost", to="solo", reason=err.reason,
+        )
+        print(
+            f"multihost: WorkerLostError ({err.reason}): {err} — "
+            f"degrading to single-process",
+            file=sys.stderr,
+        )
+
+    try:
+        # init_from_env's own ValueError (partial launcher env) propagates:
+        # a misconfigured launcher is a usage error, and N processes
+        # silently degrading to N solo runs would each print the rank-0
+        # report. Cluster failures (WorkerLostError from its guarded init)
+        # degrade.
+        inited = init_from_env()
+    except ValueError:
+        raise
+    except Exception as e:  # noqa: BLE001 — classified + logged in the helper
+        degrade_to_solo(e)
+        inited = True  # do not also attempt auto-detection
+    if not inited:
+        # No explicit launcher env: fall back to jax's cluster
+        # auto-detection (Cloud TPU pods, Slurm, Open MPI). On a plain host
+        # this raises (ValueError for a missing coordinator on this jax) —
+        # degrade to solo through the typed path, never a bare swallow.
         try:
+            faults.fault_point("multihost.init")
             jax.distributed.initialize()
-        except Exception as e:  # noqa: BLE001 — any init failure means solo
-            print(
-                f"multihost: no cluster detected ({type(e).__name__}); "
-                f"running single-process",
-                file=sys.stderr,
-            )
+        except Exception as e:  # noqa: BLE001
+            degrade_to_solo(e)
 
     from knn_tpu.data.arff import load_arff
     from knn_tpu.utils.cli_format import result_line
@@ -262,17 +319,36 @@ def _worker_main(argv) -> int:
     rank = jax.process_index()
     # Replicated load on every process — the reference's exact IO strategy
     # (mpi.cpp:136-139).
-    train = load_arff(args.train)
-    test = load_arff(args.test)
-    train.validate_for_knn(args.k, test)
+    try:
+        train = load_arff(args.train)
+        test = load_arff(args.test)
+        train.validate_for_knn(args.k, test)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
 
-    with RegionTimer() as t:
-        preds = predict_query_sharded_global(
-            train.features, train.labels, test.features, args.k,
-            train.num_classes,
-            query_tile=args.query_tile, train_tile=args.train_tile,
-            engine=args.engine,
+    from knn_tpu.resilience.errors import ResilienceError
+
+    try:
+        with RegionTimer() as t:
+            preds = predict_query_sharded_global(
+                train.features, train.labels, test.features, args.k,
+                train.num_classes,
+                query_tile=args.query_tile, train_tile=args.train_tile,
+                engine=args.engine,
+            )
+    except ResilienceError as e:
+        # A mid-collective failure with peers already joined: degrading N
+        # processes to N solo runs would duplicate the rank-0 report, so
+        # (like the reference's MPI job) the worker dies — but with a
+        # one-line typed error, not a traceback, and the reason counted.
+        obs.counter_add(
+            "knn_worker_lost_total",
+            help="multihost workers lost or never joined (degraded to solo)",
+            reason=type(e).__name__,
         )
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
 
     if rank == 0:  # rank-0 reporting, like mpi.cpp:188-199
         acc = accuracy(confusion_matrix(preds, test.labels, test.num_classes))

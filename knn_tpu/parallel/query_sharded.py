@@ -25,6 +25,7 @@ from knn_tpu.backends.tpu import forward_tiled_core
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.obs.instrument import record_collective
 from knn_tpu.parallel.mesh import make_mesh, shard_map_compat
+from knn_tpu.resilience.retry import guarded_call
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
 
@@ -202,12 +203,12 @@ def _predict_query_sharded_stripe(
             model_query_sharded_bytes(qx.shape[0], qx.shape[1]),
         )
     with obs.span("dispatch", path="query-sharded", engine="stripe"):
-        out = fn(
+        out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
-        )
+        ))
     with obs.span("fetch", path="query-sharded"):
-        return np.asarray(out)[:q]
+        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
 
 
 def predict_query_sharded(
@@ -259,12 +260,12 @@ def predict_query_sharded(
             model_query_sharded_bytes(qx.shape[0], qx.shape[1]),
         )
     with obs.span("dispatch", path="query-sharded", engine="xla"):
-        out = fn(
+        out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(train_x.shape[0], jnp.int32),
-        )
+        ))
     with obs.span("fetch", path="query-sharded"):
-        return np.asarray(out)[:q]
+        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
 
 
 @register("tpu-sharded")

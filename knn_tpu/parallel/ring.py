@@ -45,6 +45,7 @@ from knn_tpu.ops.distance import _DIST_FNS
 from knn_tpu.ops.topk import merge_topk_labeled
 from knn_tpu.ops.vote import vote
 from knn_tpu.parallel.mesh import make_mesh, shard_map_compat
+from knn_tpu.resilience.retry import guarded_call
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
 # [q_local, shard_rows] cells above which ``engine="auto"`` abandons the
@@ -240,12 +241,12 @@ def predict_ring(
                 ),
             )
         with obs.span("dispatch", path="ring", engine="stripe"):
-            out = fn(
+            out = guarded_call("collective.step", lambda: fn(
                 jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
                 jnp.asarray(n, jnp.int32),
-            )
+            ))
         with obs.span("fetch", path="ring"):
-            return np.asarray(out)[:q]
+            return guarded_call("collective.step", lambda: np.asarray(out)[:q])
 
     with obs.span("prepare", path="ring", engine=engine):
         if engine == "tiled":
@@ -278,12 +279,12 @@ def predict_ring(
             ),
         )
     with obs.span("dispatch", path="ring", engine=engine):
-        out = fn(
+        out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
-        )
+        ))
     with obs.span("fetch", path="ring"):
-        return np.asarray(out)[:q]
+        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
 
 
 @register("tpu-ring")

@@ -28,6 +28,7 @@ from knn_tpu.data.dataset import Dataset
 from knn_tpu.obs.instrument import record_collective
 from knn_tpu.ops.vote import vote
 from knn_tpu.parallel.mesh import make_mesh, make_mesh_2d, default_mesh_shape, shard_map_compat
+from knn_tpu.resilience.retry import guarded_call
 from knn_tpu.utils.padding import pad_axis_to_multiple
 
 
@@ -221,12 +222,12 @@ def _predict_train_sharded_stripe(
             model_train_sharded_bytes(qx.shape[0] // n_q, k, n_t),
         )
     with obs.span("dispatch", path="train-sharded", engine="stripe"):
-        out = fn(
+        out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
-        )
+        ))
     with obs.span("fetch", path="train-sharded"):
-        return np.asarray(out)[:q]
+        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
 
 
 def predict_train_sharded(
@@ -277,12 +278,12 @@ def predict_train_sharded(
             model_train_sharded_bytes(qx.shape[0] // n_q, k, n_t),
         )
     with obs.span("dispatch", path="train-sharded", engine="xla"):
-        out = fn(
+        out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(train_x.shape[0], jnp.int32),
-        )
+        ))
     with obs.span("fetch", path="train-sharded"):
-        return np.asarray(out)[:q]
+        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
 
 
 @register("tpu-train-sharded")

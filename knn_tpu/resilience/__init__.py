@@ -1,0 +1,62 @@
+"""Resilience subsystem: fault injection, retry/backoff, degradation.
+
+The reference's failure model is the course assignment's: any failure —
+a bad ARFF token, a lost MPI rank, an OOM — is a crash (or undefined
+behavior). A serving stack needs the opposite property: every failure
+mode is *recovered* (transient faults retried), *degraded around* (the
+backend ladder, batch halving, multihost → solo), or *reported* as a
+typed, actionable error. This package is that property, woven through
+the backends, the sharded paths, multihost, and the CLI:
+
+- :mod:`knn_tpu.resilience.errors`  — the typed taxonomy (``DataError``,
+  ``CompileError``, ``DeviceError``, ``CollectiveError``,
+  ``WorkerLostError``) callers branch on instead of string-matching JAX
+  internals;
+- :mod:`knn_tpu.resilience.faults`  — deterministic, seeded fault
+  injection at named points (``arff.parse``, ``device.put``,
+  ``backend.compile``, ``collective.step``, ``multihost.init``,
+  ``native.load``), armed by ``KNN_TPU_FAULTS`` or
+  :func:`~knn_tpu.resilience.faults.inject` — chaos tests run in tier-1
+  on CPU;
+- :mod:`knn_tpu.resilience.retry`   — :func:`guarded_call`, the
+  fault-point + classify + exponential-backoff-retry wrapper on the
+  transfer/compile/collective call sites (``knn_retry_total``);
+- :mod:`knn_tpu.resilience.degrade` — the graceful-degradation ladder
+  (``tpu → tpu-pallas → native → oracle``, sharded → single-device,
+  OOM → halve ``query_batch``), with the CLI's ``--no-fallback`` escape
+  hatch (``knn_fallback_total``).
+
+Everything is measured-zero-cost when idle: an unarmed fault point is one
+``None`` check, and the retry wrapper sits only at per-predict
+granularity (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+from knn_tpu.resilience.errors import (
+    CollectiveError,
+    CompileError,
+    DataError,
+    DeviceError,
+    ResilienceError,
+    WorkerLostError,
+    classify_exception,
+)
+from knn_tpu.resilience.faults import FaultPlan, fault_point, inject, install_from_env
+from knn_tpu.resilience.retry import guarded_call
+from knn_tpu.resilience.degrade import (
+    LADDER,
+    LadderResult,
+    fallback_for,
+    known_backend,
+    predict_with_ladder,
+)
+
+__all__ = [
+    "ResilienceError", "DataError", "CompileError", "DeviceError",
+    "CollectiveError", "WorkerLostError", "classify_exception",
+    "FaultPlan", "fault_point", "inject", "install_from_env",
+    "guarded_call",
+    "LADDER", "LadderResult", "fallback_for", "known_backend",
+    "predict_with_ladder",
+]

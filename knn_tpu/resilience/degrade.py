@@ -1,0 +1,205 @@
+"""Graceful backend degradation: the ladder.
+
+The reference's MPI backend dies wholesale when any rank fails; a serving
+stack must instead answer every query it can with the best backend still
+standing. Each backend degrades along a fixed ladder toward the rung that
+cannot fail for device reasons (the NumPy oracle):
+
+    tpu-sharded / tpu-train-sharded / tpu-ring       (sharded → single-device)
+        → tpu → tpu-pallas → native → oracle
+    native-mt → native → oracle
+
+Because every rung implements the same reference-exact contract
+(SURVEY.md §3.5), degradation changes *where* the answer is computed, not
+*what* it is — predictions are bit-identical down the ladder (pinned by
+the chaos suite).
+
+Failure handling per rung:
+
+- transient faults are retried in place (:mod:`knn_tpu.resilience.retry`,
+  inside the backend call sites);
+- ``DeviceError(oom=True)`` on a rung that streams queries (``tpu``)
+  halves ``query_batch`` and re-executes the same rung — degrading batch
+  size before backend;
+- any other typed failure (CompileError / DeviceError / CollectiveError)
+  moves down the ladder, warning on stderr and counting
+  ``knn_fallback_total{from_backend,to}``;
+- a rung that rejects the *options* (e.g. ``--metric cosine`` on the
+  native kernel) is skipped the same way — but only when it is a
+  fallback rung; the user's explicitly chosen backend still reports its
+  own option errors verbatim.
+
+``no_fallback=True`` (the CLI's ``--no-fallback``) disables ladder moves
+AND batch-halving: the first typed failure propagates, so operators who
+would rather page than degrade get exactly that.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Dict, Optional, Tuple
+
+from knn_tpu import obs
+from knn_tpu.resilience.errors import DataError, DeviceError, ResilienceError
+
+#: backend -> fallback rungs, most-capable first.
+LADDER: Dict[str, Tuple[str, ...]] = {
+    "tpu-sharded": ("tpu", "tpu-pallas", "native", "oracle"),
+    "tpu-train-sharded": ("tpu", "tpu-pallas", "native", "oracle"),
+    "tpu-ring": ("tpu", "tpu-pallas", "native", "oracle"),
+    "tpu": ("tpu-pallas", "native", "oracle"),
+    "tpu-pallas": ("native", "oracle"),
+    "native-mt": ("native", "oracle"),
+    "native": ("oracle",),
+    "oracle": (),
+}
+
+#: options meaningful only to specific rungs — stripped when degrading so
+#: a fallback rung isn't rejected over a knob it never had.
+_RUNG_ONLY_OPTS = {
+    "approx": ("tpu",),
+    "recall_target": ("tpu",),
+    "query_batch": ("tpu",),
+    "num_threads": ("native-mt",),
+    "num_devices": ("tpu-sharded", "tpu-train-sharded", "tpu-ring"),
+}
+
+
+def fallback_for(backend: str, available) -> Optional[str]:
+    """First ladder rung for ``backend`` present in ``available`` — the
+    static unavailable-backend substitution (CLI startup)."""
+    for rung in LADDER.get(backend, ()):
+        if rung in available:
+            return rung
+    return None
+
+
+def known_backend(backend: str) -> bool:
+    """Whether ``backend`` is a name the ladder knows (i.e. a real backend
+    that may merely be unbuilt/unregistered on this install, as opposed to
+    a typo)."""
+    return backend in LADDER
+
+
+def opts_for_rung(rung: str, origin: str, opts: dict) -> dict:
+    """Sanitize ``opts`` for a fallback ``rung``: drop knobs owned by
+    other rungs and map ring-only engine names to auto. The origin rung
+    (``rung == origin``) keeps its opts verbatim."""
+    if rung == origin:
+        return dict(opts)
+    out = {
+        name: value
+        for name, value in opts.items()
+        if rung in _RUNG_ONLY_OPTS.get(name, (rung,))
+    }
+    if out.get("engine") in ("full", "tiled") and rung != "tpu-ring":
+        out["engine"] = "auto"
+    return out
+
+
+def _default_warn(msg: str) -> None:
+    print(f"warning: {msg}", file=sys.stderr)
+
+
+def _record_fallback(frm: str, to: str, reason: str) -> None:
+    obs.counter_add(
+        "knn_fallback_total",
+        help="degradation-ladder moves (backend -> fallback backend)",
+        from_backend=frm, to=to, reason=reason,
+    )
+
+
+class LadderResult:
+    """Outcome of a laddered predict: the predictions plus where (and with
+    what options) they were actually computed — so a caller timing repeat
+    runs can start from the surviving rung instead of re-walking failures."""
+
+    __slots__ = ("predictions", "backend", "opts", "degraded")
+
+    def __init__(self, predictions, backend: str, opts: dict, degraded: bool):
+        self.predictions = predictions
+        self.backend = backend
+        self.opts = opts
+        self.degraded = degraded
+
+
+def predict_with_ladder(
+    backend: str,
+    train,
+    test,
+    k: int,
+    opts: Optional[dict] = None,
+    *,
+    no_fallback: bool = False,
+    warn: Optional[Callable[[str], None]] = None,
+) -> LadderResult:
+    """Classify through ``backend``, degrading down the ladder on typed
+    failures. Returns a :class:`LadderResult`; raises the last typed error
+    when every rung fails (or the first one under ``no_fallback``)."""
+    from knn_tpu.backends import available_backends, get_backend
+
+    if opts is None:
+        opts = {}
+    if warn is None:
+        warn = _default_warn
+    available = set(available_backends())
+    rungs = [backend] + [r for r in LADDER.get(backend, ()) if r in available]
+    if backend not in available:
+        rungs = rungs[1:]
+        if not rungs:
+            raise DeviceError(f"backend '{backend}' unavailable and no "
+                              f"fallback rung is registered")
+    last_err: Optional[Exception] = None
+    degraded = False
+    for pos, rung in enumerate(rungs):
+        rung_opts = opts_for_rung(rung, backend, opts)
+        while True:  # OOM batch-halving loop (same rung, smaller batches)
+            try:
+                fn = get_backend(rung)
+                preds = fn(train, test, k, **rung_opts)
+                return LadderResult(preds, rung, rung_opts, degraded)
+            except DeviceError as e:
+                if (
+                    e.oom
+                    and not no_fallback
+                    and rung == "tpu"
+                    and (rung_opts.get("query_batch")
+                         or test.num_instances) > 1
+                ):
+                    prev = rung_opts.get("query_batch") or test.num_instances
+                    rung_opts = dict(rung_opts, query_batch=max(1, prev // 2))
+                    warn(
+                        f"backend '{rung}' out of memory; retrying with "
+                        f"query_batch={rung_opts['query_batch']}"
+                    )
+                    _record_fallback(rung, rung, "oom_halve_batch")
+                    degraded = True
+                    continue
+                last_err = e
+            except DataError:
+                # Bad input is bad input on every rung: switching backends
+                # cannot fix it, so don't walk the ladder pretending it might.
+                raise
+            except ResilienceError as e:
+                last_err = e
+            except ValueError as e:
+                # Option/validation rejection. On the user's chosen rung
+                # this is their error to see; on a fallback rung it means
+                # "this rung can't serve these opts" — skip it.
+                if pos == 0:
+                    raise
+                last_err = e
+            break
+        if no_fallback:
+            raise last_err
+        nxt = rungs[pos + 1] if pos + 1 < len(rungs) else None
+        if nxt is not None:
+            warn(
+                f"backend '{rung}' failed "
+                f"({type(last_err).__name__}: {last_err}); "
+                f"falling back to '{nxt}'"
+            )
+            _record_fallback(rung, nxt, type(last_err).__name__)
+            degraded = True
+    assert last_err is not None
+    raise last_err

@@ -1,0 +1,135 @@
+"""Typed error taxonomy for the resilience layer.
+
+The reference's failure story is a raw crash: a malformed ARFF aborts
+mid-parse (libarff THROW), an MPI rank failure kills the whole job, and
+anything else is undefined behavior. Our reproduction inherited the JAX
+flavor of the same problem — callers had to string-match
+``XlaRuntimeError`` messages to tell an OOM from a compile failure from a
+dead worker. This module gives every failure mode a class so callers (the
+CLI, the degradation ladder in :mod:`knn_tpu.resilience.degrade`, tests)
+branch on type, not text:
+
+- :class:`DataError`       — input data is unusable (parse failures with
+  file:line context, missing files surfaced at load, invalid shapes).
+- :class:`CompileError`    — tracing/compiling a kernel failed.
+- :class:`DeviceError`     — moving data to/from a device or executing on
+  it failed; ``oom=True`` marks resource exhaustion (the ladder answers
+  OOM by halving ``query_batch``, not by switching backends).
+- :class:`CollectiveError` — a sharded/multi-device step failed (the MPI
+  analogue of a lost rank mid-collective).
+- :class:`WorkerLostError` — a multihost worker/cluster is gone or never
+  materialized (``jax.distributed`` init failure, dead coordinator).
+
+``DataError`` subclasses ``ValueError`` and every class subclasses
+``ResilienceError`` (itself an ``Exception``), so pre-existing
+``except (OSError, ValueError)`` handling keeps working while new code
+catches the taxonomy.
+
+``transient`` marks errors worth retrying (:mod:`knn_tpu.resilience.retry`
+only re-attempts those): an interrupted transfer is transient, a malformed
+file or an OOM is not — retrying a deterministic failure wastes the
+deadline, and retrying OOM at the same batch size re-OOMs.
+"""
+
+from __future__ import annotations
+
+
+class ResilienceError(Exception):
+    """Base of the taxonomy. ``transient`` gates retry; ``fault_point``
+    records which named injection point raised it (None for real errors)."""
+
+    #: class default; instances may override via the constructor
+    transient = False
+
+    def __init__(self, message: str, *, transient: "bool | None" = None,
+                 fault_point: "str | None" = None):
+        super().__init__(message)
+        if transient is not None:
+            self.transient = transient
+        self.fault_point = fault_point
+
+
+class DataError(ResilienceError, ValueError):
+    """Unusable input data: parse failures (with file:line context where
+    the parser has it), missing/unreadable files surfaced at load time,
+    unknown nominal/class labels, shape mismatches. Never transient —
+    re-reading a malformed file yields the same bytes."""
+
+
+class CompileError(ResilienceError):
+    """Tracing or compiling a kernel failed (XLA compile error, Pallas
+    lowering failure). Transient by default: real compile infrastructure
+    does fail transiently (compile-server hiccups), and one retry is cheap
+    next to abandoning the fast backend."""
+
+    transient = True
+
+
+class DeviceError(ResilienceError):
+    """A device transfer or on-device execution failed. ``oom=True`` marks
+    resource exhaustion, which is NOT transient (same inputs re-exhaust
+    the same memory) — the ladder's answer is a smaller ``query_batch``."""
+
+    def __init__(self, message: str, *, oom: bool = False,
+                 transient: "bool | None" = None,
+                 fault_point: "str | None" = None):
+        if transient is None:
+            transient = not oom
+        super().__init__(message, transient=transient, fault_point=fault_point)
+        self.oom = oom
+
+
+class CollectiveError(ResilienceError):
+    """A multi-device collective step failed — the single-controller
+    analogue of losing an MPI rank mid-``MPI_Gatherv``. Transient by
+    default (ICI/DCN links flap); persistent failures degrade to the
+    single-device rung."""
+
+    transient = True
+
+
+class WorkerLostError(CollectiveError):
+    """A multihost worker or the cluster itself is unavailable:
+    ``jax.distributed`` init failed, the coordinator died, or a peer
+    process disappeared. ``reason`` carries the original failure class
+    name for logs/metrics."""
+
+    def __init__(self, message: str, *, reason: str = "unknown",
+                 transient: "bool | None" = None,
+                 fault_point: "str | None" = None):
+        super().__init__(message, transient=transient, fault_point=fault_point)
+        self.reason = reason
+
+
+# Substrings that mark an XLA runtime failure as resource exhaustion. XLA
+# surfaces OOM as XlaRuntimeError("RESOURCE_EXHAUSTED: ..."); host-side
+# allocation failure is MemoryError.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def classify_exception(exc: BaseException, site: str) -> ResilienceError:
+    """Map a raw exception from a guarded call site to the taxonomy.
+
+    ``site`` is the fault-point name of the call site (``device.put``,
+    ``backend.compile``, ``collective.step``, ...) — it decides the class
+    for generic runtime errors, because at the raw-exception level an XLA
+    failure during a collective dispatch is indistinguishable from one
+    during a single-device dispatch. Already-typed errors pass through
+    unchanged. The original exception is preserved as ``__cause__`` by the
+    raising caller (``raise classify_exception(e, site) from e``).
+    """
+    if isinstance(exc, ResilienceError):
+        return exc
+    text = f"{type(exc).__name__}: {exc}"
+    if isinstance(exc, MemoryError) or any(m in str(exc) for m in _OOM_MARKERS):
+        return DeviceError(f"[{site}] {text}", oom=True)
+    if site == "backend.compile":
+        return CompileError(f"[{site}] {text}")
+    if site == "multihost.init":
+        return WorkerLostError(f"[{site}] {text}", reason=type(exc).__name__)
+    if site == "collective.step":
+        return CollectiveError(f"[{site}] {text}")
+    if site == "arff.parse":
+        return DataError(f"[{site}] {text}")
+    # device.put, native.load, and any future execution-flavored site.
+    return DeviceError(f"[{site}] {text}", transient=isinstance(exc, OSError))

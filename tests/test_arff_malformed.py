@@ -1,0 +1,123 @@
+"""Malformed-ARFF hardening (ISSUE 2 satellite): truncated files,
+non-numeric fields, and unknown class labels raise ``DataError`` with
+file/line context — from BOTH parsers (pure-Python and native C++), never
+a crash, segfault, or untyped traceback."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.data import pyarff
+from knn_tpu.resilience.errors import DataError
+
+
+def _parsers():
+    out = [("py", pyarff.parse_arff_file)]
+    try:
+        from knn_tpu.native import arff_native
+
+        out.append(("cc", arff_native.parse))
+    except (ImportError, OSError):
+        pass
+    return out
+
+
+PARSERS = _parsers()
+
+
+def _write(tmp_path, content, name="bad.arff"):
+    p = tmp_path / name
+    p.write_text(content)
+    return str(p)
+
+
+HEADER = "@relation r\n@attribute x NUMERIC\n@attribute class {a,b}\n"
+
+# (case name, file content, required message fragment, required :line:)
+MALFORMED = [
+    ("empty_file", "", "no @attribute", None),
+    ("truncated_mid_attribute", "@relation r\n@attribute x {a,",
+     "unterminated nominal value list", ":2:"),
+    ("truncated_mid_quote", HEADER + "@data\n1,'a\n",
+     "unterminated quoted value", ":5:"),
+    ("non_numeric_field", HEADER + "@data\nfoo,a\n",
+     "cannot parse 'foo' as a number for 'x'", ":5:"),
+    ("unknown_class_label", HEADER + "@data\n1,zz\n",
+     "value 'zz' not in nominal set for 'class'", ":5:"),
+    ("missing_class_label",
+     "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n"
+     "@data\n1,?\n",
+     "missing class label", None),
+    ("data_before_header", "@relation r\n1,2\n",
+     "before", ":2:"),
+    ("binary_garbage", "\x01\x02\x7f\x00broken\x00\n@@@\n",
+     "", None),  # any located DataError is acceptable for random bytes
+]
+
+
+class TestMalformedFixtures:
+    @pytest.mark.parametrize("parser_name,parse", PARSERS)
+    @pytest.mark.parametrize(
+        "case,content,fragment,line", MALFORMED,
+        ids=[m[0] for m in MALFORMED],
+    )
+    def test_raises_located_data_error(
+        self, tmp_path, parser_name, parse, case, content, fragment, line
+    ):
+        path = _write(tmp_path, content, f"{case}.arff")
+        with pytest.raises(DataError) as ei:
+            parse(path)
+        msg = str(ei.value)
+        assert path.split("/")[-1] in msg, f"no file context in {msg!r}"
+        if fragment:
+            assert fragment in msg, (case, msg)
+        if line:
+            assert line in msg, f"no line context {line} in {msg!r}"
+
+    @pytest.mark.parametrize("parser_name,parse", PARSERS)
+    def test_directory_is_a_clean_error(self, tmp_path, parser_name, parse):
+        with pytest.raises((DataError, OSError)):
+            parse(str(tmp_path))
+
+    @pytest.mark.parametrize("parser_name,parse", PARSERS)
+    def test_missing_file_is_a_clean_error(self, parser_name, parse):
+        with pytest.raises((DataError, OSError)):
+            parse("/no/such/dir/no-such.arff")
+
+    def test_load_arff_missing_file_is_data_error(self):
+        # The load front-end types missing files too (the CLI's exit-2
+        # message branches on DataError, not strerror text).
+        from knn_tpu.data.arff import load_arff
+
+        with pytest.raises(DataError):
+            load_arff("/no/such/dir/no-such.arff")
+
+    @pytest.mark.parametrize("parser_name,parse", PARSERS)
+    def test_partial_row_at_eof_is_discarded_not_crashed(
+        self, tmp_path, parser_name, parse
+    ):
+        # Truncation INSIDE the final row keeps the dialect's documented
+        # discard rule (arff_parser.cpp:130-133) — a truncated download
+        # yields the complete prefix, not a crash.
+        # ",," is an empty cell -> located error even in the final row
+        # (empty cells error at scan time), while missing trailing cells at
+        # EOF are the discard case:
+        path = _write(tmp_path, HEADER + "@data\n1,a\n2,b\n3,,\n")
+        with pytest.raises(DataError):
+            parse(path)
+        path2 = _write(tmp_path, HEADER + "@data\n1,a\n2,b\n3\n", "p2.arff")
+        ds = parse(path2)
+        assert ds.num_instances == 2
+        np.testing.assert_array_equal(ds.labels, [0, 1])
+
+    def test_parsers_agree_on_error_text(self, tmp_path):
+        # Both parsers cite the same location and reason, so the CLI's
+        # one-line message is stable whichever parser is active.
+        if len(PARSERS) < 2:
+            pytest.skip("native parser not built")
+        path = _write(tmp_path, HEADER + "@data\nnope,a\n")
+        msgs = []
+        for _, parse in PARSERS:
+            with pytest.raises(DataError) as ei:
+                parse(path)
+            msgs.append(str(ei.value))
+        assert msgs[0] == msgs[1]

@@ -86,15 +86,16 @@ class TestCli:
         assert LINE_RE.match(out.getvalue().strip())
 
     def test_unknown_backend_clean_error(self, paths, capsys):
-        assert run([paths[0], paths[1], "1", "--backend", "no-such"]) == 1
+        # A name the ladder doesn't know is a typo: usage error, exit 2.
+        assert run([paths[0], paths[1], "1", "--backend", "no-such"]) == 2
         assert "unavailable" in capsys.readouterr().err
 
     def test_missing_file_clean_error(self, capsys):
-        assert run(["/nope/train.arff", "/nope/test.arff", "1"]) == 1
+        assert run(["/nope/train.arff", "/nope/test.arff", "1"]) == 2
         assert "error:" in capsys.readouterr().err
 
     def test_bad_k_clean_error(self, paths, capsys):
-        assert run([paths[0], paths[1], "999999"]) == 1
+        assert run([paths[0], paths[1], "999999"]) == 2
         assert "exceeds" in capsys.readouterr().err
 
     def test_malformed_arff_clean_error(self, tmp_path, capsys):
@@ -102,7 +103,7 @@ class TestCli:
         bad.write_text(
             "@relation r\n@attribute x NUMERIC\n@attribute class NUMERIC\n@data\nabc,0\n"
         )
-        assert run([str(bad), str(bad), "1"]) == 1
+        assert run([str(bad), str(bad), "1"]) == 2
         assert "error:" in capsys.readouterr().err
 
 
@@ -124,11 +125,11 @@ class TestSweepK:
         assert lines[1].split()[-1] == single.getvalue().strip().split()[-1]
 
     def test_sweep_rejects_garbage(self, paths, capsys):
-        assert run([paths[0], paths[1], "1", "--sweep-k", "a,b"]) == 1
+        assert run([paths[0], paths[1], "1", "--sweep-k", "a,b"]) == 2
         assert "positive integers" in capsys.readouterr().err
 
     def test_sweep_rejects_k_over_n(self, paths, capsys):
-        assert run([paths[0], paths[1], "1", "--sweep-k", "1,100000"]) == 1
+        assert run([paths[0], paths[1], "1", "--sweep-k", "1,100000"]) == 2
         assert "error:" in capsys.readouterr().err
 
     def test_sweep_rejects_incompatible_flags(self, paths, capsys):
@@ -136,8 +137,73 @@ class TestSweepK:
                       ["--query-batch", "8"], ["--engine", "full"],
                       ["--backend", "oracle"], ["--devices", "4"],
                       ["--query-tile", "64"], ["4"]):
-            assert run([paths[0], paths[1], "1", *extra, "--sweep-k", "1,5"]) == 1
+            assert run([paths[0], paths[1], "1", *extra, "--sweep-k", "1,5"]) == 2
             assert "incompatible" in capsys.readouterr().err
+
+
+class TestExitCodes:
+    """The pinned exit-code contract (docs/RESILIENCE.md): 0 success,
+    2 input/usage rejected before any classification, 1 runtime failure.
+    Always a one-line ``error:`` message, never a traceback."""
+
+    def test_success_is_zero(self, paths):
+        assert run([paths[0], paths[1], "1", "--backend", "oracle"],
+                   stdout=io.StringIO()) == 0
+
+    def test_k_below_one_exits_2(self, paths, capsys):
+        assert run([paths[0], paths[1], "0"]) == 2
+        assert "k must be >= 1" in capsys.readouterr().err
+
+    def test_k_over_n_train_exits_2(self, paths, capsys):
+        assert run([paths[0], paths[1], "999999"]) == 2
+        err = capsys.readouterr().err
+        assert err.startswith("error:") and len(err.strip().splitlines()) == 1
+
+    def test_missing_train_file_exits_2(self, paths, capsys):
+        assert run(["/no/such/train.arff", paths[1], "1"]) == 2
+        err = capsys.readouterr().err
+        assert err.startswith("error:") and "Traceback" not in err
+
+    def test_unknown_flag_exits_2(self, paths, capsys):
+        assert run([paths[0], paths[1], "1", "--bogus-flag"]) == 2
+
+    def test_no_fallback_with_unavailable_backend_exits_2(
+        self, paths, capsys, monkeypatch
+    ):
+        # The contradictory-flags case: asking for a backend that is not
+        # registered AND forbidding the ladder from substituting one.
+        import knn_tpu.backends as B
+
+        real = B.available_backends()
+        monkeypatch.setattr(
+            B, "available_backends", lambda: [b for b in real if b != "native"]
+        )
+        assert run([paths[0], paths[1], "1", "--persona", "main",
+                    "--no-fallback"]) == 2
+        err = capsys.readouterr().err
+        assert "--no-fallback" in err and err.startswith("error:")
+
+    def test_recall_target_without_approx_exits_2(self, paths, capsys):
+        assert run([paths[0], paths[1], "1", "--recall-target", "0.9"]) == 2
+        assert "--approx" in capsys.readouterr().err
+
+    def test_runtime_failure_exits_1_with_typed_error(
+        self, paths, capsys, monkeypatch
+    ):
+        # A persistent fault with the ladder disabled is a runtime failure:
+        # exit 1 and the typed class name on one line.
+        monkeypatch.setenv("KNN_TPU_FAULTS", "backend.compile=always")
+        monkeypatch.setenv("KNN_TPU_RETRY_BASE_MS", "0")
+        try:
+            assert run([paths[0], paths[1], "1", "--backend", "tpu",
+                        "--no-fallback"], stdout=io.StringIO()) == 1
+        finally:
+            from knn_tpu.resilience import faults
+
+            monkeypatch.delenv("KNN_TPU_FAULTS")
+            faults.install_from_env()
+        err = capsys.readouterr().err
+        assert "CompileError" in err and "Traceback" not in err
 
 
 class TestDumpPredictions:

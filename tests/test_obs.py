@@ -330,7 +330,8 @@ class TestCliRoundTrip:
                   "--metrics-out", "/no/such/dir/m.json"])
         obs.disable()
         obs.reset()
-        assert rc == 1
+        # Rejected before any parse/compute: usage exit code (2).
+        assert rc == 2
         assert "error:" in capsys.readouterr().err
 
     def test_sweep_carries_phases(self, paths, tmp_path):

@@ -1,0 +1,433 @@
+"""Chaos suite: the resilience subsystem under deterministic fault injection.
+
+The acceptance contract (ISSUE 2): every (fault point, mode) combination
+ends in recovery with bit-identical predictions, or in a typed error —
+never an unhandled traceback. Runs entirely on CPU; the injection harness
+(knn_tpu/resilience/faults.py) stands in for the hardware failures.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.resilience import degrade, faults, retry
+from knn_tpu.resilience.errors import (
+    CollectiveError,
+    CompileError,
+    DataError,
+    DeviceError,
+    ResilienceError,
+    WorkerLostError,
+    classify_exception,
+)
+from tests import fixtures
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    # Chaos at full speed: no backoff sleeps in tests.
+    monkeypatch.setenv("KNN_TPU_RETRY_BASE_MS", "0")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.install(None)  # never leak an armed plan into another test
+
+
+@pytest.fixture(scope="module")
+def golden(request):
+    """Oracle predictions for (small, k=3) — the bit-identical target every
+    recovered/degraded run must reproduce."""
+    from knn_tpu.backends.oracle import knn_oracle
+
+    train, test = fixtures.load_pair("small")
+    preds = knn_oracle(
+        train.features, train.labels, test.features, 3, train.num_classes
+    )
+    return train, test, preds
+
+
+class TestErrors:
+    def test_taxonomy_shape(self):
+        assert issubclass(DataError, ValueError)
+        assert issubclass(WorkerLostError, CollectiveError)
+        for cls in (CompileError, DeviceError, CollectiveError):
+            assert issubclass(cls, ResilienceError)
+            assert not issubclass(cls, ValueError)
+
+    def test_transient_defaults(self):
+        assert not DataError("x").transient
+        assert CompileError("x").transient
+        assert CollectiveError("x").transient
+        assert DeviceError("x").transient
+        assert not DeviceError("x", oom=True).transient
+
+    def test_classify_oom(self):
+        e = classify_exception(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating"),
+            "device.put",
+        )
+        assert isinstance(e, DeviceError) and e.oom and not e.transient
+
+    def test_classify_by_site(self):
+        assert isinstance(
+            classify_exception(RuntimeError("x"), "backend.compile"),
+            CompileError,
+        )
+        assert isinstance(
+            classify_exception(RuntimeError("x"), "collective.step"),
+            CollectiveError,
+        )
+        w = classify_exception(ConnectionError("refused"), "multihost.init")
+        assert isinstance(w, WorkerLostError) and w.reason == "ConnectionError"
+        assert isinstance(
+            classify_exception(OSError("io"), "device.put"), DeviceError
+        )
+        # Already-typed errors pass through unchanged.
+        d = DataError("x")
+        assert classify_exception(d, "device.put") is d
+
+
+class TestFaultPlan:
+    def test_modes(self):
+        plan = faults.FaultPlan("device.put=2, backend.compile=always")
+        assert plan.check("device.put") is not None
+        assert plan.check("device.put") is not None
+        assert plan.check("device.put") is None
+        for _ in range(5):
+            assert plan.check("backend.compile") is not None
+        assert plan.check("collective.step") is None  # unarmed point
+
+    def test_kind_override(self):
+        kind, err = faults.FaultPlan("device.put=once:oom").check("device.put")
+        assert kind == "oom" and isinstance(err, DeviceError) and err.oom
+        kind, err = faults.FaultPlan("native.load=once").check("native.load")
+        assert kind == "io" and isinstance(err, OSError)
+
+    def test_probabilistic_is_seed_deterministic(self):
+        def seq(seed):
+            plan = faults.FaultPlan("device.put=p0.5", seed=seed)
+            return [plan.check("device.put") is not None for _ in range(32)]
+
+        assert seq(7) == seq(7)
+        assert seq(7) != seq(8)  # astronomically unlikely to collide
+        assert any(seq(7)) and not all(seq(7))
+
+    def test_unknown_point_or_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.FaultPlan("no.such.point=once")
+        with pytest.raises(ValueError, match="bad fault mode"):
+            faults.FaultPlan("device.put=sometimes")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.FaultPlan("device.put=once:nope")
+
+    def test_inject_scopes_and_counts(self):
+        with faults.inject("device.put=once") as plan:
+            with pytest.raises(DeviceError):
+                faults.fault_point("device.put")
+            faults.fault_point("device.put")  # second activation passes
+        assert plan.stats()["device.put"] == {"fired": 1, "activations": 2}
+        faults.fault_point("device.put")  # disarmed again
+
+    def test_env_install(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_ENV, "backend.compile=once")
+        plan = faults.install_from_env()
+        try:
+            assert plan is not None
+            with pytest.raises(CompileError):
+                faults.fault_point("backend.compile")
+        finally:
+            monkeypatch.delenv(faults.FAULT_ENV)
+            assert faults.install_from_env() is None
+
+    def test_fault_point_typo_raises_under_armed_plan(self):
+        with faults.inject("device.put=always"):
+            with pytest.raises(ValueError, match="not a registered point"):
+                faults.fault_point("device.putt")
+
+
+class TestRetry:
+    def test_backoff_schedule(self):
+        assert retry.backoff_schedule(4, 25.0, 2000.0) == [25.0, 50.0, 100.0]
+        assert retry.backoff_schedule(5, 1000.0, 2000.0) == [
+            1000.0, 2000.0, 2000.0, 2000.0,
+        ]
+
+    def test_fail_once_recovers(self):
+        calls = []
+        with faults.inject("device.put=once"):
+            out = retry.guarded_call(
+                "device.put", lambda: calls.append(1) or 42
+            )
+        assert out == 42 and len(calls) == 1
+
+    def test_fail_always_raises_typed(self):
+        with faults.inject("device.put=always") as plan:
+            with pytest.raises(DeviceError):
+                retry.guarded_call("device.put", lambda: 42, attempts=3)
+        assert plan.stats()["device.put"]["fired"] == 3  # all attempts tried
+
+    def test_non_transient_not_retried(self):
+        with faults.inject("device.put=always:oom") as plan:
+            with pytest.raises(DeviceError) as ei:
+                retry.guarded_call("device.put", lambda: 42, attempts=3)
+        assert ei.value.oom
+        assert plan.stats()["device.put"]["activations"] == 1  # no retry
+
+    def test_raw_exception_classified_with_cause(self):
+        boom = RuntimeError("kaboom")
+
+        def fn():
+            raise boom
+
+        with pytest.raises(CompileError) as ei:
+            retry.guarded_call("backend.compile", fn, attempts=1)
+        assert ei.value.__cause__ is boom
+
+    def test_deadline_stops_retrying(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise OSError("transient")
+
+        with pytest.raises(DeviceError):
+            retry.guarded_call(
+                "device.put", fn, attempts=10, base_ms=50.0, deadline_ms=1.0,
+            )
+        assert len(calls) == 1  # first backoff would blow the deadline
+
+    def test_retry_counter_emitted(self):
+        obs.enable()
+        obs.reset()
+        try:
+            with faults.inject("device.put=once"):
+                retry.guarded_call("device.put", lambda: 1)
+            counters = obs.registry().to_json()
+            assert counters["knn_retry_total"][0]["value"] >= 1
+            assert "knn_fault_injected_total" in counters
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+def _ladder_predict(backend, train, test, k=3, opts=None, **kw):
+    return degrade.predict_with_ladder(backend, train, test, k, opts, **kw)
+
+
+class TestLadder:
+    def test_clean_run_is_not_degraded(self, golden):
+        train, test, want = golden
+        res = _ladder_predict("tpu", train, test)
+        assert not res.degraded and res.backend == "tpu"
+        np.testing.assert_array_equal(res.predictions, want)
+
+    def test_device_fail_always_degrades_to_host_rung(self, golden, capsys):
+        train, test, want = golden
+        with faults.inject("device.put=always"):
+            res = _ladder_predict("tpu", train, test)
+        assert res.degraded and res.backend in ("native", "oracle")
+        np.testing.assert_array_equal(res.predictions, want)
+        assert "falling back" in capsys.readouterr().err
+
+    def test_no_fallback_raises_typed(self, golden):
+        train, test, _ = golden
+        with faults.inject("device.put=always"):
+            with pytest.raises(DeviceError):
+                _ladder_predict("tpu", train, test, no_fallback=True)
+
+    def test_oom_halves_query_batch_then_succeeds(self, golden, capsys):
+        train, test, want = golden
+        # Two OOMs, then clean: the ladder should stay on the tpu rung and
+        # serve from a quartered batch.
+        with faults.inject("device.put=2:oom"):
+            res = _ladder_predict("tpu", train, test)
+        assert res.backend == "tpu"
+        assert res.opts["query_batch"] == test.num_instances // 4
+        np.testing.assert_array_equal(res.predictions, want)
+        assert "query_batch" in capsys.readouterr().err
+
+    def test_oom_always_exhausts_batches_then_degrades(self, golden, capsys):
+        train, test, want = golden
+        with faults.inject("device.put=always:oom"):
+            res = _ladder_predict("tpu", train, test)
+        assert res.degraded and res.backend in ("native", "oracle")
+        np.testing.assert_array_equal(res.predictions, want)
+
+    def test_sharded_degrades_to_single_device(self, golden, capsys):
+        train, test, want = golden
+        with faults.inject("collective.step=always"):
+            res = _ladder_predict("tpu-sharded", train, test)
+        assert res.degraded and res.backend == "tpu"
+        np.testing.assert_array_equal(res.predictions, want)
+
+    def test_fallback_counter_emitted(self, golden):
+        train, test, _ = golden
+        obs.enable()
+        obs.reset()
+        try:
+            with faults.inject("backend.compile=always"):
+                _ladder_predict("tpu", train, test)
+            recs = obs.registry().to_json()["knn_fallback_total"]
+            moves = {
+                (r["labels"]["from_backend"], r["labels"]["to"]) for r in recs
+            }
+            assert ("tpu", "tpu-pallas") in moves
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_unavailable_backend_static_fallback(self):
+        assert degrade.fallback_for("native", {"oracle", "tpu"}) == "oracle"
+        assert degrade.fallback_for("tpu-sharded", {"tpu", "oracle"}) == "tpu"
+        assert degrade.fallback_for("oracle", {"tpu"}) is None
+        assert degrade.known_backend("tpu-ring")
+        assert not degrade.known_backend("no-such")
+
+    def test_opts_sanitized_for_fallback_rungs(self):
+        opts = {"num_devices": 4, "engine": "full", "precision": "exact",
+                "approx": True}
+        out = degrade.opts_for_rung("tpu", "tpu-ring", opts)
+        assert "num_devices" not in out
+        assert out["engine"] == "auto"
+        assert out["approx"] is True and out["precision"] == "exact"
+        # The origin rung keeps everything verbatim.
+        assert degrade.opts_for_rung("tpu-ring", "tpu-ring", opts) == opts
+
+
+MATRIX_BACKEND = {
+    # fault point -> backend whose path activates it
+    "arff.parse": "tpu",
+    "device.put": "tpu",
+    "backend.compile": "tpu",
+    "collective.step": "tpu-sharded",
+    "native.load": "native",
+}
+
+
+class TestFaultMatrix:
+    """Every fault point x {fail-once, fail-always}: recovery with
+    bit-identical predictions or a typed error — never a raw traceback.
+    (multihost.init runs in TestMultihost; its recovery is solo mode.)"""
+
+    @pytest.mark.parametrize("point", sorted(MATRIX_BACKEND))
+    def test_fail_once_recovers_bit_identical(self, point, golden):
+        train, test, want = golden
+        backend = MATRIX_BACKEND[point]
+        if backend == "native":
+            pytest.importorskip("knn_tpu.backends.native")
+        # Recovery mode: an IO-flavored blip for the parse point (a
+        # deterministic DataError is *correctly* not retried), the point's
+        # natural transient kind elsewhere.
+        spec = f"{point}=once:io" if point == "arff.parse" else f"{point}=once"
+        datasets = fixtures.datasets_dir()
+        with faults.inject(spec) as plan:
+            if point == "arff.parse":
+                from knn_tpu.data.arff import load_arff
+
+                ds = load_arff(str(datasets / "small-train.arff"))
+                assert ds.num_instances == train.num_instances
+            else:
+                res = _ladder_predict(backend, train, test)
+                assert not res.degraded, (
+                    f"fail-once at {point} should be absorbed by retry, "
+                    f"not the ladder"
+                )
+                np.testing.assert_array_equal(res.predictions, want)
+        assert plan.stats()[point]["fired"] == 1
+
+    @pytest.mark.parametrize("point", sorted(MATRIX_BACKEND))
+    def test_fail_always_degrades_or_types(self, point, golden):
+        train, test, want = golden
+        backend = MATRIX_BACKEND[point]
+        if backend == "native":
+            pytest.importorskip("knn_tpu.backends.native")
+        with faults.inject(f"{point}=always"):
+            if point == "arff.parse":
+                from knn_tpu.data.arff import load_arff
+
+                with pytest.raises(DataError):
+                    load_arff(str(fixtures.datasets_dir() / "small-train.arff"))
+            else:
+                res = _ladder_predict(backend, train, test)
+                assert res.degraded
+                assert res.backend != backend
+                np.testing.assert_array_equal(res.predictions, want)
+
+    def test_native_parse_degrades_to_python_parser(self, golden):
+        # The ingest mini-ladder: native parser lost -> pure-Python twin,
+        # identical arrays.
+        train, _, _ = golden
+        from knn_tpu.data.arff import load_arff
+
+        path = str(fixtures.datasets_dir() / "small-train.arff")
+        with faults.inject("native.load=always"):
+            ds = load_arff(path)
+        np.testing.assert_array_equal(ds.features, train.features)
+        np.testing.assert_array_equal(ds.labels, train.labels)
+
+
+class TestMultihost:
+    def test_init_failure_degrades_to_solo(self, capsys, monkeypatch):
+        # The satellite contract: no bare `except Exception` swallow — the
+        # lost worker is logged, typed, counted, and the run degrades solo.
+        for var in ("KNN_TPU_COORD_ADDR", "KNN_TPU_NUM_PROCS",
+                    "KNN_TPU_PROC_ID"):
+            monkeypatch.delenv(var, raising=False)
+        from knn_tpu.parallel.multihost import _worker_main
+
+        d = fixtures.datasets_dir()
+        obs.enable()
+        obs.reset()
+        try:
+            with faults.inject("multihost.init=always"):
+                rc = _worker_main([
+                    str(d / "small-train.arff"), str(d / "small-test.arff"),
+                    "3",
+                ])
+            assert rc == 0
+            err = capsys.readouterr().err
+            assert "WorkerLostError" in err and "single-process" in err
+            recs = obs.registry().to_json()
+            assert recs["knn_worker_lost_total"][0]["value"] == 1
+            assert recs["knn_worker_lost_total"][0]["labels"]["reason"] \
+                == "injected"
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+class TestCliChaos:
+    def test_cli_recovers_from_transient_fault(self, monkeypatch, capsys):
+        from knn_tpu.cli import run
+
+        d = fixtures.datasets_dir()
+        monkeypatch.setenv("KNN_TPU_FAULTS", "device.put=once")
+        out = io.StringIO()
+        try:
+            rc = run([str(d / "small-train.arff"), str(d / "small-test.arff"),
+                      "3", "--backend", "tpu"], stdout=out)
+        finally:
+            monkeypatch.delenv("KNN_TPU_FAULTS")
+            faults.install_from_env()
+        assert rc == 0
+        assert "required" in out.getvalue()  # the canonical result line
+
+    def test_cli_degrades_and_still_answers(self, monkeypatch, capsys):
+        from knn_tpu.cli import run
+
+        d = fixtures.datasets_dir()
+        monkeypatch.setenv("KNN_TPU_FAULTS", "backend.compile=always")
+        out = io.StringIO()
+        try:
+            rc = run([str(d / "small-train.arff"), str(d / "small-test.arff"),
+                      "3", "--backend", "tpu"], stdout=out)
+        finally:
+            monkeypatch.delenv("KNN_TPU_FAULTS")
+            faults.install_from_env()
+        assert rc == 0
+        assert "falling back" in capsys.readouterr().err
+        assert "required" in out.getvalue()

@@ -97,7 +97,9 @@ class TestStringIngest:
 
         p = tmp_path / "s.arff"
         p.write_text(STRING_FILE)
-        assert run([str(p), str(p), "1", "--backend", "oracle"]) == 1
+        # Non-numeric feature columns are an input-validation rejection:
+        # the usage exit code (2) under the resilience exit-code contract.
+        assert run([str(p), str(p), "1", "--backend", "oracle"]) == 2
         err = capsys.readouterr().err
         assert "error:" in err and "host" in err
 
