@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos bench parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke bench parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -71,6 +71,14 @@ chaos:
 	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 -m pytest \
 		tests/test_resilience.py tests/test_arff_malformed.py -q \
 		-p no:cacheprovider
+
+# The serving lifecycle gate (docs/SERVING.md): build a fixture index,
+# boot `knn_tpu serve` as a subprocess, probe /predict (bit-identical to
+# the in-process model) + /healthz + /metrics, then SIGINT and require a
+# clean exit. stdlib-only probing; covers what the in-process server
+# tests cannot (signals, the ready banner, a real ephemeral-port bind).
+serve-smoke:
+	JAX_PLATFORMS=cpu python3 scripts/serve_smoke.py
 
 bench:
 	python3 bench.py

@@ -11,6 +11,16 @@ module with ``--persona``, keeping the reference's 3/4-positional-arg contract
 intact while optional trailing flags expose TPU knobs (mesh shape, precision,
 tiles — SURVEY.md §5.6). Timing wraps the classify region only, parsing
 excluded, and the result line is byte-compatible with main.cpp:146.
+
+Beyond the reference's one-shot shape, the CLI has subcommands (argv that
+does not start with one implies ``classify``, so the positional contract
+above is untouched):
+
+- ``classify``   — the reference-parity batch run (default);
+- ``save-index`` — parse a train ARFF once into a versioned index
+  artifact (``knn_tpu/serve/artifact.py``);
+- ``serve``      — a long-lived micro-batching HTTP server over such an
+  artifact (``knn_tpu/serve/`` — docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -28,12 +38,18 @@ from knn_tpu.utils.timing import RegionTimer, maybe_profile
 
 # Exit-code contract (pinned by tests/test_cli.py::TestExitCodes):
 # 0 = success; EXIT_USAGE (2) = the user's input was rejected before any
-# classification ran (bad flags, bad k, missing/malformed files, unknown
-# backend, --no-fallback against an unavailable backend); EXIT_RUNTIME (1)
-# = the computation itself failed (every ladder rung exhausted, artifact
-# write failures). One-line messages on stderr, never a traceback.
+# classification/serving ran (bad flags, bad k, missing/malformed files,
+# unknown backend, --no-fallback against an unavailable backend, a
+# missing/corrupt/newer-format index artifact, bad serve policy values);
+# EXIT_RUNTIME (1) = the computation itself failed (every ladder rung
+# exhausted, artifact write failures, a serve port that cannot bind).
+# One-line messages on stderr, never a traceback.
 EXIT_USAGE = 2
 EXIT_RUNTIME = 1
+
+# Subcommands (`classify` is implied when argv starts with anything else,
+# keeping the reference's positional invocation byte-compatible).
+_SUBCOMMANDS = ("classify", "serve", "save-index")
 
 # persona -> (default backend, usage string modeled on the reference's)
 _PERSONAS = {
@@ -48,10 +64,87 @@ _PERSONAS = {
 
 
 def build_parser() -> argparse.ArgumentParser:
+    """Top-level parser with subcommands. ``run`` prepends ``classify``
+    when argv doesn't start with a subcommand name, so the reference's
+    bare positional invocation (``knn_tpu train.arff test.arff k``) keeps
+    working unchanged."""
     p = argparse.ArgumentParser(
         prog="knn_tpu",
-        description="TPU-native KNN classifier (reference-parity CLI)",
+        description="TPU-native KNN: reference-parity batch classify, "
+                    "index building, and a micro-batching server",
     )
+    sub = p.add_subparsers(dest="command", metavar="{classify,serve,save-index}")
+    _add_classify_args(sub.add_parser(
+        "classify",
+        help="one-shot classify (default; bare positional argv implies it)",
+        description="TPU-native KNN classifier (reference-parity CLI)",
+    ))
+    _add_serve_args(sub.add_parser(
+        "serve",
+        help="long-lived micro-batching HTTP server over a prebuilt index "
+             "(docs/SERVING.md)",
+        description="Serve /predict, /kneighbors, /healthz, /metrics from "
+                    "an index artifact built by `knn_tpu save-index`. The "
+                    "process warms the configured batch shapes (first-call "
+                    "compile) before reporting ready.",
+    ))
+    _add_save_index_args(sub.add_parser(
+        "save-index",
+        help="build a versioned index artifact from a train ARFF file",
+        description="Parse TRAIN once and write an index artifact "
+                    "(arrays.npz + manifest.json) that `knn_tpu serve` "
+                    "boots from without re-parsing ARFF.",
+    ))
+    return p
+
+
+def _add_serve_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("index", help="index artifact directory (save-index output)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8099,
+                   help="TCP port (0 picks an ephemeral port, reported in "
+                   "the ready line)")
+    p.add_argument("--max-batch", type=int, default=256,
+                   help="close a micro-batch at this many coalesced query "
+                   "rows")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="...or when the oldest queued request has waited "
+                   "this long (the latency price of coalescing — "
+                   "docs/SERVING.md)")
+    p.add_argument("--max-queue-rows", type=int, default=4096,
+                   help="admission bound: queued rows beyond this are "
+                   "refused with HTTP 429")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline (HTTP 504 on expiry); "
+                   "requests may override with a deadline_ms body field")
+    p.add_argument("--warmup-batches", default=None, metavar="B1,B2,...",
+                   help="batch shapes to compile before reporting ready "
+                   "(default: 1 and --max-batch)")
+    p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
+                   help="force a JAX platform (e.g. cpu, tpu) before model "
+                   "warmup")
+
+
+def _add_save_index_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("train", help="train ARFF file")
+    p.add_argument("out", help="output artifact directory")
+    p.add_argument("--k", type=int, default=5)
+    p.add_argument("--family", choices=["classifier", "regressor"],
+                   default="classifier")
+    p.add_argument("--backend", default="tpu",
+                   help="classifier backend recorded in the manifest")
+    p.add_argument("--metric",
+                   choices=["euclidean", "manhattan", "chebyshev", "cosine"],
+                   default="euclidean")
+    p.add_argument("--weights", choices=["uniform", "distance"],
+                   default="uniform")
+    p.add_argument("--engine", choices=["auto", "stripe", "xla"],
+                   default="auto",
+                   help="candidate engine (regressor; for the classifier "
+                   "it is recorded as a backend option when not auto)")
+
+
+def _add_classify_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("train", help="train ARFF file")
     p.add_argument("test", help="test ARFF file")
     p.add_argument("k", type=int, help="number of neighbors")
@@ -152,7 +245,6 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-candidate expected recall for --approx "
                    "(0 < r <= 1, default 0.95; higher = slower, closer to "
                    "exact)")
-    return p
 
 
 def _dump_predictions(path: str, preds) -> bool:
@@ -222,7 +314,9 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
     scoped to this call: the prior global on/off state is restored on the
     way out, so a long-lived embedder that invokes the CLI once with
     artifacts does not keep paying tracing cost (or growing the span
-    buffer) on every later predict."""
+    buffer) on every later predict. (``serve`` keeps obs enabled for its
+    own lifetime — its /metrics endpoint IS the artifact — and never
+    returns here until shutdown.)"""
     was_enabled = obs.enabled()
     try:
         return _run(argv, stdout)
@@ -231,18 +325,46 @@ def run(argv: Optional[Sequence[str]] = None, stdout=None) -> int:
             obs.disable()
 
 
+def _normalize_argv(argv: Optional[Sequence[str]]) -> "list[str]":
+    """Prepend ``classify`` unless argv already names a subcommand (or asks
+    for top-level help) — the backward-compat shim that keeps the
+    reference's bare 3/4-positional invocation and every persona wrapper
+    working against the subcommand parser."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or (argv[0] not in _SUBCOMMANDS
+                    and argv[0] not in ("-h", "--help")):
+        argv = ["classify"] + argv
+    return argv
+
+
+def _apply_platform(platform: str) -> Optional[str]:
+    """Force a JAX platform pre-init (shared by classify and serve). Same
+    discipline as init_from_env (multihost.py): skip the no-op write
+    (jax.config.update clears initialized backends even for a same value).
+    Returns an error message or None."""
+    import jax
+
+    if getattr(jax.config, "jax_platforms", None) != platform:
+        try:
+            jax.config.update("jax_platforms", platform)
+        except RuntimeError as e:
+            return f"--platform {platform}: {e}"
+    return None
+
+
 def _run(argv: Optional[Sequence[str]], stdout) -> int:
     stdout = stdout or sys.stdout
     parser = build_parser()
     try:
-        args = parser.parse_args(argv)
+        args = parser.parse_args(_normalize_argv(argv))
     except SystemExit as e:
         return e.code if isinstance(e.code, int) else EXIT_USAGE
 
     # Re-read KNN_TPU_FAULTS so env-armed chaos runs work for in-process
     # run() calls too (the import-time arm only sees the spawn env);
-    # inject()-armed plans are preserved. A malformed spec is user input:
-    # one-line message, usage exit code.
+    # inject()-armed plans are preserved — for every subcommand: a served
+    # process is exactly where chaos testing matters. A malformed spec is
+    # user input: one-line message, usage exit code.
     from knn_tpu.resilience import faults
 
     try:
@@ -251,6 +373,137 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         print(f"error: {faults.FAULT_ENV}: {e}", file=sys.stderr)
         return EXIT_USAGE
 
+    if args.command == "serve":
+        return _run_serve(args, stdout)
+    if args.command == "save-index":
+        return _run_save_index(args, stdout)
+    return _run_classify(args, stdout)
+
+
+def _run_save_index(args, stdout) -> int:
+    """``knn_tpu save-index TRAIN OUT``: parse once, write the versioned
+    artifact ``knn_tpu serve`` boots from. Bad inputs (missing/malformed
+    ARFF, bad k, unknown backend, a clobber target that is not an
+    artifact) exit 2; a write failure mid-save exits 1."""
+    from knn_tpu.models.knn import KNNClassifier, KNNRegressor
+    from knn_tpu.resilience import degrade
+    from knn_tpu.serve.artifact import save_index
+
+    if args.family == "classifier" and not degrade.known_backend(args.backend):
+        print(f"error: backend '{args.backend}' unavailable", file=sys.stderr)
+        return EXIT_USAGE
+    try:
+        train = load_arff(args.train)
+        if args.family == "classifier":
+            opts = {} if args.engine == "auto" else {"engine": args.engine}
+            model = KNNClassifier(
+                args.k, backend=args.backend, metric=args.metric,
+                weights=args.weights, **opts,
+            )
+        else:
+            model = KNNRegressor(
+                args.k, weights=args.weights, metric=args.metric,
+                engine=args.engine,
+            )
+        model.fit(train)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    try:
+        out = save_index(model, args.out)
+    except ValueError as e:  # clobber refusal / non-directory target
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    except OSError as e:  # the write itself failed
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_RUNTIME
+    print(
+        f"wrote index {out}: {train.num_instances} rows x "
+        f"{train.num_features} features, family={args.family}, k={args.k}",
+        file=stdout,
+    )
+    return 0
+
+
+def _run_serve(args, stdout) -> int:
+    """``knn_tpu serve INDEX``: load the artifact, warm the configured
+    batch shapes, then serve until SIGINT/SIGTERM. Bad policy values or a
+    bad artifact exit 2 before any compute; bind/warmup failures exit 1."""
+    from knn_tpu.resilience.errors import DataError, ResilienceError
+
+    for bad, msg in (
+        (args.max_batch < 1, f"--max-batch must be >= 1, got {args.max_batch}"),
+        (args.max_wait_ms < 0,
+         f"--max-wait-ms must be >= 0, got {args.max_wait_ms}"),
+        (args.max_queue_rows < args.max_batch,
+         f"--max-queue-rows ({args.max_queue_rows}) must be >= --max-batch "
+         f"({args.max_batch})"),
+        (args.deadline_ms is not None and args.deadline_ms <= 0,
+         f"--deadline-ms must be > 0, got {args.deadline_ms}"),
+        (not 0 <= args.port <= 65535, f"--port out of range: {args.port}"),
+    ):
+        if bad:
+            print(f"error: {msg}", file=sys.stderr)
+            return EXIT_USAGE
+    warmup_batches = None
+    if args.warmup_batches is not None:
+        try:
+            warmup_batches = sorted(
+                {int(s) for s in args.warmup_batches.split(",") if s}
+            )
+            if not warmup_batches or warmup_batches[0] < 1:
+                raise ValueError
+        except ValueError:
+            print(f"error: --warmup-batches wants positive integers, got "
+                  f"{args.warmup_batches!r}", file=sys.stderr)
+            return EXIT_USAGE
+    if args.platform:
+        err = _apply_platform(args.platform)
+        if err is not None:
+            print(f"error: {err}", file=sys.stderr)
+            return EXIT_USAGE
+    from knn_tpu.serve.artifact import load_index
+    from knn_tpu.serve.server import ServeApp, make_server, serve_forever
+
+    try:
+        model = load_index(args.index)
+    except DataError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    # The /metrics endpoint is this process's observability artifact;
+    # serving without it would be flying blind.
+    obs.enable()
+    app = ServeApp(
+        model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue_rows=args.max_queue_rows, deadline_ms=args.deadline_ms,
+    )
+    try:
+        server = make_server(app, args.host, args.port)
+    except OSError as e:
+        print(f"error: cannot bind {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        app.close()
+        return EXIT_RUNTIME
+    host, port = server.server_address[:2]
+    try:
+        warmed = app.warm(warmup_batches)
+    except (ResilienceError, ValueError, RuntimeError) as e:
+        print(f"error: warmup failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        server.server_close()
+        app.close()
+        return EXIT_RUNTIME
+    print(
+        f"knn-tpu serve: ready on http://{host}:{port} "
+        f"(family={app.family}, k={model.k}, "
+        f"train_rows={model.train_.num_instances}, "
+        f"warmed={sorted(warmed)})",
+        file=stdout, flush=True,
+    )
+    return serve_forever(server)
+
+
+def _run_classify(args, stdout) -> int:
     obs_err = _setup_obs(args)
     if obs_err is not None:
         print(f"error: {obs_err}", file=sys.stderr)
@@ -295,19 +548,10 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
             return EXIT_USAGE
 
     if args.platform:
-        import jax
-
-        # Same discipline as init_from_env (multihost.py): skip the no-op
-        # write (jax.config.update clears initialized backends even for a
-        # same value) and keep the CLI's no-traceback contract if the
-        # backend is already pinned.
-        if getattr(jax.config, "jax_platforms", None) != args.platform:
-            try:
-                jax.config.update("jax_platforms", args.platform)
-            except RuntimeError as e:
-                print(f"error: --platform {args.platform}: {e}",
-                      file=sys.stderr)
-                return 1
+        err = _apply_platform(args.platform)
+        if err is not None:
+            print(f"error: {err}", file=sys.stderr)
+            return EXIT_RUNTIME
 
     # Multi-host init (the MPI_Init analogue) — no-op unless a cluster
     # launcher set coordinator env vars.

@@ -124,24 +124,89 @@ def _kneighbors_arrays(
 
 class AsyncResult:
     """Handle for an in-flight retrieval/predict (``kneighbors_async`` /
-    ``predict_async``): the device work and its device->host copies are
-    already dispatched when the handle is returned; :meth:`result` performs
-    the one blocking host sync and memoizes. On a tunneled device every
-    blocking sync costs a fixed ~100 ms round trip regardless of compute, so
-    M calls made through futures and resolved together pay ~one round trip
-    where M synchronous calls pay M (VERDICT r4 #6 — measured 102.8 ms/call
-    on a 0.75 ms kernel step)."""
+    ``predict_async``, and the serving batcher's request futures): the
+    device work and its device->host copies are already dispatched when the
+    handle is returned; :meth:`result` performs the one blocking host sync
+    and memoizes. On a tunneled device every blocking sync costs a fixed
+    ~100 ms round trip regardless of compute, so M calls made through
+    futures and resolved together pay ~one round trip where M synchronous
+    calls pay M (VERDICT r4 #6 — measured 102.8 ms/call on a 0.75 ms
+    kernel step).
 
-    __slots__ = ("_finish", "_value")
+    The handle is single-consumer: resolve it from one thread."""
+
+    __slots__ = ("_finish", "_value", "_waiter", "_outcome")
 
     def __init__(self, finish):
         self._finish = finish
         self._value = None
+        self._waiter = None
+        self._outcome = None
 
-    def result(self):
-        if self._finish is not None:
+    def result(self, timeout: "float | None" = None):
+        """Block until the result is ready and return it (memoized).
+
+        ``timeout`` (seconds) bounds the wait: on expiry a typed
+        :class:`~knn_tpu.resilience.errors.DeadlineExceededError` is raised
+        and the in-flight work keeps running — a later ``result()`` call
+        can still collect it. Two resolution strategies:
+
+        - a finish closure marked ``__accepts_timeout__ = True`` (the
+          serving batcher's event-backed futures) is called as
+          ``finish(timeout=...)`` and owns its own bounded wait;
+        - a generic closure (the deferred device fetches, which block in
+          jax) is moved to a daemon waiter thread the first time a timeout
+          is requested, and the caller joins it with the timeout.
+        """
+        if self._waiter is not None:
+            return self._join_waiter(timeout)
+        if self._finish is None:
+            return self._value
+        if timeout is None:
             self._value = self._finish()
             self._finish = None
+            return self._value
+        if getattr(self._finish, "__accepts_timeout__", False):
+            # The closure raises DeadlineExceededError itself on expiry,
+            # leaving the handle resolvable later.
+            self._value = self._finish(timeout=timeout)
+            self._finish = None
+            return self._value
+        import threading
+
+        fn, self._finish = self._finish, None
+        box = []
+
+        def run():
+            try:
+                box.append(("ok", fn()))
+            except BaseException as e:  # delivered to the consumer below
+                box.append(("err", e))
+
+        self._outcome = box
+        self._waiter = threading.Thread(
+            target=run, name="knn-async-result", daemon=True
+        )
+        self._waiter.start()
+        return self._join_waiter(timeout)
+
+    def _join_waiter(self, timeout):
+        from knn_tpu.resilience.errors import DeadlineExceededError
+
+        self._waiter.join(timeout)
+        if self._waiter.is_alive():
+            raise DeadlineExceededError(
+                f"async result not ready within {timeout * 1e3:.0f} ms; the "
+                f"work continues — call result() again to collect it"
+            )
+        kind, payload = self._outcome[0]
+        if kind == "err":
+            # Memoized failure: the dead waiter is kept so every later
+            # result() joins instantly and re-raises the same error.
+            raise payload
+        self._value = payload
+        self._waiter = None
+        self._outcome = None
         return self._value
 
 
@@ -317,7 +382,7 @@ class KNNClassifier:
         return fn(self.train_, test, self.k, metric=self.metric, **self.backend_opts)
 
     def _weighted_class_scores(
-        self, test: Dataset, neighbors=None
+        self, test: Optional[Dataset] = None, neighbors=None
     ) -> np.ndarray:
         train = self.train_
         dists, idx = neighbors if neighbors is not None else self.kneighbors(test)
@@ -328,6 +393,24 @@ class KNNClassifier:
         for c in range(train.num_classes):
             scores[:, c] = np.where(labels == c, w, 0.0).sum(axis=1)
         return scores
+
+    def predict_from_candidates(
+        self, dists: np.ndarray, idx: np.ndarray
+    ) -> np.ndarray:
+        """Predictions from an already-retrieved candidate set — the vote
+        half of :meth:`predict_async`, shared with the serving micro-batcher
+        (``knn_tpu/serve/batcher.py``), which retrieves candidates for a
+        whole coalesced batch and votes per request slice. Identical
+        predictions to :meth:`predict` by the shared (distance, train-index,
+        first-max vote) contracts (SURVEY.md §3.5)."""
+        train = self.train_
+        if self.weights == "distance":
+            scores = self._weighted_class_scores(neighbors=(dists, idx))
+            with obs.span("vote", weighted=True):
+                return np.argmax(scores, axis=1).astype(np.int32)
+        with obs.span("vote"):
+            labels = train.labels[np.minimum(idx, train.num_instances - 1)]
+            return _host_vote(labels, train.num_classes)
 
     def kneighbors(self, test: Dataset):
         """Per-query neighbor candidates: ``(dists [Q,k], indices [Q,k])``
@@ -373,16 +456,7 @@ class KNNClassifier:
         )
 
         def finish():
-            dists, idx = resolve()
-            if self.weights == "distance":
-                scores = self._weighted_class_scores(test, (dists, idx))
-                with obs.span("vote", weighted=True):
-                    return np.argmax(scores, axis=1).astype(np.int32)
-            with obs.span("vote"):
-                labels = train.labels[
-                    np.minimum(idx, train.num_instances - 1)
-                ]
-                return _host_vote(labels, train.num_classes)
+            return self.predict_from_candidates(*resolve())
 
         return AsyncResult(finish)
 

@@ -20,6 +20,14 @@ entries compute the model bytes for the call they are about to dispatch
 and record them here, so the static StableHLO audit and the runtime
 counter can be cross-checked for exact equality (tests/test_obs.py).
 
+The ``record_serve_*`` helpers are the serving subsystem's instrument set
+(``knn_tpu/serve/`` — docs/SERVING.md): admission counters
+(``knn_serve_requests_total`` / ``knn_serve_rejected_total`` /
+``knn_serve_deadline_expired_total``), per-batch coalescing histograms
+(``knn_serve_batch_size`` in requests, ``knn_serve_batch_rows`` in rows,
+``knn_serve_dispatch_ms``), and per-request latency
+(``knn_serve_queue_wait_ms``, ``knn_serve_request_ms``).
+
 Everything here is a no-op while ``knn_tpu.obs`` is disabled.
 """
 
@@ -87,6 +95,88 @@ def observed_backend(name: str, fn):
 
     wrapped.__wrapped_backend__ = fn
     return wrapped
+
+
+# Serving-path instrument ladders (knn_tpu/serve/). Request/queue/dispatch
+# latencies live in low single-digit ms when batching works and in the
+# hundreds when it doesn't, so the ladder starts below the default's floor.
+SERVE_MS_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 10000.0,
+)
+# Coalesced requests (and rows) per dispatched batch: powers of two up to
+# far past any sane max_batch.
+SERVE_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                       512.0, 1024.0)
+
+
+def record_serve_request(kind: str, rows: int) -> None:
+    """Count an ADMITTED serving request (rejected ones go to
+    :func:`record_serve_rejected` instead)."""
+    obs.counter_add(
+        "knn_serve_requests_total", 1,
+        help="serving requests admitted to the micro-batch queue", kind=kind,
+    )
+    obs.counter_add(
+        "knn_serve_rows_total", int(rows),
+        help="query rows admitted to the micro-batch queue", kind=kind,
+    )
+
+
+def record_serve_rejected(reason: str) -> None:
+    obs.counter_add(
+        "knn_serve_rejected_total", 1,
+        help="serving requests refused by admission control (HTTP 429)",
+        reason=reason,
+    )
+
+
+def record_serve_deadline_expired() -> None:
+    obs.counter_add(
+        "knn_serve_deadline_expired_total", 1,
+        help="serving requests whose deadline expired while queued "
+             "(HTTP 504)",
+    )
+
+
+def record_serve_queue_wait(ms: float, kind: str) -> None:
+    obs.histogram_observe(
+        "knn_serve_queue_wait_ms", ms, buckets=SERVE_MS_BUCKETS,
+        help="per-request wait from enqueue to batch close", kind=kind,
+    )
+
+
+def record_serve_batch(requests: int, rows: int, dispatch_ms: float) -> None:
+    """Record one dispatched micro-batch. ``knn_serve_batch_size`` counts
+    REQUESTS coalesced per dispatch — the number whose histogram exceeding
+    1 is the measured proof that dynamic batching engages (pinned by
+    tests/test_serve.py); ``knn_serve_batch_rows`` counts query rows."""
+    obs.histogram_observe(
+        "knn_serve_batch_size", requests, buckets=SERVE_BATCH_BUCKETS,
+        help="requests coalesced per dispatched micro-batch",
+    )
+    obs.histogram_observe(
+        "knn_serve_batch_rows", rows, buckets=SERVE_BATCH_BUCKETS,
+        help="query rows per dispatched micro-batch",
+    )
+    obs.histogram_observe(
+        "knn_serve_dispatch_ms", dispatch_ms, buckets=SERVE_MS_BUCKETS,
+        help="engine dispatch wall ms per micro-batch (kneighbors + "
+             "scatter)",
+    )
+
+
+def record_serve_request_done(kind: str, outcome: str, ms: float) -> None:
+    obs.counter_add(
+        "knn_serve_responses_total", 1,
+        help="serving requests completed, by outcome", kind=kind,
+        outcome=outcome,
+    )
+    obs.histogram_observe(
+        "knn_serve_request_ms", ms, buckets=SERVE_MS_BUCKETS,
+        help="per-request latency from enqueue to completion", kind=kind,
+        outcome=outcome,
+    )
 
 
 def record_transfer(nbytes: int, direction: str = "h2d",

@@ -37,7 +37,9 @@ from knn_tpu.resilience.errors import (
     CollectiveError,
     CompileError,
     DataError,
+    DeadlineExceededError,
     DeviceError,
+    OverloadError,
     ResilienceError,
     WorkerLostError,
     classify_exception,
@@ -54,7 +56,8 @@ from knn_tpu.resilience.degrade import (
 
 __all__ = [
     "ResilienceError", "DataError", "CompileError", "DeviceError",
-    "CollectiveError", "WorkerLostError", "classify_exception",
+    "CollectiveError", "WorkerLostError", "DeadlineExceededError",
+    "OverloadError", "classify_exception",
     "FaultPlan", "fault_point", "inject", "install_from_env",
     "guarded_call",
     "LADDER", "LadderResult", "fallback_for", "known_backend",

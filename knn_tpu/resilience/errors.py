@@ -19,6 +19,11 @@ branch on type, not text:
   analogue of a lost rank mid-collective).
 - :class:`WorkerLostError` — a multihost worker/cluster is gone or never
   materialized (``jax.distributed`` init failure, dead coordinator).
+- :class:`DeadlineExceededError` — a request's deadline elapsed before its
+  result was ready (the serving path's 504; also raised by
+  ``AsyncResult.result(timeout=...)``).
+- :class:`OverloadError`  — admission control rejected work because a
+  bounded queue is full (the serving path's 429).
 
 ``DataError`` subclasses ``ValueError`` and every class subclasses
 ``ResilienceError`` (itself an ``Exception``), so pre-existing
@@ -99,6 +104,23 @@ class WorkerLostError(CollectiveError):
                  fault_point: "str | None" = None):
         super().__init__(message, transient=transient, fault_point=fault_point)
         self.reason = reason
+
+
+class DeadlineExceededError(ResilienceError):
+    """A deadline elapsed before the work finished: a queued serving request
+    expired before dispatch, or ``AsyncResult.result(timeout=...)`` ran out
+    of time waiting for an in-flight computation. Never transient under the
+    retry machinery — the work is usually still running; re-submitting it
+    would double the load exactly when the system is slowest. The serving
+    front-end maps this to HTTP 504."""
+
+
+class OverloadError(ResilienceError):
+    """Admission control rejected new work: a bounded request queue is full
+    (or the component is shutting down). Not transient for the *internal*
+    retry loop — an immediate in-process re-attempt re-hits the same full
+    queue; the right retry is the CLIENT's, after backoff, which is exactly
+    what the serving front-end's HTTP 429 tells it."""
 
 
 # Substrings that mark an XLA runtime failure as resource exhaustion. XLA

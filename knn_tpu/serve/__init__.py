@@ -1,0 +1,42 @@
+"""Serving subsystem: long-lived, low-latency request serving.
+
+The reference — and every layer grown on top of it until now — is a batch
+CLI: parse ARFF, classify once, print, exit. That shape pays the expensive
+one-time costs (ARFF parse, host pad/transpose, device upload, first-call
+compile — BENCH_r05 measures train upload alone at ~537 ms) on EVERY
+invocation. A production KNN service pays them once and then answers many
+small concurrent requests; the pipelined kneighbors path already runs at
+~9.5 ms/call vs ~61 ms for naive per-call dispatch (BENCH_r05), and this
+package is the machinery that gets concurrent callers onto that path:
+
+- :mod:`knn_tpu.serve.batcher`  — the dynamic micro-batcher: a thread-safe
+  request queue that coalesces concurrent ``predict``/``kneighbors``
+  requests into one padded device batch under a ``max_batch`` /
+  ``max_wait_ms`` policy, dispatches through the model's existing engine
+  selection, and scatters per-request slices back to waiting
+  :class:`~knn_tpu.models.knn.AsyncResult` futures — bit-identical to the
+  synchronous API (pinned by tests/test_serve.py);
+- :mod:`knn_tpu.serve.artifact` — the versioned index artifact store:
+  save/load of a fitted model as ``arrays.npz`` + a JSON manifest
+  (k/metric/engine/dtype/schema hash), so a server boots from a prebuilt
+  index without re-parsing ARFF, plus the warmup step that triggers
+  first-call compilation for the configured batch shapes before the server
+  reports ready;
+- :mod:`knn_tpu.serve.server`   — the HTTP front-end (stdlib
+  ``ThreadingHTTPServer``, no new dependencies): ``/predict``,
+  ``/kneighbors``, ``/healthz``, ``/metrics`` (Prometheus text straight
+  from :mod:`knn_tpu.obs`), with admission control wired through the
+  resilience taxonomy — bounded queue → :class:`OverloadError` → 429,
+  per-request deadline → :class:`DeadlineExceededError` → 504.
+
+CLI: ``python -m knn_tpu save-index train.arff index/`` then
+``python -m knn_tpu serve index/``. Policy, artifact format, and endpoint
+contract: docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+from knn_tpu.serve.batcher import MicroBatcher
+from knn_tpu.serve.artifact import load_index, save_index, warmup
+
+__all__ = ["MicroBatcher", "load_index", "save_index", "warmup"]

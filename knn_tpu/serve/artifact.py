@@ -1,0 +1,277 @@
+"""Index artifact store: versioned save/load of a fitted model.
+
+A serving process must boot from a PREBUILT index, not from raw ARFF: the
+parse (and for huge sets the host pad/transpose) is the batch pipeline's
+cost, paid once at build time by ``knn_tpu save-index``, not on every
+server start. An artifact is a directory:
+
+    index/
+    ├── manifest.json   — format version, model family + hyperparameters
+    │                     (k, metric, weights, backend/engine, opts),
+    │                     array schema (rows/features/classes/dtype),
+    │                     attribute metadata, and a schema hash
+    └── arrays.npz      — the train arrays (features, labels, and
+    │                     raw_targets when the source kept them)
+
+The manifest is the contract: ``format`` gates forward compatibility
+(loaders reject artifacts from a NEWER format rather than misread them),
+and ``schema_hash`` — a digest over the attribute schema and array
+shapes/dtypes — pins manifest↔arrays consistency, so a hand-edited
+manifest or a swapped ``arrays.npz`` fails typed
+(:class:`~knn_tpu.resilience.errors.DataError`) instead of serving wrong
+answers. Round-trip equality with the in-memory model is pinned per
+backend in tests/test_serve.py.
+
+:func:`warmup` is the boot step between load and ready: it runs the
+retrieval path at the batch shapes the server is configured to dispatch,
+so first-call compilation (seconds at TPU scale) happens before
+``/healthz`` reports ready, never inside a user request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Attribute, Dataset
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor
+from knn_tpu.resilience.errors import DataError
+
+#: Bumped on any incompatible change to the manifest or array layout.
+ARTIFACT_FORMAT = 1
+MANIFEST_NAME = "manifest.json"
+ARRAYS_NAME = "arrays.npz"
+
+
+def schema_hash(ds: Dataset) -> str:
+    """Digest over the dataset's SCHEMA — attribute metadata plus array
+    shapes/dtypes, not the data values (hashing ~GB of train rows on every
+    server boot would be the kind of cost this store exists to avoid)."""
+    payload = json.dumps(
+        {
+            "attributes": [
+                {
+                    "name": a.name,
+                    "type": a.type,
+                    "nominal_values": a.nominal_values,
+                    "string_values": a.string_values,
+                }
+                for a in ds.attributes
+            ],
+            "features": [list(ds.features.shape), str(ds.features.dtype)],
+            "labels": [list(ds.labels.shape), str(ds.labels.dtype)],
+            "raw_targets": (
+                [list(ds.raw_targets.shape), str(ds.raw_targets.dtype)]
+                if ds.raw_targets is not None else None
+            ),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
+
+def _model_manifest(model) -> dict:
+    if isinstance(model, KNNClassifier):
+        return {
+            "family": "classifier",
+            "k": model.k,
+            "metric": model.metric,
+            "weights": model.weights,
+            "backend": model.backend_name,
+            "backend_opts": dict(model.backend_opts),
+        }
+    if isinstance(model, KNNRegressor):
+        return {
+            "family": "regressor",
+            "k": model.k,
+            "metric": model.metric,
+            "weights": model.weights,
+            "engine": model.engine,
+        }
+    raise TypeError(
+        f"cannot save a {type(model).__name__}; expected KNNClassifier or "
+        f"KNNRegressor"
+    )
+
+
+def save_index(model, path) -> Path:
+    """Write a fitted model to ``path`` (a directory; created if missing).
+
+    Refuses to clobber a non-empty directory that is not already an
+    artifact (no ``manifest.json``) — re-saving over an existing artifact
+    is fine. Raises ``ValueError``/``OSError`` for bad inputs/paths (the
+    CLI maps both to exit 2).
+    """
+    train = model.train_  # RuntimeError before fit
+    manifest = _model_manifest(model)
+    out = Path(path)
+    if out.exists():
+        if not out.is_dir():
+            raise ValueError(f"{out}: exists and is not a directory")
+        if any(out.iterdir()) and not (out / MANIFEST_NAME).exists():
+            raise ValueError(
+                f"{out}: non-empty directory without a {MANIFEST_NAME} — "
+                f"refusing to overwrite something that is not an index "
+                f"artifact"
+            )
+    out.mkdir(parents=True, exist_ok=True)
+    arrays = {"features": train.features, "labels": train.labels}
+    if train.raw_targets is not None:
+        arrays["raw_targets"] = train.raw_targets
+    np.savez(out / ARRAYS_NAME, **arrays)
+    manifest.update(
+        format=ARTIFACT_FORMAT,
+        created_unix=round(time.time(), 3),
+        relation=train.relation,
+        attributes=[
+            {
+                "name": a.name,
+                "type": a.type,
+                "nominal_values": a.nominal_values,
+                "string_values": a.string_values,
+            }
+            for a in train.attributes
+        ],
+        train_rows=int(train.num_instances),
+        num_features=int(train.num_features),
+        num_classes=int(train.num_classes),
+        dtype=str(train.features.dtype),
+        schema_hash=schema_hash(train),
+    )
+    tmp = out / (MANIFEST_NAME + ".tmp")
+    tmp.write_text(json.dumps(manifest, indent=2, sort_keys=True) + "\n")
+    # Manifest lands last and atomically: a crashed save leaves a directory
+    # load_index rejects, never a half-artifact that parses.
+    os.replace(tmp, out / MANIFEST_NAME)
+    return out
+
+
+def _read_manifest(root: Path) -> dict:
+    mf = root / MANIFEST_NAME
+    if not root.exists():
+        raise DataError(f"{root}: index artifact not found")
+    if not root.is_dir() or not mf.exists():
+        raise DataError(
+            f"{root}: not an index artifact (no {MANIFEST_NAME}); build one "
+            f"with `knn_tpu save-index`"
+        )
+    try:
+        manifest = json.loads(mf.read_text())
+    except (OSError, ValueError) as e:
+        raise DataError(f"{mf}: unreadable manifest: {e}") from e
+    fmt = manifest.get("format")
+    if not isinstance(fmt, int) or fmt < 1:
+        raise DataError(f"{mf}: missing/invalid format field: {fmt!r}")
+    if fmt > ARTIFACT_FORMAT:
+        raise DataError(
+            f"{mf}: artifact format {fmt} is newer than this build "
+            f"supports ({ARTIFACT_FORMAT}); rebuild the index or upgrade"
+        )
+    return manifest
+
+
+def load_index(path):
+    """Load an artifact into a fitted model (the inverse of
+    :func:`save_index`; equality with the saved model is pinned per
+    backend). Raises :class:`DataError` — typed, never a traceback — for
+    missing/corrupt/newer-format artifacts."""
+    root = Path(path)
+    manifest = _read_manifest(root)
+    import zipfile
+
+    try:
+        with np.load(root / ARRAYS_NAME, allow_pickle=False) as z:
+            features = z["features"]
+            labels = z["labels"]
+            raw_targets = z["raw_targets"] if "raw_targets" in z else None
+    # BadZipFile subclasses Exception directly (not OSError/ValueError) and
+    # is what a truncated/corrupt .npz actually raises.
+    except (OSError, KeyError, ValueError, zipfile.BadZipFile) as e:
+        raise DataError(f"{root / ARRAYS_NAME}: unreadable arrays: {e}") from e
+    attrs = [
+        Attribute(
+            a["name"], a["type"], a.get("nominal_values"),
+            a.get("string_values"),
+        )
+        for a in manifest.get("attributes", [])
+    ]
+    train = Dataset(
+        features=features, labels=labels,
+        relation=manifest.get("relation", ""), attributes=attrs,
+        raw_targets=raw_targets,
+    )
+    want = manifest.get("schema_hash")
+    got = schema_hash(train)
+    if want != got:
+        raise DataError(
+            f"{root}: schema hash mismatch (manifest {want!r}, arrays "
+            f"{got!r}) — the manifest and arrays.npz are not from the same "
+            f"save; rebuild the index"
+        )
+    family = manifest.get("family")
+    try:
+        if family == "classifier":
+            model = KNNClassifier(
+                k=manifest["k"], backend=manifest.get("backend", "tpu"),
+                metric=manifest.get("metric", "euclidean"),
+                weights=manifest.get("weights", "uniform"),
+                **manifest.get("backend_opts", {}),
+            )
+        elif family == "regressor":
+            model = KNNRegressor(
+                k=manifest["k"],
+                weights=manifest.get("weights", "uniform"),
+                metric=manifest.get("metric", "euclidean"),
+                engine=manifest.get("engine", "auto"),
+            )
+        else:
+            raise DataError(f"{root}: unknown model family {family!r}")
+        return model.fit(train)
+    except (KeyError, TypeError, ValueError) as e:
+        if isinstance(e, DataError):
+            raise
+        raise DataError(f"{root}: manifest does not describe a loadable "
+                        f"model: {e}") from e
+
+
+def warmup(model, batch_sizes=(1, 256), kinds=("predict",)) -> dict:
+    """Trigger first-call compilation for the given dispatch shapes.
+
+    Runs each ``kind`` at each batch size on synthetic rows drawn from the
+    fitted train set (real data distribution, so data-dependent branches
+    like the finite-input fast path warm the same executable serving will
+    use). Returns ``{f"{kind}@{rows}": wall_ms}`` — the server logs these
+    and flips ready only afterwards, so no user request ever pays the
+    multi-second compile.
+    """
+    train = model.train_
+    out = {}
+    with obs.span("serve.warmup", shapes=len(batch_sizes) * len(kinds)):
+        for rows in sorted({int(b) for b in batch_sizes}):
+            if rows < 1:
+                raise ValueError(f"warmup batch sizes must be >= 1: {rows}")
+            reps = -(-rows // train.num_instances)  # ceil
+            feats = np.tile(train.features, (reps, 1))[:rows]
+            ds = Dataset(feats, np.zeros(rows, np.int32))
+            for kind in kinds:
+                t0 = time.monotonic()
+                if kind == "predict":
+                    if isinstance(model, KNNClassifier):
+                        model.predict_from_candidates(*model.kneighbors(ds))
+                    else:
+                        model.predict(ds)
+                elif kind == "kneighbors":
+                    model.kneighbors(ds)
+                else:
+                    raise ValueError(f"unknown warmup kind {kind!r}")
+                out[f"{kind}@{rows}"] = round(
+                    (time.monotonic() - t0) * 1e3, 3
+                )
+    return out

@@ -1,0 +1,344 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into device batches.
+
+One TPU dispatch on a 64-row batch costs barely more than one on a single
+row (the kernel's grid is train-side; query rows ride the same sweep), so
+the way to serve many small concurrent requests fast is to NOT dispatch
+them individually: queue them, close a batch when either ``max_batch`` rows
+are waiting or the oldest request has waited ``max_wait_ms``, retrieve
+candidates for the whole batch in ONE engine dispatch, and scatter each
+request its slice. Latency cost: at most ``max_wait_ms`` of added queue
+wait; throughput gain: one dispatch amortized over every coalesced request
+(measured in bench.py's ``serving`` config).
+
+Correctness contract: every query row's retrieval is row-independent
+(per-row distance, per-row top-k, per-row vote — SURVEY.md §3.5), so the
+batched path is **bit-identical** to calling the synchronous API per
+request, whatever batch its rows landed in (pinned by
+tests/test_serve.py::TestBatcherBitIdentity across threads × engines ×
+both model families).
+
+Design notes:
+
+- One worker thread owns all device dispatch; HTTP handler threads only
+  enqueue and wait on futures. This sidesteps concurrent-dispatch
+  contention and makes the dispatch order deterministic (FIFO).
+- Both ``predict`` and ``kneighbors`` requests coalesce into the SAME
+  retrieval dispatch — predict is kneighbors + a host-side vote
+  (:meth:`KNNClassifier.predict_from_candidates`), so mixing kinds costs
+  nothing.
+- Admission control is row-bounded: ``max_queue_rows`` queued rows → new
+  submissions fail fast with :class:`OverloadError` (HTTP 429 upstream).
+  A per-request ``deadline_ms`` expires requests still queued when their
+  batch closes with :class:`DeadlineExceededError` (HTTP 504) instead of
+  dispatching work nobody is waiting for.
+- Futures are :class:`~knn_tpu.models.knn.AsyncResult` handles whose
+  finish closure waits on a per-request event and is marked
+  ``__accepts_timeout__``, so ``result(timeout=...)`` is a bounded wait
+  with no extra thread.
+
+Tuning ``max_wait_ms`` (docs/SERVING.md): it is the price of coalescing —
+0 disables batching in all but back-to-back arrival, a value near the
+per-dispatch wall time roughly doubles worst-case latency for ~max_batch×
+fewer dispatches. Start at ~¼ of your per-dispatch latency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import AsyncResult, KNNClassifier
+from knn_tpu.obs import instrument
+from knn_tpu.resilience.errors import DeadlineExceededError, OverloadError
+
+KINDS = ("predict", "kneighbors")
+
+
+class _Request:
+    """One queued request: features, kind, timing, and the completion
+    event its future waits on."""
+
+    __slots__ = (
+        "features", "kind", "rows", "enqueued_ns", "deadline_ns", "event",
+        "value", "error",
+    )
+
+    def __init__(self, features: np.ndarray, kind: str,
+                 deadline_ns: Optional[int]):
+        self.features = features
+        self.kind = kind
+        self.rows = features.shape[0]
+        self.enqueued_ns = time.monotonic_ns()
+        self.deadline_ns = deadline_ns
+        self.event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+
+    # -- completion (worker side) -----------------------------------------
+
+    def _finish(self, outcome: str) -> None:
+        try:
+            ms = (time.monotonic_ns() - self.enqueued_ns) / 1e6
+            instrument.record_serve_request_done(self.kind, outcome, ms)
+        except Exception:  # noqa: BLE001 — metrics must never block
+            pass  # completion: a waiter left unsignaled is a hung client
+        finally:
+            self.event.set()
+
+    def succeed(self, value) -> None:
+        self.value = value
+        self._finish("ok")
+
+    def fail(self, error: BaseException, outcome: str = "error") -> None:
+        self.error = error
+        self._finish(outcome)
+
+    # -- future (client side) ----------------------------------------------
+
+    def handle(self) -> AsyncResult:
+        def finish(timeout: Optional[float] = None):
+            if not self.event.wait(timeout):
+                raise DeadlineExceededError(
+                    f"{self.kind} request not served within "
+                    f"{timeout * 1e3:.0f} ms (still queued or in dispatch; "
+                    f"result() again to keep waiting)"
+                )
+            if self.error is not None:
+                raise self.error
+            return self.value
+
+        finish.__accepts_timeout__ = True
+        return AsyncResult(finish)
+
+
+class MicroBatcher:
+    """Thread-safe dynamic micro-batching front door for a fitted model.
+
+    ``model`` is a fitted :class:`KNNClassifier` or :class:`KNNRegressor`;
+    retrieval goes through ``model.kneighbors`` (the model's own engine
+    selection and device cache), votes/aggregation through the same host
+    twins the async API uses — so results are bit-identical to the
+    synchronous per-request calls.
+
+    ``max_batch``      — close a batch at this many queued rows;
+    ``max_wait_ms``    — ... or when the oldest queued request has waited
+                         this long, whichever first;
+    ``max_queue_rows`` — admission bound: queued rows beyond this fail
+                         submissions with :class:`OverloadError`.
+    """
+
+    def __init__(self, model, *, max_batch: int = 256,
+                 max_wait_ms: float = 2.0, max_queue_rows: int = 4096):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        if max_queue_rows < max_batch:
+            raise ValueError(
+                f"max_queue_rows ({max_queue_rows}) must be >= max_batch "
+                f"({max_batch}) or full batches could never form"
+            )
+        model.train_  # raises RuntimeError before fit — fail at build time
+        self._model = model
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue_rows = int(max_queue_rows)
+        self._cond = threading.Condition()
+        self._queue: deque[_Request] = deque()
+        self._queued_rows = 0
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="knn-serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, features, kind: str = "predict",
+               deadline_ms: Optional[float] = None) -> AsyncResult:
+        """Enqueue one request; returns the future immediately.
+
+        ``features``: one query row ``[D]`` or a row batch ``[q, D]``
+        (float32-coerced). ``deadline_ms`` bounds the QUEUE+DISPATCH time:
+        a request still undispatched when it expires fails with
+        :class:`DeadlineExceededError` instead of occupying a batch slot.
+        Raises :class:`OverloadError` when the queue is full or the
+        batcher is closed, :class:`ValueError` for shape mismatches.
+        """
+        if kind not in KINDS:
+            raise ValueError(f"unknown request kind {kind!r}; choose "
+                             f"{' or '.join(KINDS)}")
+        x = np.ascontiguousarray(features, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        want_d = self._model.train_.num_features
+        if x.ndim != 2 or x.shape[1] != want_d:
+            raise ValueError(
+                f"features must be [q, {want_d}] (or one [{want_d}] row), "
+                f"got {np.shape(features)}"
+            )
+        if x.shape[0] == 0:
+            raise ValueError("empty request (0 query rows)")
+        deadline_ns = (
+            time.monotonic_ns() + int(deadline_ms * 1e6)
+            if deadline_ms is not None else None
+        )
+        req = _Request(x, kind, deadline_ns)
+        with self._cond:
+            if self._closed:
+                instrument.record_serve_rejected("closed")
+                raise OverloadError("batcher is shut down")
+            if self._queued_rows + req.rows > self.max_queue_rows:
+                instrument.record_serve_rejected("queue_full")
+                raise OverloadError(
+                    f"request queue full ({self._queued_rows} rows queued, "
+                    f"bound {self.max_queue_rows}); retry after backoff"
+                )
+            self._queue.append(req)
+            self._queued_rows += req.rows
+            self._cond.notify_all()
+        instrument.record_serve_request(kind, req.rows)
+        return req.handle()
+
+    def predict(self, features, timeout: Optional[float] = None):
+        """Synchronous convenience: ``submit(..., 'predict').result()``."""
+        return self.submit(features, "predict").result(timeout=timeout)
+
+    def kneighbors(self, features, timeout: Optional[float] = None):
+        """Synchronous convenience: ``submit(..., 'kneighbors').result()``."""
+        return self.submit(features, "kneighbors").result(timeout=timeout)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting work, drain the queue, and join the worker.
+        Already-queued requests are still dispatched; new submissions
+        raise :class:`OverloadError`. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker side -------------------------------------------------------
+
+    def _collect(self) -> "list[_Request]":
+        """Block until a batch closes; [] only at shutdown with an empty
+        queue. Coalescing rule: from the arrival of the OLDEST queued
+        request, wait up to ``max_wait_ms`` for more work, closing early
+        at ``max_batch`` rows (or on shutdown). Whole requests only — a
+        request larger than ``max_batch`` dispatches alone, oversized."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()
+            if not self._queue:
+                return []
+            # The span covers only the coalescing window, not the idle
+            # block above — an idle server must not inflate queue totals.
+            with obs.span("serve.queue", waiting_rows=self._queued_rows):
+                deadline_ns = self._queue[0].enqueued_ns + int(
+                    self.max_wait_ms * 1e6
+                )
+                while not self._closed and self._queued_rows < self.max_batch:
+                    wait_s = (deadline_ns - time.monotonic_ns()) / 1e9
+                    if wait_s <= 0:
+                        break
+                    self._cond.wait(wait_s)
+            batch, rows = [], 0
+            while self._queue:
+                nxt = self._queue[0]
+                if batch and rows + nxt.rows > self.max_batch:
+                    break
+                batch.append(self._queue.popleft())
+                rows += nxt.rows
+            self._queued_rows -= rows
+            return batch
+
+    def _run(self) -> None:
+        # The worker must survive ANYTHING (an instrumentation bug
+        # included — found live: a conflicting-bucket registration): a
+        # dead worker strands every queued future until its timeout,
+        # which presents as a hung server. _Request._finish is itself
+        # exception-proof, so failing the batch here cannot re-raise.
+        while True:
+            batch = None
+            try:
+                batch = self._collect()
+                if not batch:
+                    return
+                self._dispatch(batch)
+            except BaseException as e:  # noqa: BLE001 — see above
+                for req in batch or ():
+                    if not req.event.is_set():
+                        req.fail(e)
+                if batch is None:
+                    # _collect itself failed: nothing to deliver the error
+                    # to; don't spin hot on a persistently broken path.
+                    time.sleep(0.05)
+
+    def _dispatch(self, batch: "list[_Request]") -> None:
+        now_ns = time.monotonic_ns()
+        live: "list[_Request]" = []
+        for req in batch:
+            instrument.record_serve_queue_wait(
+                (now_ns - req.enqueued_ns) / 1e6, req.kind
+            )
+            if req.deadline_ns is not None and now_ns > req.deadline_ns:
+                instrument.record_serve_deadline_expired()
+                req.fail(
+                    DeadlineExceededError(
+                        f"{req.kind} request expired in queue after "
+                        f"{(now_ns - req.enqueued_ns) / 1e6:.1f} ms"
+                    ),
+                    outcome="expired",
+                )
+                continue
+            live.append(req)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        t0 = time.monotonic()
+        try:
+            with obs.span("serve.batch", requests=len(live), rows=rows):
+                features = (
+                    live[0].features if len(live) == 1
+                    else np.concatenate([r.features for r in live])
+                )
+                batch_ds = Dataset(features, np.zeros(rows, np.int32))
+            with obs.span("serve.dispatch", requests=len(live), rows=rows):
+                dists, idx = self._model.kneighbors(batch_ds)
+                off = 0
+                for req in live:
+                    d = dists[off:off + req.rows]
+                    i = idx[off:off + req.rows]
+                    off += req.rows
+                    if req.kind == "kneighbors":
+                        req.succeed((d, i))
+                    elif isinstance(self._model, KNNClassifier):
+                        req.succeed(
+                            self._model.predict_from_candidates(d, i)
+                        )
+                    else:
+                        req.succeed(self._model._predict_from((d, i)))
+            instrument.record_serve_batch(
+                len(live), rows, (time.monotonic() - t0) * 1e3
+            )
+        except Exception as e:  # noqa: BLE001 — delivered per-future
+            obs.counter_add(
+                "knn_serve_errors_total",
+                help="micro-batch dispatches that raised (typed error "
+                     "delivered to every coalesced request)",
+                type=type(e).__name__,
+            )
+            for req in live:
+                if not req.event.is_set():
+                    req.fail(e)

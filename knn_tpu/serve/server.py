@@ -1,0 +1,271 @@
+"""The ``knn_tpu serve`` HTTP front-end (stdlib only — no new deps).
+
+A :class:`ServeApp` owns the loaded model, the micro-batcher, and the
+readiness flag; :class:`KNNServer` (a ``ThreadingHTTPServer``) gives every
+connection a handler thread that does nothing device-side itself — it
+validates, enqueues on the batcher, and waits on the request future, so
+the batcher's single worker thread stays the only device dispatcher.
+
+Endpoint contract (docs/SERVING.md):
+
+- ``POST /predict``     body ``{"instances": [[...], ...]}`` (rows of
+  ``num_features`` floats; optional ``"deadline_ms"`` overriding the
+  server default) → ``{"predictions": [...]}``.
+- ``POST /kneighbors``  same body → ``{"distances": [[...]], "indices":
+  [[...]]}`` (k per row, model order).
+- ``GET /healthz``      → 200 ``{"ready": true, ...}`` once warmup has
+  compiled the configured batch shapes; 503 before that (so a load
+  balancer never routes a request into a multi-second first-call
+  compile).
+- ``GET /metrics``      → the Prometheus text exposition straight from
+  the global :mod:`knn_tpu.obs` registry (``knn_serve_*`` plus every
+  model/backend metric the process has recorded).
+
+Admission control maps the resilience taxonomy to status codes:
+:class:`OverloadError` (bounded queue full) → **429**,
+:class:`DeadlineExceededError` (queue or result wait expired) → **504**,
+``ValueError``/bad JSON → **400**, any other typed failure → **500** with
+the error class name in the body. Always a JSON body, never a traceback.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from knn_tpu import obs
+from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.resilience.errors import DeadlineExceededError, OverloadError
+from knn_tpu.serve import artifact
+from knn_tpu.serve.batcher import MicroBatcher
+
+#: Request bodies past this are rejected 413 before json.loads allocates.
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class ServeApp:
+    """Everything the handlers need, built once at boot."""
+
+    def __init__(self, model, *, max_batch: int = 256,
+                 max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
+                 deadline_ms: Optional[float] = None):
+        self.model = model
+        self.family = (
+            "classifier" if isinstance(model, KNNClassifier) else "regressor"
+        )
+        self.deadline_ms = deadline_ms
+        self.batcher = MicroBatcher(
+            model, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue_rows=max_queue_rows,
+        )
+        self.ready = False
+        self.started_unix = time.time()
+        self.warmup_ms: dict = {}
+
+    def warm(self, batch_sizes=None) -> dict:
+        """Compile the serving dispatch shapes, then report ready.
+
+        One kind suffices: predict warmup runs the retrieval executable
+        (kneighbors) plus a host-side vote that compiles nothing, so a
+        separate kneighbors pass would re-dispatch the identical
+        executable for zero extra compilation."""
+        if batch_sizes is None:
+            batch_sizes = (1, self.batcher.max_batch)
+        self.warmup_ms = artifact.warmup(
+            self.model, batch_sizes=batch_sizes, kinds=("predict",)
+        )
+        self.ready = True
+        return self.warmup_ms
+
+    def close(self) -> None:
+        self.ready = False
+        self.batcher.close()
+
+    def health(self) -> dict:
+        return {
+            "ready": self.ready,
+            "family": self.family,
+            "k": self.model.k,
+            "train_rows": self.model.train_.num_instances,
+            "num_features": self.model.train_.num_features,
+            "uptime_s": round(time.time() - self.started_unix, 1),
+            "warmup_ms": self.warmup_ms,
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "knn-tpu-serve/1"
+    protocol_version = "HTTP/1.1"
+    # Socket timeout: a client stalling mid-body (or idling on keep-alive)
+    # must release its handler thread — without this, N slow connections
+    # pin N threads forever and starve the process before the batcher's
+    # admission control ever engages.
+    timeout = 60
+
+    @property
+    def app(self) -> ServeApp:
+        return self.server.app  # type: ignore[attr-defined]
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        # Per-request stderr lines at serving rates are an accidental DoS
+        # on the process's own stderr; the /metrics endpoint is the log.
+        pass
+
+    def _send(self, status: int, payload: dict, content_type="application/json"):
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, status: int, text: str, content_type: str):
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- GET ---------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 — stdlib dispatch name
+        if self.path == "/healthz":
+            h = self.app.health()
+            self._send(200 if h["ready"] else 503, h)
+        elif self.path == "/metrics":
+            self._send_text(
+                200, obs.registry().to_prometheus(),
+                "text/plain; version=0.0.4",
+            )
+        else:
+            self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    # -- POST --------------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802 — stdlib dispatch name
+        # Error replies sent before the body was drained must also close
+        # the connection: with HTTP/1.1 keep-alive the unread bytes would
+        # be parsed as the next request line.
+        if self.path not in ("/predict", "/kneighbors"):
+            self.close_connection = True
+            self._send(404, {"error": f"no such endpoint: {self.path}"})
+            return
+        kind = self.path[1:]
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if length <= 0:
+            self.close_connection = True
+            self._send(400, {"error": "a JSON body with Content-Length is "
+                                      "required"})
+            return
+        if length > MAX_BODY_BYTES:
+            self.close_connection = True
+            self._send(413, {"error": f"body {length} B exceeds the "
+                                      f"{MAX_BODY_BYTES} B bound"})
+            return
+        try:
+            body = json.loads(self.rfile.read(length))
+            instances = body["instances"]
+            deadline_ms = body.get("deadline_ms", self.app.deadline_ms)
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+                if not math.isfinite(deadline_ms) or deadline_ms <= 0:
+                    raise ValueError(f"deadline_ms must be a finite value "
+                                     f"> 0, got {deadline_ms}")
+            x = np.asarray(instances, dtype=np.float32)
+        except (KeyError, TypeError, ValueError) as e:
+            self._send(400, {"error": f"bad request body: {e}"})
+            return
+        t0 = time.monotonic()
+        try:
+            handle = self.app.batcher.submit(x, kind, deadline_ms=deadline_ms)
+        except OverloadError as e:
+            self._send(429, {"error": str(e)})
+            return
+        except ValueError as e:  # shape/kind rejection
+            self._send(400, {"error": str(e)})
+            return
+        timeout = deadline_ms / 1e3 if deadline_ms is not None else None
+        try:
+            value = handle.result(timeout=timeout)
+        except DeadlineExceededError as e:
+            self._send(504, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — the batcher delivers ANY
+            # failure to the future (that is its worker-survival contract);
+            # whatever arrives must become the documented JSON 500, never a
+            # handler traceback + dropped connection.
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        ms = round((time.monotonic() - t0) * 1e3, 3)
+        if kind == "predict":
+            self._send(200, {"predictions": np.asarray(value).tolist(),
+                             "ms": ms})
+        else:
+            dists, idx = value
+            self._send(200, {
+                "distances": np.asarray(dists).tolist(),
+                "indices": np.asarray(idx).tolist(),
+                "ms": ms,
+            })
+
+
+class KNNServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the :class:`ServeApp`. Daemon handler
+    threads: a hung client connection must not block process exit."""
+
+    daemon_threads = True
+
+    def __init__(self, address, app: ServeApp):
+        super().__init__(address, _Handler)
+        self.app = app
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return  # the client went away mid-response; not a server error
+        super().handle_error(request, client_address)
+
+
+def make_server(app: ServeApp, host: str = "127.0.0.1",
+                port: int = 0) -> KNNServer:
+    """Bind (port 0 → ephemeral; read ``server.server_address``)."""
+    return KNNServer((host, port), app)
+
+
+def serve_forever(server: KNNServer, *, banner=None) -> int:
+    """Run until SIGINT/SIGTERM, then shut down cleanly (stop accepting,
+    drain the batcher). Returns 0 — the `knn_tpu serve` main loop."""
+    import signal
+
+    def on_signal(signum, frame):
+        # shutdown() must come from another thread than serve_forever's.
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    previous = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            previous[sig] = signal.signal(sig, on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded use): caller manages stop
+    if banner:
+        print(banner, flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+        server.server_close()
+        server.app.close()
+    return 0

@@ -1,0 +1,168 @@
+"""Serve smoke gate (`make serve-smoke`): the full boot→probe→shutdown
+lifecycle of the serving stack, as a subprocess — the one thing the pytest
+suite's in-process server tests cannot cover (signal handling, the ready
+banner, a real ephemeral-port bind, clean exit code).
+
+Steps:
+1. build a fixture index with `knn_tpu save-index` (small-train.arff);
+2. boot `knn_tpu serve --port 0` and wait for the ready banner;
+3. probe /healthz (ready), /predict (predictions match an in-process
+   model on the same rows), /kneighbors (shapes), /metrics
+   (knn_serve_* counters present);
+4. SIGINT and require a clean exit within the grace period.
+
+Exit 0 on success; any failure prints a diagnosis and exits 1.
+stdlib-only (urllib, not curl: the gate must not depend on host tools).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
+BOOT_TIMEOUT_S = 120  # first-call compile on a cold cache can be slow
+SHUTDOWN_GRACE_S = 15
+
+
+def fail(msg: str, proc: "subprocess.Popen | None" = None) -> "int":
+    print(f"serve-smoke: FAIL: {msg}", file=sys.stderr)
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+    return 1
+
+
+def request(base: str, path: str, payload=None):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"} if payload else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def main() -> int:
+    from tests import fixtures  # noqa: E402 — repo-root import
+
+    d = fixtures.datasets_dir()
+    train_arff = str(d / "small-train.arff")
+    test_arff = str(d / "small-test.arff")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        index = os.path.join(tmp, "index")
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index, "--k", "3"],
+            env=env, capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: {build.stderr}")
+        print(f"serve-smoke: {build.stdout.strip()}")
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "knn_tpu.cli", "serve", index,
+             "--port", "0", "--max-batch", "16", "--max-wait-ms", "1"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        # Read the banner on a thread: a server that wedges silently
+        # before printing anything (stuck compile, deadlock) must FAIL the
+        # gate after BOOT_TIMEOUT_S, not hang CI on a blocking readline.
+        import queue
+        import threading
+
+        lines: "queue.Queue[str]" = queue.Queue()
+        reader = threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True,
+        )
+        reader.start()
+        base = None
+        deadline = time.monotonic() + BOOT_TIMEOUT_S
+        while time.monotonic() < deadline:
+            try:
+                line = lines.get(timeout=min(1.0, max(
+                    0.01, deadline - time.monotonic())))
+            except queue.Empty:
+                if proc.poll() is not None:
+                    return fail(
+                        f"server exited rc={proc.poll()} before ready", proc)
+                continue
+            print(f"serve-smoke: server: {line.rstrip()}")
+            m = READY_RE.search(line)
+            if m:
+                base = m.group(1)
+                break
+        if base is None:
+            return fail("no ready banner within the boot timeout", proc)
+
+        try:
+            st, body = request(base, "/healthz")
+            health = json.loads(body)
+            if st != 200 or not health.get("ready"):
+                return fail(f"/healthz not ready: {st} {body}", proc)
+            print(f"serve-smoke: /healthz ok (train_rows="
+                  f"{health['train_rows']})")
+
+            from knn_tpu.data.arff import load_arff
+            from knn_tpu.models.knn import KNNClassifier
+
+            train, test = load_arff(train_arff), load_arff(test_arff)
+            rows = test.features[:8]
+            want = KNNClassifier(k=3).fit(train).predict(
+                type(test)(rows, test.labels[:8])
+            ).tolist()
+            st, body = request(base, "/predict", {"instances": rows.tolist()})
+            got = json.loads(body).get("predictions")
+            if st != 200 or got != want:
+                return fail(f"/predict {st}: got {got}, want {want}", proc)
+            print(f"serve-smoke: /predict ok ({len(got)} rows, "
+                  f"bit-identical to the in-process model)")
+
+            st, body = request(
+                base, "/kneighbors", {"instances": rows[:2].tolist()})
+            kn = json.loads(body)
+            if st != 200 or len(kn["indices"]) != 2 or len(kn["indices"][0]) != 3:
+                return fail(f"/kneighbors {st}: {body[:200]}", proc)
+            print("serve-smoke: /kneighbors ok")
+
+            st, metrics = request(base, "/metrics")
+            needed = ("knn_serve_requests_total", "knn_serve_batch_size",
+                      "knn_serve_request_ms")
+            missing = [n for n in needed if n not in metrics]
+            if st != 200 or missing:
+                return fail(f"/metrics {st}: missing {missing}", proc)
+            print("serve-smoke: /metrics ok (knn_serve_* present)")
+        except Exception as e:  # noqa: BLE001 — smoke harness boundary
+            return fail(f"{type(e).__name__}: {e}", proc)
+
+        proc.send_signal(signal.SIGINT)
+        try:
+            rc = proc.wait(timeout=SHUTDOWN_GRACE_S)
+        except subprocess.TimeoutExpired:
+            return fail("server did not exit after SIGINT", proc)
+        if rc != 0:
+            return fail(f"server exited rc={rc} after SIGINT")
+        print("serve-smoke: clean shutdown, PASS")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

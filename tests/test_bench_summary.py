@@ -112,6 +112,24 @@ def _fat_record():
                 "xl_1M_sweep_ms_trials": trials,
                 "xl_1M_single_k10_ms_trials": trials,
             },
+            "serving": {
+                "metric": "serving_c8_batched_p50_ms", "value": 11.23,
+                "unit": "ms", "vs_baseline": None, "train_rows": 7509,
+                "max_batch": 64, "max_wait_ms": 2.0,
+                "requests_per_client": 30,
+                "levels": {
+                    str(c): {
+                        "batched_p50_ms": 11.23, "batched_p99_ms": 40.56,
+                        "batched_qps": 1234.5, "seq_p50_ms": 101.89,
+                        "seq_p99_ms": 250.12, "seq_qps": 98.7,
+                        "mean_batch_requests": 7.89,
+                    } for c in (1, 4, 8, 16)
+                },
+                "c8_batched_p50_ms": 11.23, "c8_seq_p50_ms": 101.89,
+                "c8_batched_qps": 1234.5, "c8_seq_qps": 98.7,
+                "batched_beats_seq_c8": True, "dropped_requests": 0,
+                "deadline_expired": 0, "failed_requests": 0,
+            },
         },
     }
 
@@ -132,15 +150,21 @@ def test_summary_keeps_headline_and_medians(bench):
     assert s["accuracy"] == 0.9948
     assert s["step_ms_median"] == 1234.456
     for name in ("mnist784", "xl", "xxl", "ingest", "sharded",
-                 "kneighbors", "sweepk"):
+                 "kneighbors", "sweepk", "serving"):
         assert "value" in s["configs"][name], name
         # Dropped as redundant with the config name (budget headroom).
         assert "metric" not in s["configs"][name]
     assert s["configs"]["mnist784"]["bf16_tflops"] == 110.7
     assert s["configs"]["xl"]["dist_evals_per_sec"] == 51.2
     assert s["configs"]["sharded"]["accuracy"] == 0.9948
+    # The serving row keeps the self-diagnosis counters and the win bit.
+    assert s["configs"]["serving"]["batched_beats_seq_c8"] is True
+    assert s["configs"]["serving"]["dropped_requests"] == 0
+    assert s["configs"]["serving"]["deadline_expired"] == 0
     # Trial lists must NOT survive into the summary.
     assert "step_ms_trials" not in json.dumps(s)
+    # Nor the serving config's per-level breakdown.
+    assert "levels" not in s["configs"]["serving"]
 
 
 def test_summary_truncates_config_errors(bench):

@@ -206,6 +206,99 @@ class TestExitCodes:
         assert "CompileError" in err and "Traceback" not in err
 
 
+class TestSubcommands:
+    """The subcommand layer (serve subsystem PR): bare positional argv
+    still routes to classify byte-compatibly; `save-index` and `serve`
+    exist with the pinned exit-code contract."""
+
+    def test_explicit_classify_matches_default(self, paths):
+        plain, explicit = io.StringIO(), io.StringIO()
+        assert run([paths[0], paths[1], "3", "--backend", "oracle"],
+                   stdout=plain) == 0
+        assert run(["classify", paths[0], paths[1], "3", "--backend",
+                    "oracle"], stdout=explicit) == 0
+        normalize = lambda s: re.sub(r"required \d+ ms", "required N ms", s)  # noqa: E731
+        assert normalize(plain.getvalue()) == normalize(explicit.getvalue())
+
+    def test_save_index_then_serve_load(self, paths, tmp_path):
+        import numpy as np
+
+        from knn_tpu.data.arff import load_arff
+        from knn_tpu.models.knn import KNNClassifier
+        from knn_tpu.serve.artifact import load_index
+
+        out = io.StringIO()
+        index = tmp_path / "idx"
+        assert run(["save-index", paths[0], str(index), "--k", "3"],
+                   stdout=out) == 0
+        assert "wrote index" in out.getvalue()
+        loaded = load_index(index)
+        train, test = load_arff(paths[0]), load_arff(paths[1])
+        np.testing.assert_array_equal(
+            loaded.predict(test), KNNClassifier(k=3).fit(train).predict(test)
+        )
+
+
+class TestServeExitCodes:
+    """2 = bad serve/artifact args rejected before any compute; the same
+    contract TestExitCodes pins for classify (docs/RESILIENCE.md)."""
+
+    def _err(self, capsys):
+        err = capsys.readouterr().err
+        assert "Traceback" not in err
+        return err
+
+    def test_save_index_missing_train_exits_2(self, tmp_path, capsys):
+        assert run(["save-index", "/no/such.arff", str(tmp_path / "x")]) == 2
+        assert "error:" in self._err(capsys)
+
+    def test_save_index_bad_k_exits_2(self, paths, tmp_path, capsys):
+        assert run(["save-index", paths[0], str(tmp_path / "x"),
+                    "--k", "0"]) == 2
+        assert "k must be >= 1" in self._err(capsys)
+
+    def test_save_index_k_over_n_exits_2(self, paths, tmp_path, capsys):
+        assert run(["save-index", paths[0], str(tmp_path / "x"),
+                    "--k", "999999"]) == 2
+        assert "exceeds" in self._err(capsys)
+
+    def test_save_index_unknown_backend_exits_2(self, paths, tmp_path,
+                                                capsys):
+        assert run(["save-index", paths[0], str(tmp_path / "x"),
+                    "--backend", "no-such"]) == 2
+        assert "unavailable" in self._err(capsys)
+
+    def test_save_index_foreign_dir_exits_2(self, paths, tmp_path, capsys):
+        victim = tmp_path / "home"
+        victim.mkdir()
+        (victim / "keep.txt").write_text("mine")
+        assert run(["save-index", paths[0], str(victim)]) == 2
+        assert "refusing" in self._err(capsys)
+        assert (victim / "keep.txt").exists()
+
+    def test_serve_missing_index_exits_2(self, capsys):
+        assert run(["serve", "/no/such/index"]) == 2
+        assert "not found" in self._err(capsys)
+
+    def test_serve_non_artifact_exits_2(self, tmp_path, capsys):
+        plain = tmp_path / "plain"
+        plain.mkdir()
+        (plain / "junk").write_text("x")
+        assert run(["serve", str(plain)]) == 2
+        assert "not an index artifact" in self._err(capsys)
+
+    def test_serve_bad_policy_exits_2(self, capsys):
+        for extra in (["--max-batch", "0"], ["--max-wait-ms", "-1"],
+                      ["--deadline-ms", "0"], ["--port", "99999"],
+                      ["--max-batch", "64", "--max-queue-rows", "8"],
+                      ["--warmup-batches", "a,b"]):
+            assert run(["serve", "/irrelevant/index", *extra]) == 2, extra
+            assert "error:" in self._err(capsys)
+
+    def test_serve_missing_positional_exits_2(self, capsys):
+        assert run(["serve"]) == 2
+
+
 class TestDumpPredictions:
     def test_dump_matches_oracle(self, paths, tmp_path):
         import numpy as np

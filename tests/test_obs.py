@@ -293,10 +293,17 @@ class TestCliRoundTrip:
         cli_rec = json.loads(out.getvalue().strip().splitlines()[-1])
         # --metrics-out and --json agree on the per-phase totals.
         assert cli_rec["phases"] == m["phases"]
-        # Per-phase totals sum to within 5% of the headline wall time.
+        # Per-phase totals sum to ~the headline wall time. The absolute
+        # floor covers the fixed sub-ms of ladder/metric bookkeeping that
+        # sits inside classify but outside the predict child span: on a
+        # fully warm path the small-fixture wall drops to ~2 ms, where
+        # that constant alone exceeds 5% relative (surfaced when the
+        # serving-PR CLI tests warmed more of the path ahead of this
+        # test; the uncovered gap itself is unchanged at ~0.2 ms).
         wall = m["wall_ms"]
         assert wall > 0
-        assert sum(m["phases"].values()) == pytest.approx(wall, rel=0.05)
+        assert sum(m["phases"].values()) == pytest.approx(
+            wall, rel=0.05, abs=0.5)
         # Perfetto trace: loadable, monotonic ts, matched B/E, >= 4 distinct
         # nested phases.
         trace = json.loads(t_path.read_text())

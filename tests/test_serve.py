@@ -1,0 +1,604 @@
+"""Serving subsystem contract tests (docs/SERVING.md).
+
+The load-bearing claim is bit-identity: whatever batch a request's rows
+were coalesced into, the batched path must return EXACTLY what the
+synchronous per-request API returns — across concurrent client threads,
+candidate engines, and both model families. Plus: coalescing measurably
+happens (the ``knn_serve_batch_size`` histogram sees batches > 1 request),
+admission control is typed (queue overflow → :class:`OverloadError` → 429,
+deadlines → :class:`DeadlineExceededError` → 504), and the index artifact
+round-trips to a model with identical predictions on every backend.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import AsyncResult, KNNClassifier, KNNRegressor
+from knn_tpu.resilience.errors import (
+    DataError, DeadlineExceededError, DeviceError, OverloadError,
+)
+from knn_tpu.serve.artifact import load_index, save_index, schema_hash, warmup
+from knn_tpu.serve.batcher import MicroBatcher
+
+
+def _problem(rng, n=300, q=40, d=5, c=5):
+    train_x = rng.integers(0, 4, (n, d)).astype(np.float32)  # grid -> ties
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.integers(0, 4, (q - q // 2, d)).astype(np.float32)]
+    )
+    train = Dataset(train_x, train_y)
+    test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+    return train, test
+
+
+@pytest.fixture
+def obs_on():
+    """Enabled + isolated observability for metric assertions."""
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+class TestAsyncResultTimeout:
+    def test_generic_finish_times_out_then_collects(self):
+        release = threading.Event()
+
+        def finish():
+            release.wait(10)
+            return 42
+
+        h = AsyncResult(finish)
+        with pytest.raises(DeadlineExceededError):
+            h.result(timeout=0.02)
+        release.set()
+        assert h.result(timeout=5) == 42
+        assert h.result() == 42  # memoized
+
+    def test_generic_finish_error_is_memoized(self):
+        def finish():
+            raise DeviceError("boom")
+
+        h = AsyncResult(finish)
+        with pytest.raises(DeviceError):
+            h.result(timeout=1)
+        with pytest.raises(DeviceError):  # same outcome on re-resolve
+            h.result(timeout=1)
+
+    def test_timeout_aware_finish_gets_the_timeout(self):
+        seen = []
+
+        def finish(timeout=None):
+            seen.append(timeout)
+            return "v"
+
+        finish.__accepts_timeout__ = True
+        assert AsyncResult(finish).result(timeout=0.5) == "v"
+        assert seen == [0.5]
+
+    def test_no_timeout_path_unchanged(self):
+        h = AsyncResult(lambda: 7)
+        assert h.result() == 7
+
+
+def _models(train, reg_train):
+    return [
+        ("clf-uniform", KNNClassifier(k=5, engine="xla").fit(train)),
+        ("clf-stripe", KNNClassifier(k=5, engine="stripe").fit(train)),
+        ("clf-auto", KNNClassifier(k=5).fit(train)),
+        ("clf-weighted", KNNClassifier(k=5, weights="distance").fit(train)),
+        ("reg-uniform", KNNRegressor(k=5, engine="xla").fit(reg_train)),
+        ("reg-weighted", KNNRegressor(k=5, weights="distance").fit(reg_train)),
+    ]
+
+
+class TestBatcherBitIdentity:
+    def test_concurrent_clients_match_sync(self, rng):
+        """The acceptance criterion: every request's batched result equals
+        the synchronous API on the same rows — threads × engines × both
+        model families, mixed predict/kneighbors kinds, varying row
+        counts, whatever batches the coalescer happened to form."""
+        train, test = _problem(rng)
+        reg_train = Dataset(
+            train.features, train.labels,
+            raw_targets=rng.standard_normal(
+                train.num_instances).astype(np.float32),
+        )
+        for name, model in _models(train, reg_train):
+            requests = []
+            for i in range(24):
+                lo = (3 * i) % (test.num_instances - 3)
+                rows = test.features[lo:lo + 1 + (i % 3)]
+                requests.append((rows, "kneighbors" if i % 4 == 3
+                                 else "predict"))
+            sync = []
+            for rows, kind in requests:
+                ds = Dataset(rows, np.zeros(len(rows), np.int32))
+                sync.append(model.kneighbors(ds) if kind == "kneighbors"
+                            else model.predict(ds))
+
+            with MicroBatcher(model, max_batch=16, max_wait_ms=20.0) as b:
+                results = [None] * len(requests)
+                errors = []
+
+                def client(ix):
+                    try:
+                        rows, kind = requests[ix]
+                        results[ix] = b.submit(rows, kind).result(timeout=60)
+                    except Exception as e:  # noqa: BLE001 — surfaced below
+                        errors.append((ix, e))
+
+                threads = [threading.Thread(target=client, args=(ix,))
+                           for ix in range(len(requests))]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            assert not errors, f"{name}: {errors}"
+            for ix, ((rows, kind), want, got) in enumerate(
+                    zip(requests, sync, results)):
+                if kind == "kneighbors":
+                    np.testing.assert_array_equal(
+                        got[0], want[0], err_msg=f"{name} req {ix} dists")
+                    np.testing.assert_array_equal(
+                        got[1], want[1], err_msg=f"{name} req {ix} indices")
+                else:
+                    np.testing.assert_array_equal(
+                        got, want, err_msg=f"{name} req {ix} predictions")
+
+    def test_single_row_convenience_roundtrip(self, rng):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        want = model.predict(test)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=1.0) as b:
+            got = np.concatenate(
+                [b.predict(test.features[i], timeout=60)
+                 for i in range(test.num_instances)]
+            )
+        np.testing.assert_array_equal(got, want)
+
+
+class TestBatcherPolicy:
+    def test_coalescing_actually_happens(self, rng, obs_on):
+        """knn_serve_batch_size must record batches of >1 request when
+        concurrent clients overlap a generous wait window — dynamic
+        batching measurably engaging, not just configured."""
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.kneighbors(test)  # warm the executable outside the window
+        with MicroBatcher(model, max_batch=32, max_wait_ms=250.0) as b:
+            handles = [b.submit(test.features[i]) for i in range(8)]
+            for h in handles:
+                h.result(timeout=60)
+        hist = obs_on.histogram("knn_serve_batch_size")
+        assert hist.count >= 1
+        assert hist.sum > hist.count, (
+            f"every batch held a single request (batches={hist.count}, "
+            f"requests={hist.sum}) — coalescing never engaged"
+        )
+
+    def test_queue_overflow_typed_and_counted(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        b = MicroBatcher(model, max_batch=2, max_queue_rows=2,
+                         max_wait_ms=2000.0)
+        try:
+            first = b.submit(test.features[0])
+            with pytest.raises(OverloadError, match="queue full"):
+                b.submit(test.features[:2])  # 1 queued + 2 > bound
+            second = b.submit(test.features[1])  # fills the batch: dispatch
+            assert first.result(timeout=60) is not None
+            assert second.result(timeout=60) is not None
+        finally:
+            b.close()
+        assert obs_on.counter("knn_serve_rejected_total",
+                              reason="queue_full").value == 1
+
+    def test_deadline_expires_in_queue(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        with MicroBatcher(model, max_batch=64, max_wait_ms=120.0) as b:
+            h = b.submit(test.features[0], deadline_ms=5)
+            with pytest.raises(DeadlineExceededError, match="expired"):
+                h.result(timeout=60)
+        assert obs_on.counter("knn_serve_deadline_expired_total").value == 1
+
+    def test_result_timeout_then_collect(self, rng):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+        with MicroBatcher(model, max_batch=64, max_wait_ms=300.0) as b:
+            h = b.submit(test.features)
+            with pytest.raises(DeadlineExceededError):
+                h.result(timeout=0.01)  # batch window still open
+            np.testing.assert_array_equal(h.result(timeout=60), want)
+
+    def test_close_drains_then_rejects(self, rng):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        b = MicroBatcher(model, max_batch=64, max_wait_ms=500.0)
+        handles = [b.submit(test.features[i]) for i in range(4)]
+        b.close()  # cuts the wait window short and drains
+        for h in handles:
+            assert h.result(timeout=60) is not None
+        with pytest.raises(OverloadError, match="shut down"):
+            b.submit(test.features[0])
+
+    def test_dispatch_failure_delivers_typed_error(self, rng, monkeypatch):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+
+        def boom(ds):
+            raise DeviceError("synthetic dispatch failure")
+
+        monkeypatch.setattr(model, "kneighbors", boom)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=1.0) as b:
+            h1 = b.submit(test.features[0])
+            h2 = b.submit(test.features[1], "kneighbors")
+            for h in (h1, h2):
+                with pytest.raises(DeviceError, match="synthetic"):
+                    h.result(timeout=60)
+
+    def test_worker_survives_instrumentation_failure(self, rng, obs_on,
+                                                     monkeypatch):
+        """An exception OUTSIDE the dispatch try (e.g. a metric-ladder
+        conflict in the recording helpers) must neither strand the batch's
+        futures nor kill the worker thread — a dead worker presents as a
+        hung server (found live: bench_serving registered
+        knn_serve_batch_size with conflicting buckets)."""
+        from knn_tpu.obs import instrument
+
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+
+        def broken(ms, kind):
+            raise ValueError("synthetic instrumentation bug")
+
+        monkeypatch.setattr(instrument, "record_serve_queue_wait", broken)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=1.0) as b:
+            with pytest.raises(ValueError, match="instrumentation"):
+                b.submit(test.features[0]).result(timeout=60)
+            monkeypatch.undo()
+            # The worker is still alive and serving.
+            assert b.predict(test.features[0], timeout=60) is not None
+
+    def test_shape_and_kind_rejected_at_submit(self, rng):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        with MicroBatcher(model, max_wait_ms=0.0) as b:
+            with pytest.raises(ValueError, match="features must be"):
+                b.submit(test.features[:, :2])
+            with pytest.raises(ValueError, match="kind"):
+                b.submit(test.features[0], "explain")
+            with pytest.raises(ValueError, match="empty"):
+                b.submit(test.features[:0])
+
+    def test_unfitted_model_rejected_at_build(self):
+        with pytest.raises(RuntimeError, match="fit"):
+            MicroBatcher(KNNClassifier(k=3))
+
+    def test_bad_policy_rejected(self, rng):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        with pytest.raises(ValueError, match="max_batch"):
+            MicroBatcher(model, max_batch=0)
+        with pytest.raises(ValueError, match="max_queue_rows"):
+            MicroBatcher(model, max_batch=64, max_queue_rows=8)
+
+
+class TestArtifact:
+    def test_round_trip_every_backend(self, rng, tmp_path):
+        """The artifact must reconstruct a model whose predictions are
+        bit-identical to the saved one — for every registered backend."""
+        from knn_tpu.backends import available_backends
+
+        train, test = _problem(rng)
+        for ix, backend in enumerate(available_backends()):
+            model = KNNClassifier(k=3, backend=backend).fit(train)
+            want = model.predict(test)
+            out = save_index(model, tmp_path / f"idx{ix}")
+            loaded = load_index(out)
+            assert loaded.backend_name == backend
+            assert loaded.k == 3
+            np.testing.assert_array_equal(
+                loaded.predict(test), want, err_msg=backend)
+
+    def test_regressor_round_trip_with_raw_targets(self, rng, tmp_path):
+        train, test = _problem(rng)
+        reg_train = Dataset(
+            train.features, train.labels,
+            raw_targets=rng.standard_normal(
+                train.num_instances).astype(np.float32),
+        )
+        model = KNNRegressor(k=4, weights="distance").fit(reg_train)
+        want = model.predict(test)
+        loaded = load_index(save_index(model, tmp_path / "reg"))
+        assert isinstance(loaded, KNNRegressor)
+        assert loaded.weights == "distance"
+        np.testing.assert_array_equal(loaded.predict(test), want)
+        np.testing.assert_array_equal(
+            loaded.train_.raw_targets, reg_train.raw_targets)
+
+    def test_manifest_fields(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        out = save_index(KNNClassifier(k=5).fit(train), tmp_path / "m")
+        manifest = json.loads((out / "manifest.json").read_text())
+        assert manifest["format"] == 1
+        assert manifest["family"] == "classifier"
+        assert manifest["k"] == 5
+        assert manifest["metric"] == "euclidean"
+        assert manifest["dtype"] == "float32"
+        assert manifest["train_rows"] == train.num_instances
+        assert manifest["num_features"] == train.num_features
+        assert manifest["num_classes"] == train.num_classes
+        assert manifest["schema_hash"] == schema_hash(train)
+
+    def test_missing_artifact_typed(self, tmp_path):
+        with pytest.raises(DataError, match="not found"):
+            load_index(tmp_path / "nope")
+
+    def test_not_an_artifact_typed(self, tmp_path):
+        plain = tmp_path / "plain"
+        plain.mkdir()
+        (plain / "junk.txt").write_text("x")
+        with pytest.raises(DataError, match="not an index artifact"):
+            load_index(plain)
+
+    def test_newer_format_rejected(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        out = save_index(KNNClassifier(k=3).fit(train), tmp_path / "v")
+        mf = out / "manifest.json"
+        doc = json.loads(mf.read_text())
+        doc["format"] = 999
+        mf.write_text(json.dumps(doc))
+        with pytest.raises(DataError, match="newer"):
+            load_index(out)
+
+    def test_schema_hash_mismatch_rejected(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        out = save_index(KNNClassifier(k=3).fit(train), tmp_path / "h")
+        mf = out / "manifest.json"
+        doc = json.loads(mf.read_text())
+        doc["schema_hash"] = "0" * 32
+        mf.write_text(json.dumps(doc))
+        with pytest.raises(DataError, match="schema hash mismatch"):
+            load_index(out)
+
+    def test_corrupt_arrays_typed(self, rng, tmp_path):
+        # BadZipFile is not OSError/ValueError; a truncated arrays.npz
+        # must still land in DataError (exit 2 from the CLI), never a
+        # traceback.
+        train, _ = _problem(rng)
+        out = save_index(KNNClassifier(k=3).fit(train), tmp_path / "c")
+        (out / "arrays.npz").write_bytes(b"definitely not a zip archive")
+        with pytest.raises(DataError, match="unreadable arrays"):
+            load_index(out)
+
+    def test_refuses_to_clobber_foreign_dir(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        victim = tmp_path / "precious"
+        victim.mkdir()
+        (victim / "thesis.txt").write_text("irreplaceable")
+        with pytest.raises(ValueError, match="refusing"):
+            save_index(KNNClassifier(k=3).fit(train), victim)
+        assert (victim / "thesis.txt").read_text() == "irreplaceable"
+
+    def test_resave_over_artifact_allowed(self, rng, tmp_path):
+        train, test = _problem(rng)
+        out = save_index(KNNClassifier(k=3).fit(train), tmp_path / "re")
+        save_index(KNNClassifier(k=5).fit(train), out)
+        assert load_index(out).k == 5
+
+    def test_warmup_reports_per_shape_wall(self, rng):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        out = warmup(model, batch_sizes=(1, 4), kinds=("predict",
+                                                       "kneighbors"))
+        assert set(out) == {"predict@1", "predict@4", "kneighbors@1",
+                            "kneighbors@4"}
+        assert all(ms >= 0 for ms in out.values())
+        with pytest.raises(ValueError, match=">= 1"):
+            warmup(model, batch_sizes=(0,))
+        with pytest.raises(ValueError, match="kind"):
+            warmup(model, kinds=("segment",))
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def served(rng, obs_on):
+    """A warmed in-process server on an ephemeral port."""
+    from knn_tpu.serve.server import ServeApp, make_server
+
+    train, test = _problem(rng)
+    model = KNNClassifier(k=3, engine="xla").fit(train)
+    app = ServeApp(model, max_batch=16, max_wait_ms=1.0)
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    app.warm((1, 4))
+    try:
+        yield f"http://{host}:{port}", model, test, app
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close()
+        thread.join(timeout=10)
+
+
+class TestServer:
+    def test_healthz_gates_on_warmup(self, rng, obs_on):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, _ = _problem(rng)
+        app = ServeApp(KNNClassifier(k=3, engine="xla").fit(train))
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://{host}:{port}"
+        try:
+            st, body = _get(base, "/healthz")
+            assert st == 503 and not json.loads(body)["ready"]
+            app.warm((1,))
+            st, body = _get(base, "/healthz")
+            health = json.loads(body)
+            assert st == 200 and health["ready"]
+            assert health["warmup_ms"]  # the compile happened pre-ready
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+
+    def test_predict_matches_sync(self, served):
+        base, model, test, _ = served
+        want = model.predict(test).tolist()
+        st, body = _post(base, "/predict", {"instances":
+                                            test.features.tolist()})
+        assert st == 200
+        assert body["predictions"] == want
+
+    def test_kneighbors_endpoint(self, served):
+        base, model, test, _ = served
+        want_d, want_i = model.kneighbors(
+            Dataset(test.features[:3], np.zeros(3, np.int32)))
+        st, body = _post(base, "/kneighbors",
+                         {"instances": test.features[:3].tolist()})
+        assert st == 200
+        np.testing.assert_array_equal(np.asarray(body["indices"]), want_i)
+        np.testing.assert_allclose(np.asarray(body["distances"]), want_d)
+
+    def test_metrics_exposition(self, served):
+        base, _, test, _ = served
+        _post(base, "/predict", {"instances": test.features[:2].tolist()})
+        st, text = _get(base, "/metrics")
+        assert st == 200
+        for needle in ("knn_serve_requests_total", "knn_serve_batch_size",
+                       "knn_serve_request_ms"):
+            assert needle in text, needle
+
+    def test_deadline_maps_to_504(self, rng, obs_on):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, test = _problem(rng)
+        # A wait window far past the deadline: the request cannot be served
+        # in time by construction.
+        app = ServeApp(KNNClassifier(k=3, engine="xla").fit(train),
+                       max_batch=64, max_wait_ms=2000.0)
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+        try:
+            app.warm((1,))
+            st, body = _post(base, "/predict", {
+                "instances": [test.features[0].tolist()], "deadline_ms": 20,
+            })
+            assert st == 504
+            assert "error" in body
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+
+    def test_overflow_maps_to_429(self, rng, obs_on):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, test = _problem(rng)
+        app = ServeApp(KNNClassifier(k=3, engine="xla").fit(train),
+                       max_batch=2, max_queue_rows=2, max_wait_ms=2000.0)
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+        try:
+            app.warm((1,))
+            # One row parks in the 2 s coalesce window; a 2-row request on
+            # top exceeds the queue bound deterministically.
+            first = {}
+
+            def park():
+                first["resp"] = _post(base, "/predict", {
+                    "instances": [test.features[0].tolist()]})
+
+            t = threading.Thread(target=park)
+            t.start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                st, body = _post(base, "/predict", {
+                    "instances": test.features[1:3].tolist()})
+                if st == 429:
+                    break
+                time.sleep(0.01)
+            assert st == 429, (st, body)
+            assert "error" in body
+            t.join(timeout=30)
+            assert first["resp"][0] == 200  # the parked request still served
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+
+    def test_malformed_requests_400(self, served):
+        base, _, test, _ = served
+        st, body = _post(base, "/predict", {"rows": [[1.0]]})
+        assert st == 400
+        st, body = _post(base, "/predict", {"instances": [[1.0, 2.0]]})
+        assert st == 400
+        st, body = _post(base, "/predict",
+                         {"instances": test.features[:1].tolist(),
+                          "deadline_ms": -5})
+        assert st == 400
+        # JSON "Infinity" parses to float inf; it must be a 400, not an
+        # OverflowError traceback in the handler thread.
+        st, body = _post(base, "/predict",
+                         {"instances": test.features[:1].tolist(),
+                          "deadline_ms": 1e400})
+        assert st == 400 and "finite" in body["error"]
+        req = urllib.request.Request(
+            base + "/predict", data=b"{not json", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+
+    def test_unknown_endpoint_404(self, served):
+        base = served[0]
+        assert _get(base, "/explain")[0] == 404
+        st, _ = _post(base, "/train", {"instances": []})
+        assert st == 404
