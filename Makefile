@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke bench parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak bench parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -79,6 +79,15 @@ chaos:
 # tests cannot (signals, the ready banner, a real ephemeral-port bind).
 serve-smoke:
 	JAX_PLATFORMS=cpu python3 scripts/serve_smoke.py
+
+# The self-healing gate (docs/SERVING.md §Ops runbook): boot the server
+# under a seeded fault burst, hammer it with concurrent closed-loop
+# clients, and assert the soak invariants — every request one terminal
+# outcome, 200s bit-identical to the oracle, no traceback bodies, the
+# breaker opens then re-closes with availability back to 100%, and a
+# final SIGTERM under load drains cleanly (exit 0). Short mode ~20 s.
+chaos-soak:
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/chaos_soak.py --short
 
 bench:
 	python3 bench.py

@@ -50,6 +50,51 @@ def _metric_dists(test_block, train_x, metric: str) -> np.ndarray:
     raise ValueError(f"unknown metric {metric!r}")
 
 
+def oracle_kneighbors(
+    train_x: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    metric: str = "euclidean",
+):
+    """Host-only candidate retrieval: ``(dists [Q,k], indices [Q,k])``
+    under the framework's (distance, train-index) tie order. This is THE
+    reference retrieval contract in one place — :func:`knn_oracle` votes
+    from it, and it is the terminal rung of the SERVING degradation
+    ladder (``knn_tpu/serve/batcher.py``), which cannot fail for device
+    reasons because no device is involved (predictions voted from these
+    candidates are bit-identical to every other rung — SURVEY.md §3.5).
+    """
+    from knn_tpu import obs
+
+    train_x = np.asarray(train_x, np.float32)
+    test_x = np.asarray(test_x, np.float32)
+    n, q = train_x.shape[0], test_x.shape[0]
+    k = min(k, n)
+    dists_out = np.empty((q, k), np.float32)
+    idx_out = np.empty((q, k), np.int64)
+    arange_n = np.arange(n)
+    # Process queries in chunks so the [chunk, N] distance block stays
+    # cache-friendly.
+    d_feat = max(train_x.shape[1], 1)
+    chunk = max(1, min(q, int(4e7) // max(n * d_feat, 1)))
+    for s in range(0, q, chunk):
+        e = min(q, s + chunk)
+        with obs.span("distance", metric=metric, backend="oracle"):
+            dists = _metric_dists(test_x[s:e], train_x, metric)
+            # Framework-wide policy: NaN distances count as +inf (the
+            # reference is UB here — SURVEY.md §3.5.5); +inf candidates
+            # are admitted in (distance, index) order.
+            np.nan_to_num(dists, copy=False, nan=np.inf)
+        with obs.span("top-k", backend="oracle"):
+            for row in range(e - s):
+                # Stable (distance, index) ordering == first-seen-wins
+                # insertion.
+                order = np.lexsort((arange_n, dists[row]))[:k]
+                idx_out[s + row] = order
+                dists_out[s + row] = dists[row][order]
+    return dists_out, idx_out
+
+
 def knn_oracle(
     train_x: np.ndarray,
     train_y: np.ndarray,
@@ -59,39 +104,18 @@ def knn_oracle(
     metric: str = "euclidean",
 ) -> np.ndarray:
     """Pure-array oracle: float32 [N,D] train, int32 [N] labels, float32 [Q,D]
-    queries -> int32 [Q] predictions."""
-    train_x = np.asarray(train_x, np.float32)
-    test_x = np.asarray(test_x, np.float32)
-    train_y = np.asarray(train_y, np.int32)
-    n = train_x.shape[0]
-    q = test_x.shape[0]
-    preds = np.empty(q, np.int32)
-    arange_n = np.arange(n)
-    # Process queries in chunks so the [chunk, N] distance block stays cache-friendly.
-    d_feat = max(train_x.shape[1], 1)
-    chunk = max(1, min(q, int(4e7) // max(n * d_feat, 1)))
+    queries -> int32 [Q] predictions — :func:`oracle_kneighbors`'s
+    candidates plus the reference vote (ties to the lowest class id)."""
     from knn_tpu import obs
 
-    for s in range(0, q, chunk):
-        e = min(q, s + chunk)
-        with obs.span("distance", metric=metric, backend="oracle"):
-            dists = _metric_dists(test_x[s:e], train_x, metric)
-            # Framework-wide policy: NaN distances count as +inf (the
-            # reference is UB here — SURVEY.md §3.5.5); +inf candidates are
-            # admitted in (distance, index) order.
-            np.nan_to_num(dists, copy=False, nan=np.inf)
-        with obs.span("top-k", backend="oracle"):
-            order = np.empty((e - s, k), np.int64)
-            for row in range(e - s):
-                # Stable (distance, index) ordering == first-seen-wins
-                # insertion.
-                order[row] = np.lexsort((arange_n, dists[row]))[:k]
-        with obs.span("vote", backend="oracle"):
-            for row in range(e - s):
-                counts = np.bincount(
-                    train_y[order[row]], minlength=num_classes
-                )
-                preds[s + row] = np.argmax(counts)
+    train_y = np.asarray(train_y, np.int32)
+    _, idx = oracle_kneighbors(train_x, test_x, k, metric)
+    q = idx.shape[0]
+    preds = np.empty(q, np.int32)
+    with obs.span("vote", backend="oracle"):
+        for row in range(q):
+            counts = np.bincount(train_y[idx[row]], minlength=num_classes)
+            preds[row] = np.argmax(counts)
     return preds
 
 

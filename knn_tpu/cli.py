@@ -117,6 +117,10 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="default per-request deadline (HTTP 504 on expiry); "
                    "requests may override with a deadline_ms body field")
+    p.add_argument("--drain-timeout-s", type=float, default=10.0,
+                   help="SIGTERM graceful-drain window: answer every "
+                   "in-flight request within this many seconds (remainders "
+                   "fail 504), then exit 0 (docs/SERVING.md ops runbook)")
     p.add_argument("--warmup-batches", default=None, metavar="B1,B2,...",
                    help="batch shapes to compile before reporting ready "
                    "(default: 1 and --max-batch)")
@@ -440,6 +444,8 @@ def _run_serve(args, stdout) -> int:
          f"({args.max_batch})"),
         (args.deadline_ms is not None and args.deadline_ms <= 0,
          f"--deadline-ms must be > 0, got {args.deadline_ms}"),
+        (args.drain_timeout_s <= 0,
+         f"--drain-timeout-s must be > 0, got {args.drain_timeout_s}"),
         (not 0 <= args.port <= 65535, f"--port out of range: {args.port}"),
     ):
         if bad:
@@ -462,11 +468,12 @@ def _run_serve(args, stdout) -> int:
         if err is not None:
             print(f"error: {err}", file=sys.stderr)
             return EXIT_USAGE
-    from knn_tpu.serve.artifact import load_index
+    from knn_tpu.serve import artifact
     from knn_tpu.serve.server import ServeApp, make_server, serve_forever
 
     try:
-        model = load_index(args.index)
+        model = artifact.load_index(args.index)
+        version = artifact.index_version(artifact.read_manifest(args.index))
     except DataError as e:
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
@@ -476,6 +483,7 @@ def _run_serve(args, stdout) -> int:
     app = ServeApp(
         model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         max_queue_rows=args.max_queue_rows, deadline_ms=args.deadline_ms,
+        index_path=args.index, index_version=version,
     )
     try:
         server = make_server(app, args.host, args.port)
@@ -497,10 +505,10 @@ def _run_serve(args, stdout) -> int:
         f"knn-tpu serve: ready on http://{host}:{port} "
         f"(family={app.family}, k={model.k}, "
         f"train_rows={model.train_.num_instances}, "
-        f"warmed={sorted(warmed)})",
+        f"index_version={version}, warmed={sorted(warmed)})",
         file=stdout, flush=True,
     )
-    return serve_forever(server)
+    return serve_forever(server, drain_timeout_s=args.drain_timeout_s)
 
 
 def _run_classify(args, stdout) -> int:

@@ -24,7 +24,13 @@ the backends, the sharded paths, multihost, and the CLI:
 - :mod:`knn_tpu.resilience.degrade` — the graceful-degradation ladder
   (``tpu → tpu-pallas → native → oracle``, sharded → single-device,
   OOM → halve ``query_batch``), with the CLI's ``--no-fallback`` escape
-  hatch (``knn_fallback_total``).
+  hatch (``knn_fallback_total``);
+- :mod:`knn_tpu.resilience.breaker` — the circuit breaker
+  (closed/open/half-open over a sliding failure window, Nygard's
+  *Release It!* pattern) the serving micro-batcher wraps its device
+  dispatch in: persistent failure short-circuits to the degraded rung,
+  half-open probes re-promote when the device recovers
+  (``knn_breaker_*`` metrics — docs/RESILIENCE.md).
 
 Everything is measured-zero-cost when idle: an unarmed fault point is one
 ``None`` check, and the retry wrapper sits only at per-predict
@@ -46,6 +52,7 @@ from knn_tpu.resilience.errors import (
 )
 from knn_tpu.resilience.faults import FaultPlan, fault_point, inject, install_from_env
 from knn_tpu.resilience.retry import guarded_call
+from knn_tpu.resilience.breaker import CircuitBreaker
 from knn_tpu.resilience.degrade import (
     LADDER,
     LadderResult,
@@ -59,7 +66,7 @@ __all__ = [
     "CollectiveError", "WorkerLostError", "DeadlineExceededError",
     "OverloadError", "classify_exception",
     "FaultPlan", "fault_point", "inject", "install_from_env",
-    "guarded_call",
+    "guarded_call", "CircuitBreaker",
     "LADDER", "LadderResult", "fallback_for", "known_backend",
     "predict_with_ladder",
 ]

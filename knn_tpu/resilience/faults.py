@@ -19,6 +19,9 @@ point is an error, so typos fail loudly):
 ``collective.step`` a sharded multi-device dispatch (query/train/ring paths)
 ``multihost.init``  ``jax.distributed`` cluster init (``parallel/multihost``)
 ``native.load``     native C++ library load/call (arff + runtime kernels)
+``serve.dispatch``  the micro-batcher worker's fast-rung device dispatch
+                    (``knn_tpu/serve/batcher.py`` — the serving chaos-soak
+                    harness injects here)
 ==================  =========================================================
 
 Fault-plan syntax (``KNN_TPU_FAULTS`` env var or :func:`inject`):
@@ -74,6 +77,7 @@ FAULT_POINTS: Dict[str, str] = {
     "collective.step": "collective",
     "multihost.init": "worker",
     "native.load": "io",
+    "serve.dispatch": "device",
 }
 
 _KINDS = ("data", "compile", "device", "collective", "worker", "io", "oom")

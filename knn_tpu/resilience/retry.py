@@ -21,12 +21,25 @@ Backoff timing is env-tunable so the chaos suite runs at full speed:
 ``KNN_TPU_RETRY_BASE_MS`` (default 25), ``KNN_TPU_RETRY_MAX_MS`` (default
 2000), ``KNN_TPU_RETRY_ATTEMPTS`` (default 3 total attempts),
 ``KNN_TPU_RETRY_DEADLINE_MS`` (default none). Tests set the base to 0.
+
+``KNN_TPU_RETRY_JITTER`` (default **off**) multiplies each backoff sleep
+by a uniform draw from ``[0.5, 1.0]`` — enough spread to de-synchronize
+the serving process's concurrent handler threads (a fault that fails N
+threads at once would otherwise have all N re-attempt in lockstep, an
+in-process retry storm), while staying below the deterministic schedule so
+the ``max_ms`` cap and deadline arithmetic keep holding. It defaults off
+because the chaos suite replays fault plans deterministically and jittered
+sleeps would vary the interleaving; when on, the draw sequence comes from
+a PRNG seeded by ``KNN_TPU_FAULT_SEED`` (the fault harness's seed), so a
+single-threaded replay is still reproducible.
 """
 
 from __future__ import annotations
 
 import errno
 import os
+import random
+import threading
 import time
 from typing import Callable, Optional, TypeVar
 
@@ -40,6 +53,47 @@ _BASE_ENV = "KNN_TPU_RETRY_BASE_MS"
 _MAX_ENV = "KNN_TPU_RETRY_MAX_MS"
 _ATTEMPTS_ENV = "KNN_TPU_RETRY_ATTEMPTS"
 _DEADLINE_ENV = "KNN_TPU_RETRY_DEADLINE_MS"
+_JITTER_ENV = "KNN_TPU_RETRY_JITTER"
+
+# Jitter PRNG: one shared, lock-protected stream so concurrent handler
+# threads draw DIFFERENT values (that difference is the whole point —
+# per-call reseeding would hand every thread the identical first draw and
+# re-synchronize the storm). Seeded lazily from KNN_TPU_FAULT_SEED.
+_jitter_lock = threading.Lock()
+_jitter_rng: Optional[random.Random] = None
+
+
+def jitter_enabled() -> bool:
+    return os.environ.get(_JITTER_ENV, "") not in ("", "0", "off", "false")
+
+
+def _seed_from_env() -> int:
+    from knn_tpu.resilience.faults import SEED_ENV
+
+    return int(os.environ.get(SEED_ENV, "0") or "0")
+
+
+def reset_jitter(seed: Optional[int] = None) -> None:
+    """(Re-)seed the jitter stream — tests use this to pin replay
+    determinism; ``None`` re-reads ``KNN_TPU_FAULT_SEED``."""
+    global _jitter_rng
+    with _jitter_lock:
+        _jitter_rng = random.Random(
+            seed if seed is not None else _seed_from_env()
+        )
+
+
+def apply_jitter(sleep_ms: float) -> float:
+    """One seeded draw: ``sleep_ms * U[0.5, 1.0]``. Bounded below at half
+    the deterministic sleep (backoff must keep backing off) and above at
+    the deterministic value (the ``max_ms`` cap and the caller's deadline
+    check stay valid)."""
+    global _jitter_rng
+    with _jitter_lock:
+        if _jitter_rng is None:
+            _jitter_rng = random.Random(_seed_from_env())
+        u = _jitter_rng.random()
+    return sleep_ms * (0.5 + 0.5 * u)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -128,6 +182,8 @@ def guarded_call(
             if attempt + 1 >= attempts:
                 break
             sleep_ms = sleeps[attempt]
+            if sleep_ms > 0 and jitter_enabled():
+                sleep_ms = apply_jitter(sleep_ms)
             elapsed_ms = (time.monotonic() - t0) * 1e3
             if deadline_ms is not None and elapsed_ms + sleep_ms >= deadline_ms:
                 break

@@ -25,9 +25,18 @@ package is the machinery that gets concurrent callers onto that path:
 - :mod:`knn_tpu.serve.server`   — the HTTP front-end (stdlib
   ``ThreadingHTTPServer``, no new dependencies): ``/predict``,
   ``/kneighbors``, ``/healthz``, ``/metrics`` (Prometheus text straight
-  from :mod:`knn_tpu.obs`), with admission control wired through the
-  resilience taxonomy — bounded queue → :class:`OverloadError` → 429,
-  per-request deadline → :class:`DeadlineExceededError` → 504.
+  from :mod:`knn_tpu.obs`), ``/admin/reload`` (hot index swap with
+  rollback), with admission control wired through the resilience
+  taxonomy — bounded queue → :class:`OverloadError` → 429, per-request
+  deadline → :class:`DeadlineExceededError` → 504.
+
+The process **self-heals** (docs/SERVING.md §Ops runbook): the worker's
+dispatch walks an in-loop degradation ladder behind a circuit breaker
+(bit-identical answers from a lower rung under device failure, OOM
+halves ``max_batch`` in place, half-open probes re-promote the fast
+rung), a supervisor restarts a dead worker, ``SIGTERM`` drains
+gracefully within ``--drain-timeout-s``, and ``SIGHUP`` hot-reloads the
+index — all soaked by ``make chaos-soak`` under seeded fault injection.
 
 CLI: ``python -m knn_tpu save-index train.arff index/`` then
 ``python -m knn_tpu serve index/``. Policy, artifact format, and endpoint

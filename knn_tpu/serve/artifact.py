@@ -153,6 +153,27 @@ def save_index(model, path) -> Path:
     return out
 
 
+def read_manifest(path) -> dict:
+    """Read + validate an artifact's manifest without loading the arrays
+    (the serving process uses this to learn the :func:`index_version` it
+    is about to swap in). Raises :class:`DataError` like
+    :func:`load_index`."""
+    return _read_manifest(Path(path))
+
+
+def index_version(manifest: dict) -> str:
+    """Opaque version tag for an artifact: ``<created_unix>-<hash8>``.
+
+    Two properties the hot-reload path needs: (1) re-saving an index —
+    even with identical data — yields a distinguishable tag (the
+    timestamp moves), so an operator can confirm WHICH build is serving;
+    (2) it is derived from manifest fields every format-1 artifact already
+    has, so no format bump. Carried in ``/healthz`` and every response's
+    ``index_version`` field (docs/SERVING.md)."""
+    return (f"{manifest.get('created_unix', 0)}-"
+            f"{str(manifest.get('schema_hash', ''))[:8]}")
+
+
 def _read_manifest(root: Path) -> dict:
     mf = root / MANIFEST_NAME
     if not root.exists():

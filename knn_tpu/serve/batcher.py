@@ -17,6 +17,28 @@ request, whatever batch its rows landed in (pinned by
 tests/test_serve.py::TestBatcherBitIdentity across threads × engines ×
 both model families).
 
+Self-healing (docs/SERVING.md §Ops runbook): the worker's device dispatch
+is wrapped in an in-loop **degradation ladder** and a **circuit breaker**
+(:mod:`knn_tpu.resilience.breaker`):
+
+- a typed device failure (``DeviceError``/``CompileError``/
+  ``CollectiveError``) on the model's configured fast rung walks down
+  ``fast → xla → oracle`` — every rung votes bit-identical predictions
+  (the ladder contract), so degradation changes *where* the batch is
+  retrieved, never *what* the client gets;
+- ``DeviceError(oom=True)`` halves ``max_batch`` in place and re-executes
+  the same rung in smaller chunks — degrading batch size before backend;
+- persistent fast-rung failure trips the breaker open: batches
+  short-circuit straight to the last-good degraded rung (no doomed
+  dispatch + ladder walk per batch), half-open probes re-try the fast
+  rung after the cooldown and re-promote it when the device recovers;
+- a request whose ``deadline_ms`` expires *mid-fallback* fails with
+  :class:`DeadlineExceededError` rather than getting a slow success from
+  a lower rung;
+- a **supervisor** thread restarts the worker if it ever dies (counted in
+  ``knn_serve_worker_restarts_total`` + logged) — queued futures survive
+  the restart instead of hanging until their timeouts.
+
 Design notes:
 
 - One worker thread owns all device dispatch; HTTP handler threads only
@@ -34,7 +56,14 @@ Design notes:
 - Futures are :class:`~knn_tpu.models.knn.AsyncResult` handles whose
   finish closure waits on a per-request event and is marked
   ``__accepts_timeout__``, so ``result(timeout=...)`` is a bounded wait
-  with no extra thread.
+  with no extra thread. Each handle's ``meta`` dict carries the
+  ``index_version`` and the ladder rung that served it.
+- :meth:`swap_model` atomically replaces the served model between batches
+  (the hot-reload path): every batch snapshots (model, version) once, so
+  a response reflects exactly one index — never a mix.
+- :meth:`begin_drain` (SIGTERM) refuses new admissions while already
+  queued work keeps dispatching; :meth:`fail_pending` gives whatever
+  cannot be answered in the drain window a typed terminal outcome.
 
 Tuning ``max_wait_ms`` (docs/SERVING.md): it is the price of coalescing —
 0 disables batching in all but back-to-back arrival, a value near the
@@ -44,6 +73,7 @@ fewer dispatches. Start at ~¼ of your per-dispatch latency.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from collections import deque
@@ -53,9 +83,17 @@ import numpy as np
 
 from knn_tpu import obs
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.models.knn import AsyncResult, KNNClassifier
+from knn_tpu.models.knn import AsyncResult, KNNClassifier, _kneighbors_arrays
 from knn_tpu.obs import instrument
-from knn_tpu.resilience.errors import DeadlineExceededError, OverloadError
+from knn_tpu.resilience import faults
+from knn_tpu.resilience.breaker import CircuitBreaker
+from knn_tpu.resilience.errors import (
+    CollectiveError,
+    CompileError,
+    DeadlineExceededError,
+    DeviceError,
+    OverloadError,
+)
 
 KINDS = ("predict", "kneighbors")
 
@@ -66,7 +104,7 @@ class _Request:
 
     __slots__ = (
         "features", "kind", "rows", "enqueued_ns", "deadline_ns", "event",
-        "value", "error",
+        "value", "error", "meta",
     )
 
     def __init__(self, features: np.ndarray, kind: str,
@@ -79,6 +117,7 @@ class _Request:
         self.event = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
+        self.meta: dict = {}
 
     # -- completion (worker side) -----------------------------------------
 
@@ -114,7 +153,7 @@ class _Request:
             return self.value
 
         finish.__accepts_timeout__ = True
-        return AsyncResult(finish)
+        return AsyncResult(finish, meta=self.meta)
 
 
 class MicroBatcher:
@@ -126,15 +165,20 @@ class MicroBatcher:
     twins the async API uses — so results are bit-identical to the
     synchronous per-request calls.
 
-    ``max_batch``      — close a batch at this many queued rows;
+    ``max_batch``      — close a batch at this many queued rows (halved in
+                         place when a dispatch OOMs);
     ``max_wait_ms``    — ... or when the oldest queued request has waited
                          this long, whichever first;
     ``max_queue_rows`` — admission bound: queued rows beyond this fail
-                         submissions with :class:`OverloadError`.
+                         submissions with :class:`OverloadError`;
+    ``index_version``  — opaque version tag stamped on every response's
+                         ``meta`` (the artifact store's version on the
+                         serving path; None for embedded use).
     """
 
     def __init__(self, model, *, max_batch: int = 256,
-                 max_wait_ms: float = 2.0, max_queue_rows: int = 4096):
+                 max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
+                 index_version: Optional[str] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -146,17 +190,24 @@ class MicroBatcher:
             )
         model.train_  # raises RuntimeError before fit — fail at build time
         self._model = model
+        self._index_version = index_version
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue_rows = int(max_queue_rows)
+        self.breaker = CircuitBreaker("serve.dispatch")
+        self.restarts = 0
+        self._last_rung = "fast"
+        self._degraded_rung = 1  # ladder position short-circuits start at
         self._cond = threading.Condition()
         self._queue: deque[_Request] = deque()
         self._queued_rows = 0
         self._closed = False
-        self._worker = threading.Thread(
-            target=self._run, name="knn-serve-batcher", daemon=True
+        self._draining = False
+        self._worker_error: Optional[BaseException] = None
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="knn-serve-supervisor", daemon=True
         )
-        self._worker.start()
+        self._supervisor.start()
 
     # -- client side -------------------------------------------------------
 
@@ -168,8 +219,9 @@ class MicroBatcher:
         (float32-coerced). ``deadline_ms`` bounds the QUEUE+DISPATCH time:
         a request still undispatched when it expires fails with
         :class:`DeadlineExceededError` instead of occupying a batch slot.
-        Raises :class:`OverloadError` when the queue is full or the
-        batcher is closed, :class:`ValueError` for shape mismatches.
+        Raises :class:`OverloadError` when the queue is full, the batcher
+        is draining, or it is closed; :class:`ValueError` for shape
+        mismatches.
         """
         if kind not in KINDS:
             raise ValueError(f"unknown request kind {kind!r}; choose "
@@ -194,6 +246,12 @@ class MicroBatcher:
             if self._closed:
                 instrument.record_serve_rejected("closed")
                 raise OverloadError("batcher is shut down")
+            if self._draining:
+                instrument.record_serve_rejected("draining")
+                raise OverloadError(
+                    "server is draining (shutting down); no new work "
+                    "accepted — retry against another replica"
+                )
             if self._queued_rows + req.rows > self.max_queue_rows:
                 instrument.record_serve_rejected("queue_full")
                 raise OverloadError(
@@ -214,14 +272,78 @@ class MicroBatcher:
         """Synchronous convenience: ``submit(..., 'kneighbors').result()``."""
         return self.submit(features, "kneighbors").result(timeout=timeout)
 
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def index_version(self) -> Optional[str]:
+        return self._index_version
+
+    @property
+    def current_rung(self) -> str:
+        """The ladder rung that answered the most recent batch."""
+        return self._last_rung
+
+    def swap_model(self, model, index_version: Optional[str] = None):
+        """Atomically replace the served model (the hot-reload path).
+
+        The worker snapshots ``(model, version)`` once per batch under the
+        queue lock, so every response reflects exactly one index — the old
+        or the new, never a mix. The caller is responsible for warming the
+        replacement first (``artifact.warmup``); the swap itself is one
+        reference assignment. Returns the previous version tag."""
+        model.train_  # fitted-model check, same as the constructor
+        with self._cond:
+            previous = self._index_version
+            self._model = model
+            self._index_version = index_version
+        return previous
+
+    def begin_drain(self) -> None:
+        """Stop admitting work (submissions raise :class:`OverloadError`)
+        while already-queued requests keep dispatching — the SIGTERM
+        half-close. Idempotent; :meth:`close` still ends the worker."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def pending_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def fail_pending(self, error: BaseException,
+                     outcome: str = "expired") -> int:
+        """Give every still-queued request a typed terminal outcome NOW
+        (the expired-drain path: remainders become 504s, not hangs).
+        Returns how many requests were failed."""
+        with self._cond:
+            doomed = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+            self._cond.notify_all()
+        for req in doomed:
+            if not req.event.is_set():
+                req.fail(error, outcome=outcome)
+        return len(doomed)
+
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop accepting work, drain the queue, and join the worker.
         Already-queued requests are still dispatched; new submissions
-        raise :class:`OverloadError`. Idempotent."""
+        raise :class:`OverloadError`. Idempotent.
+
+        Terminal-outcome guarantee: whatever the worker could not drain
+        (join timeout, a worker that died mid-shutdown) is failed with a
+        typed :class:`OverloadError` — a request accepted by ``submit``
+        NEVER ends without an outcome (pinned by
+        tests/test_serve.py::TestShutdownUnderLoad)."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        self._worker.join(timeout)
+        self._supervisor.join(timeout)
+        self.fail_pending(
+            OverloadError("batcher shut down before this request could be "
+                          "dispatched"),
+            outcome="error",
+        )
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -231,61 +353,281 @@ class MicroBatcher:
 
     # -- worker side -------------------------------------------------------
 
+    def _supervise(self) -> None:
+        """Run the worker thread; restart it if it ever dies unexpectedly.
+
+        The worker survives dispatch failures by design (they are fanned
+        to the batch's futures), so a dead worker means its own machinery
+        failed (`_collect`, the recovery path itself). Before the
+        supervisor, that was a silently hung server — every queued future
+        stranded until timeout. Now it is a counted, logged restart; the
+        queue is untouched, so queued requests get served by the
+        replacement."""
+        while True:
+            self._worker_error = None
+            worker = threading.Thread(
+                target=self._worker_body, name="knn-serve-batcher",
+                daemon=True,
+            )
+            worker.start()
+            worker.join()
+            with self._cond:
+                if self._closed:
+                    # Shutdown — a clean drain, or a death mid-shutdown
+                    # (don't restart-loop forever; close() gives whatever
+                    # is left a typed outcome either way).
+                    return
+            err = self._worker_error
+            self.restarts += 1
+            obs.counter_add(
+                "knn_serve_worker_restarts_total",
+                help="batcher worker threads restarted by the supervisor",
+            )
+            print(
+                f"warning: serve batcher worker died "
+                f"({type(err).__name__ if err else 'no exit status'}: {err}); "
+                f"restarting (restart #{self.restarts})",
+                file=sys.stderr,
+            )
+            time.sleep(0.05)  # don't spin hot on a persistently broken path
+
+    def _worker_body(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001 — handed to the supervisor
+            self._worker_error = e
+
     def _collect(self) -> "list[_Request]":
         """Block until a batch closes; [] only at shutdown with an empty
         queue. Coalescing rule: from the arrival of the OLDEST queued
         request, wait up to ``max_wait_ms`` for more work, closing early
-        at ``max_batch`` rows (or on shutdown). Whole requests only — a
-        request larger than ``max_batch`` dispatches alone, oversized."""
+        at ``max_batch`` rows (or on shutdown/drain — a draining server
+        dispatches immediately rather than holding the window open for
+        work that can no longer arrive). Whole requests only — a request
+        larger than ``max_batch`` dispatches alone, oversized."""
         with self._cond:
-            while not self._queue and not self._closed:
-                self._cond.wait()
-            if not self._queue:
-                return []
-            # The span covers only the coalescing window, not the idle
-            # block above — an idle server must not inflate queue totals.
-            with obs.span("serve.queue", waiting_rows=self._queued_rows):
-                deadline_ns = self._queue[0].enqueued_ns + int(
-                    self.max_wait_ms * 1e6
-                )
-                while not self._closed and self._queued_rows < self.max_batch:
-                    wait_s = (deadline_ns - time.monotonic_ns()) / 1e9
-                    if wait_s <= 0:
+            while True:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue:
+                    return []
+                # The span covers only the coalescing window, not the idle
+                # block above — an idle server must not inflate queue
+                # totals.
+                with obs.span("serve.queue", waiting_rows=self._queued_rows):
+                    deadline_ns = self._queue[0].enqueued_ns + int(
+                        self.max_wait_ms * 1e6
+                    )
+                    while (not self._closed and not self._draining
+                           and self._queued_rows < self.max_batch):
+                        wait_s = (deadline_ns - time.monotonic_ns()) / 1e9
+                        if wait_s <= 0:
+                            break
+                        self._cond.wait(wait_s)
+                batch, rows = [], 0
+                while self._queue:
+                    nxt = self._queue[0]
+                    if batch and rows + nxt.rows > self.max_batch:
                         break
-                    self._cond.wait(wait_s)
-            batch, rows = [], 0
-            while self._queue:
-                nxt = self._queue[0]
-                if batch and rows + nxt.rows > self.max_batch:
-                    break
-                batch.append(self._queue.popleft())
-                rows += nxt.rows
-            self._queued_rows -= rows
-            return batch
+                    batch.append(self._queue.popleft())
+                    rows += nxt.rows
+                self._queued_rows -= rows
+                if batch:
+                    return batch
+                # The queue was cleared under the window (fail_pending on
+                # an expired drain): every request already has its typed
+                # outcome — go back to waiting, this is NOT a shutdown
+                # (returning [] here would read as one and make the
+                # supervisor count a bogus worker death).
 
     def _run(self) -> None:
-        # The worker must survive ANYTHING (an instrumentation bug
-        # included — found live: a conflicting-bucket registration): a
-        # dead worker strands every queued future until its timeout,
-        # which presents as a hung server. _Request._finish is itself
-        # exception-proof, so failing the batch here cannot re-raise.
+        # Dispatch failures are delivered to the batch's futures
+        # (_Request._finish is itself exception-proof, so failing the
+        # batch cannot re-raise); anything that escapes _collect or the
+        # recovery path itself kills the worker — and the supervisor
+        # restarts it, counted and logged, with the queue intact.
         while True:
-            batch = None
+            batch = self._collect()
+            if not batch:
+                return
             try:
-                batch = self._collect()
-                if not batch:
-                    return
                 self._dispatch(batch)
-            except BaseException as e:  # noqa: BLE001 — see above
-                for req in batch or ():
+            except BaseException as e:  # noqa: BLE001 — fanned per-future
+                for req in batch:
                     if not req.event.is_set():
                         req.fail(e)
-                if batch is None:
-                    # _collect itself failed: nothing to deliver the error
-                    # to; don't spin hot on a persistently broken path.
-                    time.sleep(0.05)
+
+    # -- the degradation ladder --------------------------------------------
+
+    def _rungs(self, model):
+        """The serving ladder for this batch's model snapshot:
+        ``fast`` (the model's own configured retrieval — engine selection
+        + device cache), ``xla`` (the tiled candidate scan, skipped when
+        it IS the fast engine), ``oracle`` (pure NumPy — cannot fail for
+        device reasons). Every rung retrieves under the same (distance,
+        train-index) contract, so votes are bit-identical down the ladder.
+        """
+        train = model.train_
+        k, metric = model.k, model.metric
+
+        def fast(feats):
+            return model.kneighbors(
+                Dataset(feats, np.zeros(feats.shape[0], np.int32))
+            )
+
+        def xla(feats):
+            return _kneighbors_arrays(
+                train.features, feats, k, metric=metric, engine="xla",
+                cache=train.device_cache,
+            )
+
+        def oracle(feats):
+            from knn_tpu.backends.oracle import oracle_kneighbors
+
+            return oracle_kneighbors(train.features, feats, k, metric)
+
+        if isinstance(model, KNNClassifier):
+            engine = model._retrieval_engine()
+        else:
+            engine = model.engine
+        rungs = [("fast", fast)]
+        if engine != "xla":  # "auto" may resolve to stripe on real TPU
+            rungs.append(("xla", xla))
+        rungs.append(("oracle", oracle))
+        return rungs
+
+    def _call_rung(self, fn, feats):
+        """Dispatch ``feats`` through one rung, chunked to the CURRENT
+        ``max_batch`` (which OOM recovery may have shrunk below this
+        batch's row count). Row independence makes the chunked result
+        identical to the one-shot dispatch."""
+        cap = self.max_batch
+        if feats.shape[0] <= cap:
+            return fn(feats)
+        dists, idx = [], []
+        for s in range(0, feats.shape[0], cap):
+            d, i = fn(feats[s:s + cap])
+            dists.append(d)
+            idx.append(i)
+        return np.concatenate(dists), np.concatenate(idx)
+
+    def _expire_now(self, live: "list[_Request]") -> "list[_Request]":
+        """Deadline re-check between ladder rungs: a request that expired
+        while a higher rung was failing gets its 504 NOW — never a slow
+        success from a lower rung."""
+        now_ns = time.monotonic_ns()
+        keep = []
+        for req in live:
+            if req.deadline_ns is not None and now_ns > req.deadline_ns:
+                instrument.record_serve_deadline_expired()
+                req.fail(
+                    DeadlineExceededError(
+                        f"{req.kind} request deadline expired after "
+                        f"{(now_ns - req.enqueued_ns) / 1e6:.1f} ms while "
+                        f"degradation was in progress"
+                    ),
+                    outcome="expired",
+                )
+            else:
+                keep.append(req)
+        return keep
+
+    def _warn(self, msg: str) -> None:
+        print(f"warning: {msg}", file=sys.stderr)
+
+    def _retrieve(self, model, live: "list[_Request]"):
+        """Candidate retrieval for the coalesced batch, through the
+        breaker + ladder. Returns ``(live, dists, idx, rung)`` — ``live``
+        may have shrunk (mid-fallback deadline expiries, already failed
+        typed). Raises the last typed error when every rung fails."""
+        rungs = self._rungs(model)
+        decision = self.breaker.decide()
+        start = 0
+        if decision == "open":
+            # Short-circuit: the fast rung is known-broken; go straight to
+            # the rung that last answered instead of paying a doomed
+            # dispatch + ladder walk per batch.
+            start = min(max(1, self._degraded_rung), len(rungs) - 1)
+        last_err: Optional[Exception] = None
+        pos = start
+        feats = None  # rebuilt only when `live` shrinks, not per attempt
+        while pos < len(rungs):
+            if last_err is not None:
+                kept = self._expire_now(live)
+                if len(kept) != len(live):
+                    feats = None
+                live = kept
+                if not live:
+                    return live, None, None, None
+            name, fn = rungs[pos]
+            if feats is None:
+                feats = (
+                    live[0].features if len(live) == 1
+                    else np.concatenate([r.features for r in live])
+                )
+            try:
+                if pos == 0:
+                    if decision == "probe":
+                        with obs.span("breaker.probe",
+                                      breaker=self.breaker.name):
+                            faults.fault_point("serve.dispatch")
+                            out = self._call_rung(fn, feats)
+                    else:
+                        faults.fault_point("serve.dispatch")
+                        out = self._call_rung(fn, feats)
+                    self.breaker.record_success()
+                else:
+                    out = self._call_rung(fn, feats)
+                    self._degraded_rung = pos
+                self._last_rung = name
+                return live, out[0], out[1], name
+            except DeviceError as e:
+                if e.oom and self.max_batch > 1:
+                    prev, self.max_batch = self.max_batch, max(
+                        1, self.max_batch // 2)
+                    self._warn(
+                        f"serving dispatch OOM on rung '{name}'; halving "
+                        f"max_batch {prev} -> {self.max_batch}"
+                    )
+                    obs.counter_add(
+                        "knn_serve_fallback_total",
+                        help="serving-ladder moves (rung -> fallback rung; "
+                             "from==to is an in-place max_batch halving)",
+                        from_rung=name, to=name, reason="oom_halve_batch",
+                    )
+                    last_err = e
+                    continue  # same rung, smaller chunks
+                last_err = e
+            except (CompileError, CollectiveError, OSError) as e:
+                last_err = e
+            if pos == 0:
+                self.breaker.record_failure()
+            nxt = rungs[pos + 1][0] if pos + 1 < len(rungs) else None
+            if nxt is not None:
+                self._warn(
+                    f"serving rung '{name}' failed "
+                    f"({type(last_err).__name__}: {last_err}); "
+                    f"falling back to '{nxt}'"
+                )
+                obs.counter_add(
+                    "knn_serve_fallback_total",
+                    help="serving-ladder moves (rung -> fallback rung; "
+                         "from==to is an in-place max_batch halving)",
+                    from_rung=name, to=nxt,
+                    reason=type(last_err).__name__,
+                )
+            pos += 1
+        assert last_err is not None
+        raise last_err
+
+    # -- dispatch ----------------------------------------------------------
 
     def _dispatch(self, batch: "list[_Request]") -> None:
+        with self._cond:
+            # One snapshot per batch: swap_model can never split a batch
+            # across two indexes.
+            model = self._model
+            version = self._index_version
         now_ns = time.monotonic_ns()
         live: "list[_Request]" = []
         for req in batch:
@@ -308,29 +650,26 @@ class MicroBatcher:
         rows = sum(r.rows for r in live)
         t0 = time.monotonic()
         try:
-            with obs.span("serve.batch", requests=len(live), rows=rows):
-                features = (
-                    live[0].features if len(live) == 1
-                    else np.concatenate([r.features for r in live])
-                )
-                batch_ds = Dataset(features, np.zeros(rows, np.int32))
             with obs.span("serve.dispatch", requests=len(live), rows=rows):
-                dists, idx = self._model.kneighbors(batch_ds)
+                live, dists, idx, rung = self._retrieve(model, live)
+                if not live:
+                    return
                 off = 0
                 for req in live:
                     d = dists[off:off + req.rows]
                     i = idx[off:off + req.rows]
                     off += req.rows
+                    req.meta["index_version"] = version
+                    req.meta["rung"] = rung
                     if req.kind == "kneighbors":
                         req.succeed((d, i))
-                    elif isinstance(self._model, KNNClassifier):
-                        req.succeed(
-                            self._model.predict_from_candidates(d, i)
-                        )
+                    elif isinstance(model, KNNClassifier):
+                        req.succeed(model.predict_from_candidates(d, i))
                     else:
-                        req.succeed(self._model._predict_from((d, i)))
+                        req.succeed(model._predict_from((d, i)))
             instrument.record_serve_batch(
-                len(live), rows, (time.monotonic() - t0) * 1e3
+                len(live), sum(r.rows for r in live),
+                (time.monotonic() - t0) * 1e3,
             )
         except Exception as e:  # noqa: BLE001 — delivered per-future
             obs.counter_add(

@@ -10,26 +10,39 @@ Endpoint contract (docs/SERVING.md):
 
 - ``POST /predict``     body ``{"instances": [[...], ...]}`` (rows of
   ``num_features`` floats; optional ``"deadline_ms"`` overriding the
-  server default) → ``{"predictions": [...]}``.
+  server default) → ``{"predictions": [...], "index_version": ...}``.
 - ``POST /kneighbors``  same body → ``{"distances": [[...]], "indices":
-  [[...]]}`` (k per row, model order).
-- ``GET /healthz``      → 200 ``{"ready": true, ...}`` once warmup has
-  compiled the configured batch shapes; 503 before that (so a load
-  balancer never routes a request into a multi-second first-call
-  compile).
+  [[...]], ...}`` (k per row, model order).
+- ``GET /healthz``      → 200 ``{"ready": true, "draining": false,
+  "index_version": ..., "breaker": ..., ...}`` once warmup has compiled
+  the configured batch shapes; 503 before that (so a load balancer never
+  routes a request into a multi-second first-call compile) and again
+  while draining.
 - ``GET /metrics``      → the Prometheus text exposition straight from
   the global :mod:`knn_tpu.obs` registry (``knn_serve_*`` plus every
   model/backend metric the process has recorded).
+- ``POST /admin/reload`` body ``{}`` or ``{"index": PATH}`` → hot index
+  reload: load + validate the artifact off the serving path, warm it in
+  the background, atomically swap; ANY failure rolls back with the old
+  index still serving. 409 while another reload is in flight. ``SIGHUP``
+  triggers the same reload from the boot index path.
 
 Admission control maps the resilience taxonomy to status codes:
-:class:`OverloadError` (bounded queue full) → **429**,
+:class:`OverloadError` (bounded queue full) → **429** (**503** while
+draining — the load balancer's cue to route away, not retry here),
 :class:`DeadlineExceededError` (queue or result wait expired) → **504**,
 ``ValueError``/bad JSON → **400**, any other typed failure → **500** with
 the error class name in the body. Always a JSON body, never a traceback.
+
+Signals (the ops runbook, docs/SERVING.md): **SIGTERM** = graceful drain
+(healthz flips to 503 ``draining``, new admissions refused typed,
+in-flight answered within ``--drain-timeout-s``, remainders failed 504,
+exit 0); **SIGINT** = fast clean stop; **SIGHUP** = hot reload.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import threading
@@ -41,7 +54,11 @@ import numpy as np
 
 from knn_tpu import obs
 from knn_tpu.models.knn import KNNClassifier
-from knn_tpu.resilience.errors import DeadlineExceededError, OverloadError
+from knn_tpu.resilience.errors import (
+    DataError,
+    DeadlineExceededError,
+    OverloadError,
+)
 from knn_tpu.serve import artifact
 from knn_tpu.serve.batcher import MicroBatcher
 
@@ -49,24 +66,39 @@ from knn_tpu.serve.batcher import MicroBatcher
 MAX_BODY_BYTES = 64 * 1024 * 1024
 
 
+class ReloadInProgress(OverloadError):
+    """A hot reload is already running; the admin endpoint maps this to
+    HTTP 409 (one swap at a time keeps rollback reasoning trivial)."""
+
+
 class ServeApp:
     """Everything the handlers need, built once at boot."""
 
     def __init__(self, model, *, max_batch: int = 256,
                  max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 index_path: Optional[str] = None,
+                 index_version: Optional[str] = None):
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
         )
         self.deadline_ms = deadline_ms
+        self.index_path = index_path
+        self.index_version = index_version
         self.batcher = MicroBatcher(
             model, max_batch=max_batch, max_wait_ms=max_wait_ms,
-            max_queue_rows=max_queue_rows,
+            max_queue_rows=max_queue_rows, index_version=index_version,
         )
         self.ready = False
+        self.draining = False
         self.started_unix = time.time()
         self.warmup_ms: dict = {}
+        self.reloads = 0
+        self._warm_sizes = None
+        self._reload_lock = threading.Lock()
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
 
     def warm(self, batch_sizes=None) -> dict:
         """Compile the serving dispatch shapes, then report ready.
@@ -77,11 +109,150 @@ class ServeApp:
         executable for zero extra compilation."""
         if batch_sizes is None:
             batch_sizes = (1, self.batcher.max_batch)
+        self._warm_sizes = tuple(batch_sizes)
         self.warmup_ms = artifact.warmup(
             self.model, batch_sizes=batch_sizes, kinds=("predict",)
         )
         self.ready = True
         return self.warmup_ms
+
+    # -- hot reload --------------------------------------------------------
+
+    def reload(self, path: Optional[str] = None) -> dict:
+        """Hot-swap the serving index: load + validate ``path`` (default:
+        the boot index path), warm it OFF the serving path, then swap
+        atomically (one reference assignment in the batcher — every
+        response reflects exactly one index version). Any failure —
+        missing/corrupt/newer-format artifact, incompatible schema, a
+        warmup compile error — raises typed and leaves the old index
+        serving untouched (rollback is "never swapped")."""
+        if not self._reload_lock.acquire(blocking=False):
+            raise ReloadInProgress("a reload is already in progress")
+        try:
+            target = path or self.index_path
+            if target is None:
+                raise DataError(
+                    "no index path to reload from: the server was built "
+                    "without one and the request named none"
+                )
+            t0 = time.monotonic()
+            manifest = artifact.read_manifest(target)
+            version = artifact.index_version(manifest)
+            model = artifact.load_index(target)
+            new_family = ("classifier" if isinstance(model, KNNClassifier)
+                          else "regressor")
+            if new_family != self.family:
+                raise DataError(
+                    f"{target}: artifact family '{new_family}' does not "
+                    f"match the serving family '{self.family}' — that is a "
+                    f"new deployment, not a reload"
+                )
+            if (model.train_.num_features
+                    != self.model.train_.num_features):
+                raise DataError(
+                    f"{target}: feature width {model.train_.num_features} "
+                    f"does not match the serving width "
+                    f"{self.model.train_.num_features} — in-flight requests "
+                    f"were validated against the old schema; rejecting the "
+                    f"swap"
+                )
+            # Warm in the background sense: the OLD index keeps serving
+            # while these compiles run — they touch only the new model's
+            # device cache.
+            warmup_ms = artifact.warmup(
+                model, batch_sizes=self._warm_sizes or (1, self.batcher.max_batch),
+                kinds=("predict",),
+            )
+            previous = self.batcher.swap_model(model, version)
+            self.model = model
+            self.index_version = version
+            self.reloads += 1
+            obs.counter_add(
+                "knn_serve_reloads_total",
+                help="hot index reloads, by outcome", outcome="ok",
+            )
+            return {
+                "index_version": version,
+                "previous_version": previous,
+                "warmup_ms": warmup_ms,
+                "ms": round((time.monotonic() - t0) * 1e3, 3),
+            }
+        except Exception as e:
+            obs.counter_add(
+                "knn_serve_reloads_total",
+                help="hot index reloads, by outcome",
+                outcome="rolled_back", type=type(e).__name__,
+            )
+            raise
+        finally:
+            self._reload_lock.release()
+
+    # -- graceful drain ----------------------------------------------------
+
+    @contextlib.contextmanager
+    def track_request(self):
+        """In-flight accounting for the drain barrier: a request is
+        in-flight from body parse to response written."""
+        with self._inflight_cond:
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._inflight_cond:
+                self._inflight -= 1
+                self._inflight_cond.notify_all()
+
+    def drain(self, timeout_s: float) -> dict:
+        """The SIGTERM path: flip to draining (healthz 503, new admissions
+        refused with typed :class:`OverloadError`), then answer every
+        in-flight request within ``timeout_s``. Requests that cannot be
+        answered in time are failed :class:`DeadlineExceededError` (their
+        handlers respond 504) — every admitted request ends with exactly
+        one terminal outcome. Returns a summary; the process still exits
+        0 (a drained shutdown IS success)."""
+        t0 = time.monotonic()
+        self.draining = True
+        self.batcher.begin_drain()
+        deadline = t0 + timeout_s
+        with self._inflight_cond:
+            while self._inflight > 0 and time.monotonic() < deadline:
+                self._inflight_cond.wait(
+                    min(0.1, max(0.0, deadline - time.monotonic()))
+                )
+            remaining = self._inflight
+        expired = 0
+        if remaining > 0 or self.batcher.pending_rows() > 0:
+            expired = self.batcher.fail_pending(
+                DeadlineExceededError(
+                    f"server drained for {timeout_s:.1f} s and shut down "
+                    f"before this request could be dispatched"
+                ),
+                outcome="expired",
+            )
+            if expired:
+                obs.counter_add(
+                    "knn_serve_drain_expired_total", expired,
+                    help="requests failed 504 because the drain window "
+                         "closed",
+                )
+            # A short grace for the freshly-failed futures' handlers to
+            # write their 504s before the process exits.
+            grace = time.monotonic() + min(2.0, timeout_s)
+            with self._inflight_cond:
+                while self._inflight > 0 and time.monotonic() < grace:
+                    self._inflight_cond.wait(0.05)
+        # Re-read AFTER the expiry + grace: a request still in flight here
+        # (e.g. mid-dispatch on a slow rung, not in the queue for
+        # fail_pending to reach) will be cut off at process exit — the
+        # drain was NOT clean and the summary must say so.
+        with self._inflight_cond:
+            remaining = self._inflight
+        return {
+            "drained_clean": expired == 0 and remaining == 0,
+            "expired": expired,
+            "inflight_at_exit": remaining,
+            "ms": round((time.monotonic() - t0) * 1e3, 3),
+        }
 
     def close(self) -> None:
         self.ready = False
@@ -90,6 +261,12 @@ class ServeApp:
     def health(self) -> dict:
         return {
             "ready": self.ready,
+            "draining": self.draining,
+            "index_version": self.index_version,
+            "breaker": self.batcher.breaker.state,
+            "rung": self.batcher.current_rung,
+            "worker_restarts": self.batcher.restarts,
+            "reloads": self.reloads,
             "family": self.family,
             "k": self.model.k,
             "train_rows": self.model.train_.num_instances,
@@ -138,7 +315,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — stdlib dispatch name
         if self.path == "/healthz":
             h = self.app.health()
-            self._send(200 if h["ready"] else 503, h)
+            ok = h["ready"] and not h["draining"]
+            self._send(200 if ok else 503, h)
         elif self.path == "/metrics":
             self._send_text(
                 200, obs.registry().to_prometheus(),
@@ -149,7 +327,35 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- POST --------------------------------------------------------------
 
+    def _read_json_body(self, required: bool):
+        """Parse the JSON request body; returns ``(dict, None, None)`` or
+        ``(None, error_string, http_status)``. ``required=False`` treats
+        an absent body as ``{}`` (the admin endpoints take optional
+        bodies)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return None, "a JSON body with Content-Length is required", 400
+        if length <= 0:
+            if required:
+                return (None, "a JSON body with Content-Length is required",
+                        400)
+            return {}, None, None
+        if length > MAX_BODY_BYTES:
+            return None, (f"body {length} B exceeds the {MAX_BODY_BYTES} B "
+                          f"bound"), 413
+        try:
+            body = json.loads(self.rfile.read(length))
+        except ValueError as e:
+            return None, f"bad request body: {e}", 400
+        if not isinstance(body, dict):
+            return None, "the request body must be a JSON object", 400
+        return body, None, None
+
     def do_POST(self):  # noqa: N802 — stdlib dispatch name
+        if self.path == "/admin/reload":
+            self._do_reload()
+            return
         # Error replies sent before the body was drained must also close
         # the connection: with HTTP/1.1 keep-alive the unread bytes would
         # be parsed as the next request line.
@@ -157,23 +363,43 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
             self._send(404, {"error": f"no such endpoint: {self.path}"})
             return
-        kind = self.path[1:]
-        try:
-            length = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            length = -1
-        if length <= 0:
+        with self.app.track_request():
+            self._do_inference(self.path[1:])
+
+    def _do_reload(self):
+        body, err, status = self._read_json_body(required=False)
+        if err is not None:
             self.close_connection = True
-            self._send(400, {"error": "a JSON body with Content-Length is "
-                                      "required"})
-            return
-        if length > MAX_BODY_BYTES:
-            self.close_connection = True
-            self._send(413, {"error": f"body {length} B exceeds the "
-                                      f"{MAX_BODY_BYTES} B bound"})
+            self._send(status, {"error": err})
             return
         try:
-            body = json.loads(self.rfile.read(length))
+            result = self.app.reload(body.get("index"))
+        except ReloadInProgress as e:
+            self._send(409, {"error": str(e)})
+            return
+        except DataError as e:
+            # Bad/incompatible replacement artifact: rolled back, the old
+            # index is still serving — say so explicitly.
+            self._send(400, {
+                "error": str(e), "rolled_back": True,
+                "index_version": self.app.index_version,
+            })
+            return
+        except Exception as e:  # noqa: BLE001 — warmup/compile failures
+            self._send(500, {
+                "error": f"{type(e).__name__}: {e}", "rolled_back": True,
+                "index_version": self.app.index_version,
+            })
+            return
+        self._send(200, result)
+
+    def _do_inference(self, kind: str):
+        body, err, status = self._read_json_body(required=True)
+        if err is not None:
+            self.close_connection = True
+            self._send(status, {"error": err})
+            return
+        try:
             instances = body["instances"]
             deadline_ms = body.get("deadline_ms", self.app.deadline_ms)
             if deadline_ms is not None:
@@ -189,7 +415,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             handle = self.app.batcher.submit(x, kind, deadline_ms=deadline_ms)
         except OverloadError as e:
-            self._send(429, {"error": str(e)})
+            # While draining, 503 (not 429): the load balancer should take
+            # this replica out of rotation, not have the client retry here.
+            self._send(503 if self.app.draining else 429, {"error": str(e)})
             return
         except ValueError as e:  # shape/kind rejection
             self._send(400, {"error": str(e)})
@@ -207,14 +435,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
             return
         ms = round((time.monotonic() - t0) * 1e3, 3)
+        meta = handle.meta or {}
         if kind == "predict":
             self._send(200, {"predictions": np.asarray(value).tolist(),
+                             "index_version": meta.get("index_version"),
                              "ms": ms})
         else:
             dists, idx = value
             self._send(200, {
                 "distances": np.asarray(dists).tolist(),
                 "indices": np.asarray(idx).tolist(),
+                "index_version": meta.get("index_version"),
                 "ms": ms,
             })
 
@@ -244,19 +475,60 @@ def make_server(app: ServeApp, host: str = "127.0.0.1",
     return KNNServer((host, port), app)
 
 
-def serve_forever(server: KNNServer, *, banner=None) -> int:
-    """Run until SIGINT/SIGTERM, then shut down cleanly (stop accepting,
-    drain the batcher). Returns 0 — the `knn_tpu serve` main loop."""
-    import signal
+def serve_forever(server: KNNServer, *, banner=None,
+                  drain_timeout_s: float = 10.0) -> int:
+    """Run until a stop signal, then shut down cleanly. Returns 0 — the
+    `knn_tpu serve` main loop.
 
-    def on_signal(signum, frame):
+    - SIGINT: fast clean stop (stop accepting, drain the batcher queue).
+    - SIGTERM: graceful drain — readiness flips to 503 ``draining``, new
+      admissions are refused typed, in-flight requests are answered
+      within ``drain_timeout_s`` (remainders 504), then stop. Exit 0
+      either way: drained shutdown IS success.
+    - SIGHUP: hot index reload from the boot path (rollback on failure;
+      the loop keeps serving throughout).
+    """
+    import signal
+    import sys
+
+    def on_sigint(signum, frame):
         # shutdown() must come from another thread than serve_forever's.
         threading.Thread(target=server.shutdown, daemon=True).start()
 
+    def on_sigterm(signum, frame):
+        def drain_then_stop():
+            summary = server.app.drain(drain_timeout_s)
+            print(f"knn-tpu serve: drained "
+                  f"(clean={summary['drained_clean']}, "
+                  f"expired={summary['expired']}, "
+                  f"{summary['ms']:.0f} ms); shutting down",
+                  file=sys.stderr, flush=True)
+            server.shutdown()
+
+        threading.Thread(target=drain_then_stop, daemon=True).start()
+
+    def on_sighup(signum, frame):
+        def work():
+            try:
+                r = server.app.reload()
+                print(f"knn-tpu serve: reloaded index -> "
+                      f"{r['index_version']} "
+                      f"(was {r['previous_version']}, {r['ms']:.0f} ms)",
+                      file=sys.stderr, flush=True)
+            except Exception as e:  # noqa: BLE001 — rollback is implicit
+                print(f"warning: reload failed ({type(e).__name__}: {e}); "
+                      f"the previous index keeps serving",
+                      file=sys.stderr, flush=True)
+
+        threading.Thread(target=work, daemon=True).start()
+
     previous = {}
-    for sig in (signal.SIGINT, signal.SIGTERM):
+    handlers = {signal.SIGINT: on_sigint, signal.SIGTERM: on_sigterm}
+    if hasattr(signal, "SIGHUP"):
+        handlers[signal.SIGHUP] = on_sighup
+    for sig, handler in handlers.items():
         try:
-            previous[sig] = signal.signal(sig, on_signal)
+            previous[sig] = signal.signal(sig, handler)
         except ValueError:
             pass  # not the main thread (embedded use): caller manages stop
     if banner:

@@ -1,0 +1,395 @@
+"""Chaos-soak gate (`make chaos-soak`): the serving stack under sustained,
+seeded fault injection with concurrent closed-loop clients — the
+self-healing acceptance run (docs/SERVING.md §Ops runbook).
+
+What it does:
+
+1. build a fixture index (`knn_tpu save-index`, small-train.arff, k=3);
+2. boot `knn_tpu serve` as a subprocess with a seeded fault plan armed
+   (``KNN_TPU_FAULTS=serve.dispatch=<N>`` — the first N fast-rung
+   dispatches fail) and tight breaker knobs so the whole
+   closed→open→half-open→closed cycle fits the soak window;
+3. run C concurrent closed-loop clients POSTing /predict for the window,
+   while a poller samples /healthz (breaker state, draining flag);
+4. assert the invariants:
+   - every request gets exactly ONE terminal outcome — an HTTP status or
+     (only after SIGTERM) a refused connection; a client thread that
+     never returns is a hang and fails the gate;
+   - every 200 body is **bit-identical to the synchronous oracle**
+     (`knn_oracle` on the same rows) and carries ``index_version``;
+   - no response body ever contains a traceback;
+   - zero 500s: in-loop degradation must absorb the whole fault burst;
+   - the breaker OPENS under the burst and RE-CLOSES after it clears,
+     with a steady probe of sequential requests all answering 200
+     (availability back to 100%);
+   - a final SIGTERM under load drains cleanly: exit code 0 within
+     ``--drain-timeout-s`` + grace, in-flight requests answered;
+5. emit a BENCH-style availability / error-budget JSON on stdout.
+
+Exit 0 when every invariant holds; 1 with a diagnosis otherwise.
+stdlib-only (urllib) — the gate must not depend on host tools.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
+BOOT_TIMEOUT_S = 120  # first-call compile on a cold cache can be slow
+TRACEBACK_MARKER = "Traceback (most recent call last)"
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--short", action="store_true",
+                   help="CI preset: ~20 s wall (6 s soak window)")
+    p.add_argument("--window-s", type=float, default=None,
+                   help="soak window under concurrent clients")
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--faults", type=int, default=None,
+                   help="KNN_TPU_FAULTS=serve.dispatch=<N> burst size")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--drain-timeout-s", type=float, default=5.0)
+    p.add_argument("--json-out", default=None, metavar="FILE")
+    args = p.parse_args()
+    if args.window_s is None:
+        args.window_s = 6.0 if args.short else 20.0
+    if args.faults is None:
+        args.faults = 12 if args.short else 25
+    return args
+
+
+def fail(msg: str, proc=None) -> int:
+    print(f"chaos-soak: FAIL: {msg}", file=sys.stderr)
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+    return 1
+
+
+def http(base: str, path: str, payload=None, timeout=30):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"} if payload else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class Soak:
+    """Shared state between client/poller threads and the main assertions."""
+
+    def __init__(self, base, want, test_rows, sigterm_sent):
+        self.base = base
+        self.want = want  # oracle predictions for every test row
+        self.test_rows = test_rows
+        self.sigterm_sent = sigterm_sent  # threading.Event
+        self.stop = threading.Event()
+        self.lock = threading.Lock()
+        self.outcomes: dict = {}  # status/str -> count
+        self.violations: list = []
+        self.ok_bit_identical = 0
+        self.states_seen: set = set()
+        self.draining_seen = False
+
+    def record(self, outcome: str) -> None:
+        with self.lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+
+    def violate(self, msg: str) -> None:
+        with self.lock:
+            if len(self.violations) < 20:  # enough to diagnose
+                self.violations.append(msg)
+
+    def client_loop(self, cid: int) -> None:
+        q = len(self.test_rows)
+        i = cid  # stagger the row windows per client
+        while not self.stop.is_set():
+            lo = (3 * i) % (q - 2)
+            rows = self.test_rows[lo:lo + 2]
+            i += 1
+            try:
+                st, body = http(self.base, "/predict",
+                                {"instances": rows.tolist()})
+            except Exception as e:  # noqa: BLE001 — classified below
+                if self.sigterm_sent.is_set():
+                    self.record("refused_during_shutdown")
+                    return  # the listener is gone; the soak is over
+                self.violate(f"client {cid}: transport error before "
+                             f"SIGTERM: {type(e).__name__}: {e}")
+                self.record("transport_error")
+                continue
+            self.record(str(st))
+            if TRACEBACK_MARKER in body:
+                self.violate(f"client {cid}: TRACEBACK in a response body "
+                             f"(status {st}): {body[:200]}")
+                continue
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                self.violate(f"client {cid}: non-JSON body (status {st}): "
+                             f"{body[:120]}")
+                continue
+            if st == 200:
+                expect = self.want[lo:lo + 2].tolist()
+                if doc.get("predictions") != expect:
+                    self.violate(
+                        f"client {cid}: rows [{lo}:{lo + 2}] NOT "
+                        f"bit-identical to the oracle: got "
+                        f"{doc.get('predictions')}, want {expect}"
+                    )
+                elif "index_version" not in doc:
+                    self.violate(f"client {cid}: 200 without index_version")
+                else:
+                    with self.lock:
+                        self.ok_bit_identical += 1
+            elif st == 500:
+                self.violate(f"client {cid}: 500 — the degradation ladder "
+                             f"failed to absorb a fault: {body[:200]}")
+            elif st not in (429, 503, 504):
+                self.violate(f"client {cid}: unexpected status {st}: "
+                             f"{body[:200]}")
+
+    def poll_health(self) -> None:
+        while not self.stop.is_set():
+            try:
+                _, body = http(self.base, "/healthz", timeout=5)
+                doc = json.loads(body)
+                with self.lock:
+                    self.states_seen.add(doc.get("breaker"))
+                    if doc.get("draining"):
+                        self.draining_seen = True
+            except Exception:  # noqa: BLE001 — the server may be gone
+                if self.sigterm_sent.is_set():
+                    return
+            time.sleep(0.05)
+
+
+def main() -> int:
+    args = parse_args()
+    from tests import fixtures  # noqa: E402 — repo-root import
+
+    d = fixtures.datasets_dir()
+    train_arff = str(d / "small-train.arff")
+    test_arff = str(d / "small-test.arff")
+
+    # The synchronous oracle every 200 must be bit-identical to.
+    from knn_tpu.backends.oracle import knn_oracle
+    from knn_tpu.data.arff import load_arff
+
+    train, test = load_arff(train_arff), load_arff(test_arff)
+    want = knn_oracle(train.features, train.labels, test.features, 3,
+                      train.num_classes)
+
+    fault_plan = f"serve.dispatch={args.faults}:device"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        KNN_TPU_RETRY_BASE_MS="0",
+        KNN_TPU_FAULTS=fault_plan,
+        KNN_TPU_FAULT_SEED=str(args.seed),
+        # Tight breaker so the full open -> half-open -> closed cycle fits
+        # the soak window: opens after 3 fast-rung failures, probes every
+        # 400 ms, one good probe re-closes.
+        KNN_TPU_BREAKER_WINDOW="8",
+        KNN_TPU_BREAKER_THRESHOLD="3",
+        KNN_TPU_BREAKER_COOLDOWN_MS="400",
+        KNN_TPU_BREAKER_PROBES="1",
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        index = os.path.join(tmp, "index")
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index, "--k", "3"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: {build.stderr}")
+        print(f"chaos-soak: {build.stdout.strip()}")
+        print(f"chaos-soak: fault plan {fault_plan} (seed {args.seed}), "
+              f"{args.clients} clients, {args.window_s:.0f} s window")
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "knn_tpu.cli", "serve", index,
+             "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
+             "--drain-timeout-s", str(args.drain_timeout_s)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        import queue
+
+        lines: "queue.Queue[str]" = queue.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True,
+        ).start()
+        base = None
+        deadline = time.monotonic() + BOOT_TIMEOUT_S
+        while time.monotonic() < deadline:
+            try:
+                line = lines.get(timeout=min(1.0, max(
+                    0.01, deadline - time.monotonic())))
+            except queue.Empty:
+                if proc.poll() is not None:
+                    return fail(
+                        f"server exited rc={proc.poll()} before ready", proc)
+                continue
+            m = READY_RE.search(line)
+            if m:
+                print(f"chaos-soak: server: {line.rstrip()}")
+                base = m.group(1)
+                break
+        if base is None:
+            return fail("no ready banner within the boot timeout", proc)
+
+        sigterm_sent = threading.Event()
+        soak = Soak(base, want, test.features, sigterm_sent)
+        clients = [
+            threading.Thread(target=soak.client_loop, args=(cid,),
+                             daemon=True)
+            for cid in range(args.clients)
+        ]
+        poller = threading.Thread(target=soak.poll_health, daemon=True)
+        t_soak0 = time.monotonic()
+        poller.start()
+        for t in clients:
+            t.start()
+
+        # -- phase 1: the fault burst + recovery, under load ---------------
+        time.sleep(args.window_s)
+        with soak.lock:
+            opened = "open" in soak.states_seen
+        if not opened:
+            soak.stop.set()
+            return fail(
+                f"breaker never observed open during the {args.window_s:.0f}"
+                f" s window (states seen: {sorted(map(str, soak.states_seen))}"
+                f") — the fault burst did not trip it", proc)
+
+        # -- phase 2: the burst is bounded; wait for re-close --------------
+        reclose_deadline = time.monotonic() + 30
+        breaker_state = None
+        while time.monotonic() < reclose_deadline:
+            try:
+                _, body = http(base, "/healthz", timeout=5)
+                breaker_state = json.loads(body).get("breaker")
+                if breaker_state == "closed":
+                    break
+            except Exception:  # noqa: BLE001 — keep polling
+                pass
+            time.sleep(0.1)
+        if breaker_state != "closed":
+            soak.stop.set()
+            return fail(f"breaker did not re-close after the fault burst "
+                        f"(state: {breaker_state})", proc)
+        print("chaos-soak: breaker cycle observed: closed -> open -> closed")
+
+        # -- phase 3: steady probe — availability back to 100% -------------
+        steady_ok = 0
+        for i in range(15):
+            lo = (2 * i) % (len(test.features) - 2)
+            st, body = http(base, "/predict",
+                            {"instances": test.features[lo:lo + 2].tolist()})
+            doc = json.loads(body)
+            if st != 200:
+                soak.stop.set()
+                return fail(f"steady probe {i}: status {st} after recovery "
+                            f"({body[:200]})", proc)
+            if doc["predictions"] != want[lo:lo + 2].tolist():
+                soak.stop.set()
+                return fail(f"steady probe {i}: not bit-identical after "
+                            f"recovery", proc)
+            steady_ok += 1
+        print(f"chaos-soak: steady probe {steady_ok}/15 ok "
+              f"(availability 100%, bit-identical)")
+
+        # -- phase 4: SIGTERM under load — graceful drain ------------------
+        t_drain0 = time.monotonic()
+        sigterm_sent.set()
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=args.drain_timeout_s + 15)
+        except subprocess.TimeoutExpired:
+            soak.stop.set()
+            return fail("server did not exit after SIGTERM within the "
+                        "drain window + grace", proc)
+        drain_ms = (time.monotonic() - t_drain0) * 1e3
+        soak.stop.set()
+        for t in clients:
+            t.join(timeout=35)
+            if t.is_alive():
+                return fail("a client thread never finished its request — "
+                            "a request HUNG with no terminal outcome")
+        poller.join(timeout=5)
+        if rc != 0:
+            return fail(f"server exited rc={rc} after SIGTERM (graceful "
+                        f"drain must exit 0)")
+
+        # -- verdict -------------------------------------------------------
+        if soak.violations:
+            for v in soak.violations:
+                print(f"chaos-soak: VIOLATION: {v}", file=sys.stderr)
+            return fail(f"{len(soak.violations)} invariant violation(s)")
+
+        total = sum(soak.outcomes.values())
+        ok = soak.outcomes.get("200", 0)
+        report = {
+            "chaos_soak": {
+                "window_s": args.window_s,
+                "clients": args.clients,
+                "fault_plan": fault_plan,
+                "seed": args.seed,
+                "soak_wall_s": round(time.monotonic() - t_soak0, 2),
+            },
+            "requests_total": total,
+            "outcomes": dict(sorted(soak.outcomes.items())),
+            "availability": round(ok / total, 4) if total else None,
+            "bit_identical_ok": soak.ok_bit_identical,
+            "error_budget": {
+                "traceback_bodies": 0,
+                "untyped_500s": soak.outcomes.get("500", 0),
+                "hung_requests": 0,
+            },
+            "breaker": {
+                "opened": True,
+                "reclosed": True,
+                "states_seen": sorted(
+                    s for s in soak.states_seen if s is not None),
+            },
+            "steady_probe": {"ok": steady_ok, "of": 15},
+            "drain": {
+                "exit_code": rc,
+                "wall_ms": round(drain_ms, 1),
+                "draining_observed": soak.draining_seen,
+            },
+        }
+        doc = json.dumps(report, indent=2)
+        print(doc)
+        if args.json_out:
+            Path(args.json_out).write_text(doc + "\n")
+        print("chaos-soak: PASS")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

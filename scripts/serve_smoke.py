@@ -6,10 +6,13 @@ banner, a real ephemeral-port bind, clean exit code).
 Steps:
 1. build a fixture index with `knn_tpu save-index` (small-train.arff);
 2. boot `knn_tpu serve --port 0` and wait for the ready banner;
-3. probe /healthz (ready), /predict (predictions match an in-process
-   model on the same rows), /kneighbors (shapes), /metrics
+3. probe /healthz (ready, NOT draining, carries index_version — the
+   self-healing fields, docs/SERVING.md), /predict (predictions match an
+   in-process model on the same rows), /kneighbors (shapes), /metrics
    (knn_serve_* counters present);
-4. SIGINT and require a clean exit within the grace period.
+4. rebuild the index and SIGHUP: the hot reload must swap index_version
+   while the process keeps serving bit-identical predictions;
+5. SIGINT and require a clean exit within the grace period.
 
 Exit 0 on success; any failure prints a diagnosis and exits 1.
 stdlib-only (urllib, not curl: the gate must not depend on host tools).
@@ -118,8 +121,15 @@ def main() -> int:
             health = json.loads(body)
             if st != 200 or not health.get("ready"):
                 return fail(f"/healthz not ready: {st} {body}", proc)
+            if health.get("draining") is not False:
+                return fail(f"/healthz draining field wrong at boot: "
+                            f"{body}", proc)
+            boot_version = health.get("index_version")
+            if not boot_version:
+                return fail(f"/healthz missing index_version: {body}", proc)
             print(f"serve-smoke: /healthz ok (train_rows="
-                  f"{health['train_rows']})")
+                  f"{health['train_rows']}, index_version={boot_version}, "
+                  f"draining=false)")
 
             from knn_tpu.data.arff import load_arff
             from knn_tpu.models.knn import KNNClassifier
@@ -150,6 +160,42 @@ def main() -> int:
             if st != 200 or missing:
                 return fail(f"/metrics {st}: missing {missing}", proc)
             print("serve-smoke: /metrics ok (knn_serve_* present)")
+
+            # Hot reload: rebuild the index (new created_unix -> new
+            # version), SIGHUP, and require the swap while serving stays
+            # bit-identical.
+            rebuild = subprocess.run(
+                [sys.executable, "-m", "knn_tpu.cli", "save-index",
+                 train_arff, index, "--k", "3"],
+                env=env, capture_output=True, text=True, cwd=REPO,
+            )
+            if rebuild.returncode != 0:
+                return fail(f"index rebuild rc={rebuild.returncode}: "
+                            f"{rebuild.stderr}", proc)
+            proc.send_signal(signal.SIGHUP)
+            new_version = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st, body = request(base, "/healthz")
+                v = json.loads(body).get("index_version")
+                if st == 200 and v and v != boot_version:
+                    new_version = v
+                    break
+                time.sleep(0.1)
+            if new_version is None:
+                return fail("SIGHUP reload never swapped index_version",
+                            proc)
+            st, body = request(base, "/predict",
+                               {"instances": rows.tolist()})
+            got = json.loads(body)
+            if st != 200 or got.get("predictions") != want:
+                return fail(f"/predict after reload {st}: got "
+                            f"{got.get('predictions')}, want {want}", proc)
+            if got.get("index_version") != new_version:
+                return fail(f"response index_version {got.get('index_version')} "
+                            f"!= reloaded {new_version}", proc)
+            print(f"serve-smoke: SIGHUP reload ok "
+                  f"({boot_version} -> {new_version}, still bit-identical)")
         except Exception as e:  # noqa: BLE001 — smoke harness boundary
             return fail(f"{type(e).__name__}: {e}", proc)
 

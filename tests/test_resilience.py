@@ -214,6 +214,70 @@ class TestRetry:
             obs.reset()
 
 
+class TestRetryJitter:
+    """KNN_TPU_RETRY_JITTER (default OFF): seeded backoff jitter that
+    de-synchronizes concurrent handler threads without breaking chaos
+    replay — bounds and replay determinism pinned here."""
+
+    def _capture_sleeps(self, monkeypatch):
+        seen = []
+        monkeypatch.setattr(retry.time, "sleep", lambda s: seen.append(s))
+        return seen
+
+    def _failing(self):
+        raise DeviceError("transient blip", transient=True)
+
+    def test_default_off_sleeps_schedule_verbatim(self, monkeypatch):
+        monkeypatch.delenv("KNN_TPU_RETRY_JITTER", raising=False)
+        seen = self._capture_sleeps(monkeypatch)
+        with pytest.raises(DeviceError):
+            retry.guarded_call("device.put", self._failing, attempts=3,
+                               base_ms=8.0, max_ms=1000.0)
+        assert seen == [0.008, 0.016]  # the deterministic schedule, exactly
+
+    def test_jitter_bounded_below_half_above_schedule(self, monkeypatch):
+        monkeypatch.setenv("KNN_TPU_RETRY_JITTER", "1")
+        retry.reset_jitter(7)
+        seen = self._capture_sleeps(monkeypatch)
+        with pytest.raises(DeviceError):
+            retry.guarded_call("device.put", self._failing, attempts=6,
+                               base_ms=8.0, max_ms=1000.0)
+        schedule = [s / 1e3 for s in retry.backoff_schedule(6, 8.0, 1000.0)]
+        assert len(seen) == len(schedule)
+        for got, base in zip(seen, schedule):
+            assert base / 2 <= got <= base, (got, base)
+        assert seen != schedule  # jitter actually moved something
+
+    def test_jitter_replay_deterministic_from_seed(self, monkeypatch):
+        monkeypatch.setenv("KNN_TPU_RETRY_JITTER", "1")
+        runs = []
+        for _ in range(2):
+            retry.reset_jitter(123)
+            seen = []
+            monkeypatch.setattr(retry.time, "sleep",
+                                lambda s: seen.append(s))
+            with pytest.raises(DeviceError):
+                retry.guarded_call("device.put", self._failing, attempts=4,
+                                   base_ms=16.0, max_ms=1000.0)
+            runs.append(seen)
+        assert runs[0] == runs[1]  # same seed -> identical sleep sequence
+
+    def test_apply_jitter_bounds_over_many_draws(self):
+        retry.reset_jitter(99)
+        draws = [retry.apply_jitter(100.0) for _ in range(500)]
+        assert all(50.0 <= d <= 100.0 for d in draws)
+        assert max(draws) - min(draws) > 10.0  # it actually spreads
+
+    def test_seed_env_feeds_jitter(self, monkeypatch):
+        monkeypatch.setenv("KNN_TPU_RETRY_JITTER", "1")
+        monkeypatch.setenv(faults.SEED_ENV, "31337")
+        retry.reset_jitter()  # re-reads KNN_TPU_FAULT_SEED
+        a = [retry.apply_jitter(100.0) for _ in range(3)]
+        retry.reset_jitter()
+        b = [retry.apply_jitter(100.0) for _ in range(3)]
+        assert a == b
+
+
 def _ladder_predict(backend, train, test, k=3, opts=None, **kw):
     return degrade.predict_with_ladder(backend, train, test, k, opts, **kw)
 

@@ -237,14 +237,22 @@ class TestBatcherPolicy:
         with pytest.raises(OverloadError, match="shut down"):
             b.submit(test.features[0])
 
-    def test_dispatch_failure_delivers_typed_error(self, rng, monkeypatch):
+    def test_every_rung_failing_delivers_typed_error(self, rng, monkeypatch):
+        """A fast-rung failure DEGRADES now (TestServingLadder pins that);
+        the typed error reaches the futures only when the whole serving
+        ladder is exhausted."""
+        import knn_tpu.backends.oracle as oracle_mod
+        import knn_tpu.serve.batcher as batcher_mod
+
         train, test = _problem(rng)
         model = KNNClassifier(k=3, engine="xla").fit(train)
 
-        def boom(ds):
+        def boom(*args, **kwargs):
             raise DeviceError("synthetic dispatch failure")
 
         monkeypatch.setattr(model, "kneighbors", boom)
+        monkeypatch.setattr(batcher_mod, "_kneighbors_arrays", boom)
+        monkeypatch.setattr(oracle_mod, "oracle_kneighbors", boom)
         with MicroBatcher(model, max_batch=8, max_wait_ms=1.0) as b:
             h1 = b.submit(test.features[0])
             h2 = b.submit(test.features[1], "kneighbors")

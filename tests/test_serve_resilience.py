@@ -1,0 +1,602 @@
+"""Self-healing serving contracts (docs/SERVING.md §Ops runbook):
+
+- the circuit breaker state machine (closed/open/half-open over a sliding
+  failure window) and its env knobs;
+- in-loop degradation: a fast-rung device failure mid-serve degrades the
+  BATCH (bit-identical answers from a lower rung) instead of failing it;
+  OOM halves ``max_batch`` in place; the breaker short-circuits to the
+  degraded rung while open and re-promotes after recovery probes;
+- the supervisor restarting a dead worker thread (counted);
+- deadline propagation through the ladder: a request expiring
+  mid-fallback gets ``DeadlineExceededError``, not a slow success;
+- shutdown under load: every admitted request ends with a typed terminal
+  outcome, never a hung waiter;
+- hot index reload: atomic swap (responses carry exactly the old or the
+  new ``index_version``), rollback on a corrupt replacement;
+- graceful drain: readiness flips, admissions refused typed, queued work
+  answered (or failed typed when the window expires).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.resilience import faults
+from knn_tpu.resilience.breaker import CircuitBreaker
+from knn_tpu.resilience.errors import (
+    DeadlineExceededError, DeviceError, OverloadError,
+)
+from knn_tpu.serve import artifact
+from knn_tpu.serve.batcher import MicroBatcher
+from knn_tpu.serve.server import ServeApp, make_server
+
+
+def _problem(rng, n=300, q=40, d=5, c=5):
+    train_x = rng.integers(0, 4, (n, d)).astype(np.float32)  # grid -> ties
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.integers(0, 4, (q - q // 2, d)).astype(np.float32)]
+    )
+    train = Dataset(train_x, train_y)
+    test = Dataset(test_x, np.zeros(len(test_x), np.int32))
+    return train, test
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker state machine
+
+
+class TestCircuitBreaker:
+    def test_trips_open_at_threshold(self):
+        b = CircuitBreaker("t", window=4, threshold=2, cooldown_ms=10_000,
+                           probe_successes=1)
+        assert b.decide() == "closed"
+        b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open"
+        assert b.decide() == "open"  # within cooldown: short-circuit
+        assert b.short_circuits == 1
+
+    def test_window_slides_failures_out(self):
+        b = CircuitBreaker("t", window=3, threshold=2, cooldown_ms=10_000)
+        b.record_failure()
+        b.record_success()
+        b.record_success()
+        b.record_success()  # the failure aged out of the 3-wide window
+        b.record_failure()
+        assert b.state == "closed"  # 1 failure in window, threshold 2
+
+    def test_half_open_probe_recloses_after_successes(self):
+        b = CircuitBreaker("t", window=4, threshold=1, cooldown_ms=1,
+                           probe_successes=2)
+        b.record_failure()
+        assert b.state == "open"
+        time.sleep(0.005)
+        assert b.decide() == "probe"
+        b.record_success()
+        assert b.state == "half-open"  # 1 of 2 probes
+        assert b.decide() == "probe"
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_failed_probe_reopens(self):
+        b = CircuitBreaker("t", window=4, threshold=1, cooldown_ms=1,
+                           probe_successes=1)
+        b.record_failure()
+        time.sleep(0.005)
+        assert b.decide() == "probe"
+        b.record_failure()
+        assert b.state == "open"
+        assert b.decide() == "open"  # cooldown restarted
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("KNN_TPU_BREAKER_WINDOW", "7")
+        monkeypatch.setenv("KNN_TPU_BREAKER_THRESHOLD", "3")
+        monkeypatch.setenv("KNN_TPU_BREAKER_COOLDOWN_MS", "123")
+        monkeypatch.setenv("KNN_TPU_BREAKER_PROBES", "4")
+        b = CircuitBreaker("env")
+        assert (b.window, b.threshold, b.cooldown_ms, b.probe_successes) == \
+            (7, 3, 123.0, 4)
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError, match="threshold"):
+            CircuitBreaker("t", window=2, threshold=5)
+
+    def test_transition_metrics(self, obs_on):
+        b = CircuitBreaker("m", window=2, threshold=1, cooldown_ms=10_000)
+        b.record_failure()
+        assert obs_on.counter(
+            "knn_breaker_transitions_total", breaker="m", from_state="closed",
+            to_state="open",
+        ).value == 1
+        assert obs_on.gauge("knn_breaker_state", breaker="m").value == 1
+        b.decide()
+        assert obs_on.counter(
+            "knn_breaker_short_circuits_total", breaker="m").value == 1
+
+
+# ---------------------------------------------------------------------------
+# In-loop degradation
+
+
+class TestServingLadder:
+    def test_fast_failure_degrades_bit_identical(self, rng, obs_on,
+                                                 monkeypatch):
+        """A persistent device failure mid-serve must NOT fail the batch:
+        the ladder answers from a lower rung with bit-identical
+        predictions, counted as a fallback."""
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+
+        def boom(ds):
+            raise DeviceError("dead device")
+
+        with MicroBatcher(model, max_batch=64, max_wait_ms=1.0) as b:
+            monkeypatch.setattr(model, "kneighbors", boom)
+            h = b.submit(test.features)
+            got = h.result(timeout=60)
+        np.testing.assert_array_equal(got, want)
+        assert h.meta["rung"] == "oracle"  # engine=xla ladder: fast→oracle
+        assert obs_on.counter(
+            "knn_serve_fallback_total", from_rung="fast", to="oracle",
+            reason="DeviceError",
+        ).value >= 1
+
+    def test_kneighbors_degrades_with_identical_indices(self, rng,
+                                                        monkeypatch):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        _, want_i = model.kneighbors(test)
+
+        def boom(ds):
+            raise DeviceError("dead device")
+
+        with MicroBatcher(model, max_batch=64, max_wait_ms=1.0) as b:
+            monkeypatch.setattr(model, "kneighbors", boom)
+            _, got_i = b.kneighbors(test.features, timeout=60)
+        np.testing.assert_array_equal(got_i, want_i)
+
+    def test_injected_oom_halves_max_batch_in_place(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=1.0) as b:
+            with faults.inject("serve.dispatch=once:oom"):
+                got = b.predict(test.features[:4], timeout=60)
+            assert b.max_batch == 4  # halved in place, not failed
+            np.testing.assert_array_equal(got, want[:4])
+        assert obs_on.counter(
+            "knn_serve_fallback_total", from_rung="fast", to="fast",
+            reason="oom_halve_batch",
+        ).value == 1
+
+    def test_breaker_opens_short_circuits_and_recloses(self, rng, obs_on,
+                                                       monkeypatch):
+        """The full self-healing cycle: sustained fast-rung faults trip
+        the breaker open (requests keep succeeding, served degraded and
+        short-circuited past the doomed dispatch); once the faults clear
+        and the cooldown elapses, a half-open probe re-promotes the fast
+        rung."""
+        monkeypatch.setenv("KNN_TPU_BREAKER_WINDOW", "4")
+        monkeypatch.setenv("KNN_TPU_BREAKER_THRESHOLD", "2")
+        monkeypatch.setenv("KNN_TPU_BREAKER_COOLDOWN_MS", "250")
+        monkeypatch.setenv("KNN_TPU_BREAKER_PROBES", "1")
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+        model.kneighbors(test)  # warm outside the fault window
+        b = MicroBatcher(model, max_batch=4, max_wait_ms=0.5)
+        try:
+            with faults.inject("serve.dispatch=always"):
+                for i in range(6):
+                    np.testing.assert_array_equal(
+                        b.predict(test.features[i], timeout=60), want[i]
+                    )
+                assert b.breaker.state == "open"
+                assert b.breaker.short_circuits >= 1  # degraded, not doomed
+            # Faults cleared: after the cooldown the next dispatch is a
+            # half-open probe that succeeds and re-closes the breaker.
+            time.sleep(0.3)
+            h = b.submit(test.features[0])
+            np.testing.assert_array_equal(h.result(timeout=60), want[0])
+            assert b.breaker.state == "closed"
+            assert h.meta["rung"] == "fast"  # re-promoted
+        finally:
+            b.close()
+        assert obs_on.counter(
+            "knn_breaker_transitions_total", breaker="serve.dispatch",
+            from_state="half-open", to_state="closed",
+        ).value >= 1
+
+    def test_deadline_expires_mid_fallback(self, rng, obs_on, monkeypatch):
+        """A request whose deadline passes while a higher rung is failing
+        gets its 504 — never a slow success from a lower rung. A
+        deadline-free request in the same batch still gets the degraded
+        (bit-identical) answer."""
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+
+        def slow_boom(ds):
+            time.sleep(0.4)
+            raise DeviceError("slowly dying device")
+
+        b = MicroBatcher(model, max_batch=64, max_wait_ms=50.0)
+        try:
+            monkeypatch.setattr(model, "kneighbors", slow_boom)
+            ha = b.submit(test.features[0], deadline_ms=200)
+            hb = b.submit(test.features[1])
+            with pytest.raises(DeadlineExceededError, match="degradation"):
+                ha.result(timeout=60)
+            np.testing.assert_array_equal(hb.result(timeout=60), want[1])
+            assert hb.meta["rung"] == "oracle"
+        finally:
+            monkeypatch.undo()
+            b.close()
+        assert obs_on.counter("knn_serve_deadline_expired_total").value == 1
+
+    def test_supervisor_restarts_dead_worker(self, rng, obs_on):
+        """A worker whose own machinery dies is restarted (counted), and
+        queued requests are served by the replacement instead of hanging
+        until their timeouts."""
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+        b = MicroBatcher(model, max_batch=8, max_wait_ms=1.0)
+        try:
+            orig = b._collect
+            died = {"n": 0}
+
+            def dying_collect():
+                if died["n"] == 0:
+                    died["n"] = 1
+                    raise RuntimeError("synthetic worker death")
+                return orig()
+
+            b._collect = dying_collect
+            # The original worker is blocked inside the old _collect; this
+            # request is served by it, then the NEXT loop iteration hits
+            # the dying replacement and kills the worker.
+            np.testing.assert_array_equal(
+                b.predict(test.features[0], timeout=60), want[0]
+            )
+            deadline = time.monotonic() + 10
+            while b.restarts == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert b.restarts == 1, "supervisor never restarted the worker"
+            np.testing.assert_array_equal(
+                b.predict(test.features[1], timeout=60), want[1]
+            )
+        finally:
+            b.close()
+        assert obs_on.counter("knn_serve_worker_restarts_total").value == 1
+
+
+# ---------------------------------------------------------------------------
+# Shutdown under load
+
+
+class TestShutdownUnderLoad:
+    def test_close_under_load_leaves_typed_outcomes(self, rng, monkeypatch):
+        """close() racing an in-flight dispatch: every admitted request
+        must end with a value or a TYPED error — a waiter that hits its
+        own wait-timeout ("not served within") means a silently dropped
+        request, the regression this pins."""
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.kneighbors(test)  # warm so the slow path is the sleep
+        real = model.kneighbors
+
+        def slow(ds):
+            time.sleep(0.1)
+            return real(ds)
+
+        monkeypatch.setattr(model, "kneighbors", slow)
+        b = MicroBatcher(model, max_batch=1, max_wait_ms=0.0)
+        handles = [b.submit(test.features[i]) for i in range(8)]
+        time.sleep(0.05)  # let the worker start dispatching the head
+        b.close(timeout=0.25)  # expires with most of the queue undrained
+        served, failed = 0, 0
+        for h in handles:
+            try:
+                assert h.result(timeout=5) is not None
+                served += 1
+            except OverloadError:
+                failed += 1  # typed shutdown outcome — the contract
+            except DeadlineExceededError as e:
+                assert "not served within" not in str(e), (
+                    "a waiter hung: request dropped without a terminal "
+                    "outcome"
+                )
+                failed += 1
+        assert served + failed == 8
+        assert failed > 0, "close(timeout) drained everything; the race " \
+                           "this test exists for never happened"
+
+
+# ---------------------------------------------------------------------------
+# Hot reload + drain (HTTP level)
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def two_indexes(rng, tmp_path):
+    train, test = _problem(rng)
+    idx_a = artifact.save_index(
+        KNNClassifier(k=1, engine="xla").fit(train), tmp_path / "a")
+    idx_b = artifact.save_index(
+        KNNClassifier(k=5, engine="xla").fit(train), tmp_path / "b")
+    return train, test, idx_a, idx_b
+
+
+@pytest.fixture
+def reload_server(two_indexes, obs_on):
+    train, test, idx_a, idx_b = two_indexes
+    model = artifact.load_index(idx_a)
+    version = artifact.index_version(artifact.read_manifest(idx_a))
+    app = ServeApp(model, max_batch=16, max_wait_ms=1.0,
+                   index_path=str(idx_a), index_version=version)
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    app.warm((1, 4))
+    try:
+        yield f"http://{host}:{port}", app, test, idx_a, idx_b, version
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close()
+        thread.join(timeout=10)
+
+
+class TestHotReload:
+    def test_reload_swaps_version_atomically(self, reload_server):
+        base, app, test, idx_a, idx_b, va = reload_server
+        want_b = artifact.load_index(idx_b).predict(test).tolist()
+        st, h = _get(base, "/healthz")
+        assert st == 200 and json.loads(h)["index_version"] == va
+        st, body = _post(base, "/admin/reload", {"index": str(idx_b)})
+        assert st == 200, body
+        vb = body["index_version"]
+        assert vb != va and body["previous_version"] == va
+        assert body["warmup_ms"]  # the new index warmed before the swap
+        st, h = _get(base, "/healthz")
+        assert json.loads(h)["index_version"] == vb
+        st, body = _post(base, "/predict",
+                         {"instances": test.features.tolist()})
+        assert st == 200
+        assert body["index_version"] == vb
+        assert body["predictions"] == want_b
+
+    def test_corrupt_replacement_rolls_back(self, reload_server):
+        base, app, test, idx_a, idx_b, va = reload_server
+        want_a = artifact.load_index(idx_a).predict(test).tolist()
+        (idx_b / "arrays.npz").write_bytes(b"not a zip archive")
+        st, body = _post(base, "/admin/reload", {"index": str(idx_b)})
+        assert st == 400, body
+        assert body["rolled_back"] is True
+        assert body["index_version"] == va  # the old index still serving
+        st, body = _post(base, "/predict",
+                         {"instances": test.features.tolist()})
+        assert st == 200
+        assert body["index_version"] == va
+        assert body["predictions"] == want_a
+
+    def test_family_change_rejected(self, reload_server, rng, tmp_path):
+        from knn_tpu.models.knn import KNNRegressor
+
+        base, app, test, idx_a, idx_b, va = reload_server
+        train, _ = _problem(rng)
+        reg_train = Dataset(
+            train.features, train.labels,
+            raw_targets=rng.standard_normal(
+                train.num_instances).astype(np.float32),
+        )
+        reg_idx = artifact.save_index(
+            KNNRegressor(k=3).fit(reg_train), tmp_path / "reg")
+        st, body = _post(base, "/admin/reload", {"index": str(reg_idx)})
+        assert st == 400 and "family" in body["error"]
+        assert app.index_version == va
+
+    def test_reload_under_load_never_serves_a_mix(self, reload_server):
+        """Concurrent predicts during a reload: every response must carry
+        exactly the old or the new index_version, with predictions
+        matching THAT version's model — never a mix."""
+        base, app, test, idx_a, idx_b, va = reload_server
+        want_a = artifact.load_index(idx_a).predict(test).tolist()
+        want_b = artifact.load_index(idx_b).predict(test).tolist()
+        assert want_a != want_b, "k=1 vs k=5 must disagree somewhere or " \
+                                 "this test proves nothing"
+        rows = test.features.tolist()
+        results, errors = [], []
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    st, body = _post(base, "/predict", {"instances": rows})
+                    if st != 200:
+                        errors.append((st, body))
+                    else:
+                        results.append(
+                            (body["index_version"], body["predictions"]))
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(("exc", repr(e)))
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        st, body = _post(base, "/admin/reload", {"index": str(idx_b)})
+        vb = body["index_version"]
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert st == 200, body
+        assert not errors, errors[:3]
+        seen = set()
+        for version, preds in results:
+            assert version in (va, vb), f"unknown index_version {version}"
+            want = want_a if version == va else want_b
+            assert preds == want, (
+                f"response tagged {version} did not match that version's "
+                f"model — a mixed index was served"
+            )
+            seen.add(version)
+        assert va in seen, "no response from the old index — load never " \
+                           "overlapped the reload"
+
+    def test_concurrent_reload_conflicts_409(self, reload_server,
+                                             monkeypatch):
+        base, app, test, idx_a, idx_b, va = reload_server
+        release = threading.Event()
+        real_warm = artifact.warmup
+
+        def slow_warm(*a, **kw):
+            release.wait(10)
+            return real_warm(*a, **kw)
+
+        monkeypatch.setattr(artifact, "warmup", slow_warm)
+        first = {}
+
+        def kick():
+            first["resp"] = _post(base, "/admin/reload",
+                                  {"index": str(idx_b)})
+
+        t = threading.Thread(target=kick)
+        t.start()
+        # Wait until the in-flight reload actually holds the reload lock
+        # (blocked inside the slowed warmup) before probing.
+        deadline = time.monotonic() + 10
+        while (not app._reload_lock.locked()
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert app._reload_lock.locked()
+        st, body = _post(base, "/admin/reload", {"index": str(idx_b)})
+        release.set()
+        t.join(timeout=30)
+        assert st == 409, body
+        assert first["resp"][0] == 200  # the in-flight reload completed
+
+
+class TestDrain:
+    def test_drain_flips_health_refuses_and_answers(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        # A long coalescing window parks the request so drain overlaps it.
+        app = ServeApp(model, max_batch=64, max_wait_ms=2000.0)
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+        try:
+            app.warm((1,))
+            parked = {}
+
+            def park():
+                parked["resp"] = _post(base, "/predict", {
+                    "instances": [test.features[0].tolist()]})
+
+            t = threading.Thread(target=park)
+            t.start()
+            deadline = time.monotonic() + 10
+            while (app.batcher.pending_rows() == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert app.batcher.pending_rows() > 0
+            summary = {}
+            dt = threading.Thread(
+                target=lambda: summary.update(app.drain(10.0)))
+            dt.start()
+            deadline = time.monotonic() + 10
+            while not app.draining and time.monotonic() < deadline:
+                time.sleep(0.01)
+            st, h = _get(base, "/healthz")
+            assert st == 503 and json.loads(h)["draining"] is True
+            st, body = _post(base, "/predict", {
+                "instances": [test.features[1].tolist()]})
+            assert st == 503 and "draining" in body["error"]
+            dt.join(timeout=30)
+            t.join(timeout=30)
+            # The parked request was ANSWERED during the drain (the drain
+            # cuts the coalescing window short), not dropped.
+            assert parked["resp"][0] == 200
+            assert summary["drained_clean"] is True
+            assert summary["expired"] == 0
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+
+    def test_expired_drain_fails_remainders_typed(self, rng, obs_on,
+                                                  monkeypatch):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.kneighbors(test)  # warm
+        real = model.kneighbors
+
+        def slow(ds):
+            time.sleep(0.5)
+            return real(ds)
+
+        monkeypatch.setattr(model, "kneighbors", slow)
+        app = ServeApp(model, max_batch=1, max_wait_ms=0.0)
+        handles = [app.batcher.submit(test.features[i]) for i in range(6)]
+        summary = app.drain(timeout_s=0.2)
+        assert summary["expired"] > 0
+        for h in handles:
+            try:
+                assert h.result(timeout=5) is not None
+            except DeadlineExceededError as e:
+                # The typed expired-drain outcome, NOT a hung waiter
+                # timing out on its own wait.
+                assert "not served within" not in str(e), "a waiter hung"
+                assert "drained" in str(e)
+        # fail_pending clearing the queue under the worker must NOT read
+        # as a worker death: no bogus restart counted on a routine drain.
+        time.sleep(0.7)  # let the in-flight slow dispatch finish its loop
+        assert app.batcher.restarts == 0
+        app.close()
