@@ -56,11 +56,14 @@ test:
 
 # The tier-1 gate (ROADMAP.md): the not-slow suite on CPU with the 8-device
 # virtual mesh, plus a bytecode-compile of the package so syntax errors in
-# rarely-imported modules can't hide. CI runs exactly this target.
+# rarely-imported modules can't hide, plus the disabled-path overhead gate
+# (observability/tracing must record NOTHING and cost ~nothing while off —
+# docs/OBSERVABILITY.md §Overhead). CI runs exactly this target.
 verify:
 	python3 -m compileall -q knn_tpu bench.py
 	JAX_PLATFORMS=cpu python3 -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+	JAX_PLATFORMS=cpu python3 scripts/check_disabled_overhead.py
 
 # The chaos gate (docs/RESILIENCE.md): the deterministic fault-injection
 # suite — every (fault point, mode) pair must end in recovery with
@@ -83,11 +86,15 @@ serve-smoke:
 # The self-healing gate (docs/SERVING.md §Ops runbook): boot the server
 # under a seeded fault burst, hammer it with concurrent closed-loop
 # clients, and assert the soak invariants — every request one terminal
-# outcome, 200s bit-identical to the oracle, no traceback bodies, the
-# breaker opens then re-closes with availability back to 100%, and a
-# final SIGTERM under load drains cleanly (exit 0). Short mode ~20 s.
+# outcome with a request_id that resolves to a consistent flight-recorder
+# timeline, 200s bit-identical to the oracle, no traceback bodies, the
+# breaker opens then re-closes with availability back to 100%, the SLO
+# burn rate rises under the burst and recovers to ~0, and a final SIGTERM
+# under load drains cleanly (exit 0). Short mode ~20 s. The per-request
+# Perfetto trace lands in build/ (CI uploads it as a workflow artifact).
 chaos-soak:
-	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/chaos_soak.py --short
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/chaos_soak.py \
+		--short --perfetto-out build/chaos-soak-trace.json
 
 bench:
 	python3 bench.py

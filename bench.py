@@ -1060,6 +1060,30 @@ def bench_serving():
             and c8["batched_p50_ms"] < c8["seq_p50_ms"]
         ),
     )
+
+    # Request-tracing cost, measured: the same c=8 batched run with a
+    # flight recorder attached — every request then owns a full timeline
+    # (phases, attempts, recorder commit). The delta vs c8_batched_p50_ms
+    # is the per-request price of `--flight-recorder-size` (expected:
+    # in run-to-run noise; docs/OBSERVABILITY.md §Overhead).
+    from knn_tpu.obs.reqtrace import FlightRecorder
+
+    rec = FlightRecorder(capacity=1024, slowest_k=16)
+    traced = MicroBatcher(model, max_batch=MAX_BATCH,
+                          max_wait_ms=MAX_WAIT_MS, recorder=rec)
+    try:
+        t_lats, t_wall, t_err = closed_loop(
+            8, lambda row: traced.predict(row, timeout=120))
+    finally:
+        traced.close()
+    failed += t_err
+    record["c8_traced_p50_ms"] = pct(t_lats, 50)
+    record["c8_traced_qps"] = round((8 * REQS - t_err) / t_wall, 1)
+    record["traced_timelines"] = rec.stats()["completed"]
+    log(f"serving c=8 with request tracing: p50 "
+        f"{record['c8_traced_p50_ms']} ms ({record['c8_traced_qps']} q/s, "
+        f"{record['traced_timelines']} timelines recorded) vs untraced "
+        f"{c8['batched_p50_ms']} ms")
     # Self-diagnosis: shed load must be visible in the artifact.
     reg = obs.registry()
     record["dropped_requests"] = sum(
@@ -1108,8 +1132,8 @@ _SUMMARY_EXTRA = {
                    "upload_ms", "pipelined_ms_per_call"),
     "sweepk": ("prefix_equivalence",),
     "serving": ("c8_batched_p50_ms", "c8_seq_p50_ms", "c8_batched_qps",
-                "batched_beats_seq_c8", "dropped_requests",
-                "deadline_expired"),
+                "batched_beats_seq_c8", "c8_traced_p50_ms",
+                "dropped_requests", "deadline_expired"),
 }
 
 

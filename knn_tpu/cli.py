@@ -127,6 +127,30 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
                    help="force a JAX platform (e.g. cpu, tpu) before model "
                    "warmup")
+    p.add_argument("--access-log", default=None, metavar="FILE",
+                   help="append one structured JSON line per terminal "
+                   "request outcome (request_id, status, outcome, rung, "
+                   "phase breakdown) to FILE; '-' logs to stderr")
+    p.add_argument("--flight-recorder-size", type=int, default=256,
+                   help="per-request timelines kept for /debug/requests "
+                   "(0 disables request tracing entirely)")
+    p.add_argument("--slowest-k", type=int, default=32,
+                   help="slowest-request reservoir size for /debug/slowest")
+    p.add_argument("--slo-availability-target", type=float, default=0.999,
+                   help="availability SLO: target fraction of non-400 "
+                   "requests answered 200")
+    p.add_argument("--slo-latency-ms", type=float, default=100.0,
+                   help="latency SLO threshold: a 200 slower than this "
+                   "spends latency error budget")
+    p.add_argument("--slo-latency-target", type=float, default=0.99,
+                   help="latency SLO: target fraction of requests answered "
+                   "200 within --slo-latency-ms")
+    p.add_argument("--slo-fast-rung-target", type=float, default=0.99,
+                   help="degradation SLO: target fraction of requests "
+                   "served by the model's own engine, not a fallback rung")
+    p.add_argument("--slo-windows", default=None, metavar="S1,S2,...",
+                   help="burn-rate windows in seconds (default: 300,3600 — "
+                   "the 5m/1h pair)")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -447,9 +471,36 @@ def _run_serve(args, stdout) -> int:
         (args.drain_timeout_s <= 0,
          f"--drain-timeout-s must be > 0, got {args.drain_timeout_s}"),
         (not 0 <= args.port <= 65535, f"--port out of range: {args.port}"),
+        (args.flight_recorder_size < 0,
+         f"--flight-recorder-size must be >= 0, got "
+         f"{args.flight_recorder_size}"),
+        (args.slowest_k < 0, f"--slowest-k must be >= 0, got {args.slowest_k}"),
+        (not 0 < args.slo_availability_target < 1,
+         f"--slo-availability-target must be in (0, 1), got "
+         f"{args.slo_availability_target}"),
+        (not 0 < args.slo_latency_target < 1,
+         f"--slo-latency-target must be in (0, 1), got "
+         f"{args.slo_latency_target}"),
+        (not 0 < args.slo_fast_rung_target < 1,
+         f"--slo-fast-rung-target must be in (0, 1), got "
+         f"{args.slo_fast_rung_target}"),
+        (args.slo_latency_ms <= 0,
+         f"--slo-latency-ms must be > 0, got {args.slo_latency_ms}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
+            return EXIT_USAGE
+    slo_windows = None
+    if args.slo_windows is not None:
+        try:
+            slo_windows = sorted(
+                {int(s) for s in args.slo_windows.split(",") if s}
+            )
+            if not slo_windows or slo_windows[0] < 1:
+                raise ValueError
+        except ValueError:
+            print(f"error: --slo-windows wants positive integer seconds, "
+                  f"got {args.slo_windows!r}", file=sys.stderr)
             return EXIT_USAGE
     warmup_batches = None
     if args.warmup_batches is not None:
@@ -480,11 +531,26 @@ def _run_serve(args, stdout) -> int:
     # The /metrics endpoint is this process's observability artifact;
     # serving without it would be flying blind.
     obs.enable()
-    app = ServeApp(
-        model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        max_queue_rows=args.max_queue_rows, deadline_ms=args.deadline_ms,
-        index_path=args.index, index_version=version,
+    from knn_tpu.obs.slo import DEFAULT_WINDOWS_S, SLOTracker
+
+    slo = SLOTracker(
+        availability_target=args.slo_availability_target,
+        latency_target_ms=args.slo_latency_ms,
+        latency_target=args.slo_latency_target,
+        fast_rung_target=args.slo_fast_rung_target,
+        windows_s=slo_windows or DEFAULT_WINDOWS_S,
     )
+    try:
+        app = ServeApp(
+            model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue_rows=args.max_queue_rows, deadline_ms=args.deadline_ms,
+            index_path=args.index, index_version=version,
+            flight_recorder_size=args.flight_recorder_size,
+            slowest_k=args.slowest_k, access_log=args.access_log, slo=slo,
+        )
+    except OSError as e:  # an unwritable --access-log path
+        print(f"error: --access-log {args.access_log}: {e}", file=sys.stderr)
+        return EXIT_USAGE
     try:
         server = make_server(app, args.host, args.port)
     except OSError as e:

@@ -15,7 +15,13 @@ pair around the KNN loop printed as a single milliseconds number
 - :mod:`knn_tpu.obs.export`  — file writers for ``--trace-out`` /
   ``--metrics-out``;
 - :mod:`knn_tpu.obs.bench_timing` — the pipelined-slope measurement
-  primitives shared by ``bench.py`` and ``scripts/tune_*.py``.
+  primitives shared by ``bench.py`` and ``scripts/tune_*.py``;
+- :mod:`knn_tpu.obs.reqtrace` — request-scoped tracing for the serving
+  stack: per-request timelines, the bounded flight recorder behind
+  ``/debug/requests``/``/debug/slowest``, per-request Perfetto export,
+  and the active-context channel the breaker/ladder emit through;
+- :mod:`knn_tpu.obs.slo`     — SLO objectives and multi-window
+  error-budget burn rates (``knn_slo_*`` gauges).
 
 Everything is OFF by default and zero-cost when off: ``span()`` returns a
 shared no-op context manager and the metric helpers return immediately, so
@@ -124,11 +130,12 @@ def gauge_set(name: str, value, *, help: str = "", **labels) -> None:
 
 
 def histogram_observe(
-    name: str, value, *, buckets=None, help: str = "", **labels
+    name: str, value, *, buckets=None, help: str = "", exemplar=None,
+    **labels
 ) -> None:
     if _ENABLED:
         _REGISTRY.histogram(name, buckets=buckets, help=help, **labels) \
-            .observe(value)
+            .observe(value, exemplar=exemplar)
 
 
 if os.environ.get("KNN_TPU_OBS", "") not in ("", "0"):

@@ -166,7 +166,12 @@ def record_serve_batch(requests: int, rows: int, dispatch_ms: float) -> None:
     )
 
 
-def record_serve_request_done(kind: str, outcome: str, ms: float) -> None:
+def record_serve_request_done(kind: str, outcome: str, ms: float,
+                              trace_id: "str | None" = None) -> None:
+    """One terminal serving outcome. ``trace_id`` (the request's id when
+    request tracing is on) rides the latency histogram as an OpenMetrics
+    exemplar, so a slow bucket links straight to its ``/debug/requests``
+    timeline."""
     obs.counter_add(
         "knn_serve_responses_total", 1,
         help="serving requests completed, by outcome", kind=kind,
@@ -176,6 +181,7 @@ def record_serve_request_done(kind: str, outcome: str, ms: float) -> None:
         "knn_serve_request_ms", ms, buckets=SERVE_MS_BUCKETS,
         help="per-request latency from enqueue to completion", kind=kind,
         outcome=outcome,
+        exemplar={"trace_id": trace_id} if trace_id else None,
     )
 
 

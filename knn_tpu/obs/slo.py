@@ -1,0 +1,179 @@
+"""SLO tracking: objectives, multi-window burn rates, `knn_slo_*` gauges.
+
+A latency histogram says what happened; an SLO burn rate says how fast the
+error budget is being spent — the Monarch/SRE-workbook alerting shape
+(PAPERS.md): ``burn = bad_fraction / (1 - target)`` over a window, so
+``burn == 1`` means "exactly on budget", ``burn >> 1`` means "budget gone
+in hours, page someone", and multi-window (a short and a long window
+together) separates a real incident from one bad scrape.
+
+Three serving SLIs, recorded once per terminal HTTP outcome
+(``serve/server.py``):
+
+- ``availability`` — good = the request answered 200. Overload shedding
+  (429/503), deadline 504s, and 500s spend budget; client-side 400s are
+  excluded entirely (they are the caller's defect, not the service's).
+- ``latency``      — good = answered 200 within ``latency_target_ms``.
+- ``fast_rung``    — good = answered 200 by the model's own configured
+  engine, NOT a degradation rung. The motivation's "a request silently
+  rode the oracle rung" is exactly this SLI burning while availability
+  stays green — bit-identical answers, degraded capacity.
+
+Implementation: a per-second ring of counters sized to the longest window
+(default 5 m / 1 h, env- and CLI-tunable), one lock, O(window) only on
+scrape — recording is O(1). Burn-rate gauges are computed lazily at
+exposition time (:meth:`SLOTracker.export`), surfaced in ``/metrics`` and
+``/healthz``, and asserted by the chaos-soak gate (burn rises during the
+fault burst, recovers to ~0 after the breaker re-closes).
+
+Like every obs layer: no tracker installed → one predicate per call site.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+from knn_tpu import obs
+
+#: Default burn-rate windows (seconds): the 5 m fast signal and the 1 h
+#: budget view. The soak gate shortens these via ``--slo-windows``.
+DEFAULT_WINDOWS_S = (300, 3600)
+
+OBJECTIVES = ("availability", "latency", "fast_rung")
+
+
+def window_label(seconds: int) -> str:
+    if seconds % 3600 == 0:
+        return f"{seconds // 3600}h"
+    if seconds % 60 == 0:
+        return f"{seconds // 60}m"
+    return f"{seconds}s"
+
+
+class SLOTracker:
+    """Multi-window burn-rate tracker over per-second outcome buckets.
+
+    ``record`` is called once per terminal outcome with the three SLI
+    verdicts already decided by the caller; ``burn_rates`` /
+    ``export`` aggregate the ring on demand. A window with zero events
+    reports burn 0.0 (no traffic spends no budget).
+    """
+
+    def __init__(self, *, availability_target: float = 0.999,
+                 latency_target_ms: float = 100.0,
+                 latency_target: float = 0.99,
+                 fast_rung_target: float = 0.99,
+                 windows_s: Sequence[int] = DEFAULT_WINDOWS_S):
+        for name, t in (("availability_target", availability_target),
+                        ("latency_target", latency_target),
+                        ("fast_rung_target", fast_rung_target)):
+            if not 0.0 < t < 1.0:
+                raise ValueError(f"{name} must be in (0, 1), got {t}")
+        if latency_target_ms <= 0:
+            raise ValueError(
+                f"latency_target_ms must be > 0, got {latency_target_ms}")
+        ws = tuple(sorted({int(w) for w in windows_s}))
+        if not ws or ws[0] < 1:
+            raise ValueError(f"windows_s must be positive, got {windows_s}")
+        self.targets = {
+            "availability": float(availability_target),
+            "latency": float(latency_target),
+            "fast_rung": float(fast_rung_target),
+        }
+        self.latency_target_ms = float(latency_target_ms)
+        self.windows_s = ws
+        # Bound the ring at ~3600 slots whatever the longest window is:
+        # second-wide slots up to an hour, coarser beyond (a 30-day window
+        # gets 12-minute slots — burn rates at that horizon don't need
+        # per-second resolution, and an unbounded ring would be a
+        # several-hundred-MB allocation plus an O(window) scrape scan
+        # under the same lock record() takes).
+        self.slot_s = max(1, -(-ws[-1] // 3600))
+        size = -(-ws[-1] // self.slot_s)
+        self._lock = threading.Lock()
+        # Ring slot: [slot_stamp, total, ok, latency_ok, fast_ok]
+        self._ring = [[0, 0, 0, 0, 0] for _ in range(size)]
+
+    # -- recording (O(1)) --------------------------------------------------
+
+    def record(self, ok: bool, latency_ms: float,
+               degraded: bool = False) -> None:
+        """One terminal outcome: ``ok`` = answered 200, ``latency_ms`` =
+        the request's wall, ``degraded`` = served by a fallback rung (or
+        unknown — failures count degraded)."""
+        now = int(time.monotonic() // self.slot_s)
+        slot = self._ring[now % len(self._ring)]
+        with self._lock:
+            if slot[0] != now:
+                slot[0], slot[1], slot[2], slot[3], slot[4] = now, 0, 0, 0, 0
+            slot[1] += 1
+            if ok:
+                slot[2] += 1
+                if latency_ms <= self.latency_target_ms:
+                    slot[3] += 1
+                if not degraded:
+                    slot[4] += 1
+
+    # -- aggregation (O(window), scrape-time only) -------------------------
+
+    def window_counts(self, window_s: int) -> Tuple[int, int, int, int]:
+        """``(total, ok, latency_ok, fast_ok)`` over the trailing window."""
+        now = int(time.monotonic() // self.slot_s)
+        lo = now - max(1, int(window_s) // self.slot_s)
+        total = ok = lat = fast = 0
+        with self._lock:
+            for slot in self._ring:
+                if lo < slot[0] <= now:
+                    total += slot[1]
+                    ok += slot[2]
+                    lat += slot[3]
+                    fast += slot[4]
+        return total, ok, lat, fast
+
+    def burn_rates(self) -> Dict[str, Dict[str, float]]:
+        """``{objective: {window_label: burn}}``; burn 1.0 = spending the
+        error budget exactly at the sustainable rate."""
+        out: Dict[str, Dict[str, float]] = {o: {} for o in OBJECTIVES}
+        for w in self.windows_s:
+            total, ok, lat, fast = self.window_counts(w)
+            label = window_label(w)
+            goods = {"availability": ok, "latency": lat, "fast_rung": fast}
+            for objective in OBJECTIVES:
+                if total == 0:
+                    burn = 0.0
+                else:
+                    bad_frac = 1.0 - goods[objective] / total
+                    burn = bad_frac / (1.0 - self.targets[objective])
+                out[objective][label] = round(burn, 4)
+        return out
+
+    def export(self) -> dict:
+        """Compute burn rates, push the ``knn_slo_*`` gauges into the
+        global registry (no-ops while obs is disabled), and return the
+        summary dict ``/healthz`` embeds."""
+        burns = self.burn_rates()
+        for objective, per_window in burns.items():
+            obs.gauge_set(
+                "knn_slo_target", self.targets[objective],
+                help="SLO objective target (good-event fraction)",
+                objective=objective,
+            )
+            for label, burn in per_window.items():
+                obs.gauge_set(
+                    "knn_slo_burn_rate", burn,
+                    help="error-budget burn rate (bad fraction / budget; "
+                         "1.0 = on budget, >1 = burning faster)",
+                    objective=objective, window=label,
+                )
+        obs.gauge_set(
+            "knn_slo_latency_target_ms", self.latency_target_ms,
+            help="latency SLO threshold (ms)",
+        )
+        return {
+            "targets": dict(self.targets),
+            "latency_target_ms": self.latency_target_ms,
+            "windows": [window_label(w) for w in self.windows_s],
+            "burn_rates": burns,
+        }

@@ -46,6 +46,7 @@ import time
 from collections import deque
 
 from knn_tpu import obs
+from knn_tpu.obs import reqtrace
 
 _WINDOW_ENV = "KNN_TPU_BREAKER_WINDOW"
 _THRESHOLD_ENV = "KNN_TPU_BREAKER_THRESHOLD"
@@ -136,7 +137,11 @@ class CircuitBreaker:
             help="circuit-breaker state (0 closed / 1 open / 2 half-open)",
             breaker=self.name,
         )
-        # A zero-length marker span: traces show when serving degraded.
+        # A zero-length marker span: traces show when serving degraded —
+        # and the same marker lands in every request context the current
+        # dispatch is serving (one thread-local predicate when none are).
+        reqtrace.emit("breaker.transition", breaker=self.name,
+                      from_state=frm, to_state=to)
         with obs.span("breaker.transition", breaker=self.name,
                       from_state=frm, to_state=to):
             pass

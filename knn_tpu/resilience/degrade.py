@@ -107,6 +107,11 @@ def _record_fallback(frm: str, to: str, reason: str) -> None:
         help="degradation-ladder moves (backend -> fallback backend)",
         from_backend=frm, to=to, reason=reason,
     )
+    # Also lands in any active request contexts (a laddered predict run
+    # inside a traced serving dispatch); one predicate otherwise.
+    from knn_tpu.obs import reqtrace
+
+    reqtrace.emit("fallback", from_backend=frm, to=to, reason=reason)
 
 
 class LadderResult:

@@ -84,7 +84,7 @@ import numpy as np
 from knn_tpu import obs
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.models.knn import AsyncResult, KNNClassifier, _kneighbors_arrays
-from knn_tpu.obs import instrument
+from knn_tpu.obs import instrument, reqtrace
 from knn_tpu.resilience import faults
 from knn_tpu.resilience.breaker import CircuitBreaker
 from knn_tpu.resilience.errors import (
@@ -99,16 +99,18 @@ KINDS = ("predict", "kneighbors")
 
 
 class _Request:
-    """One queued request: features, kind, timing, and the completion
-    event its future waits on."""
+    """One queued request: features, kind, timing, the completion event
+    its future waits on, and (when request tracing is on) the
+    :class:`~knn_tpu.obs.reqtrace.RequestTrace` that owns its timeline."""
 
     __slots__ = (
         "features", "kind", "rows", "enqueued_ns", "deadline_ns", "event",
-        "value", "error", "meta",
+        "value", "error", "meta", "trace",
     )
 
     def __init__(self, features: np.ndarray, kind: str,
-                 deadline_ns: Optional[int]):
+                 deadline_ns: Optional[int],
+                 trace: "Optional[reqtrace.RequestTrace]" = None):
         self.features = features
         self.kind = kind
         self.rows = features.shape[0]
@@ -118,13 +120,24 @@ class _Request:
         self.value = None
         self.error: Optional[BaseException] = None
         self.meta: dict = {}
+        self.trace = trace
 
     # -- completion (worker side) -----------------------------------------
 
     def _finish(self, outcome: str) -> None:
         try:
             ms = (time.monotonic_ns() - self.enqueued_ns) / 1e6
-            instrument.record_serve_request_done(self.kind, outcome, ms)
+            instrument.record_serve_request_done(
+                self.kind, outcome, ms,
+                trace_id=(self.trace.request_id
+                          if self.trace is not None else None),
+            )
+            if self.trace is not None:
+                if self.error is not None:
+                    self.trace.annotate(
+                        error=f"{type(self.error).__name__}: {self.error}"
+                    )
+                self.trace.finish(outcome)
         except Exception:  # noqa: BLE001 — metrics must never block
             pass  # completion: a waiter left unsignaled is a hung client
         finally:
@@ -173,12 +186,22 @@ class MicroBatcher:
                          submissions with :class:`OverloadError`;
     ``index_version``  — opaque version tag stamped on every response's
                          ``meta`` (the artifact store's version on the
-                         serving path; None for embedded use).
+                         serving path; None for embedded use);
+    ``recorder``       — an optional
+                         :class:`~knn_tpu.obs.reqtrace.FlightRecorder`:
+                         when set, every admitted request owns a
+                         :class:`~knn_tpu.obs.reqtrace.RequestTrace`
+                         timeline (queue_wait/dispatch phases, per-rung
+                         attempts, breaker + fallback events) committed to
+                         the recorder at its terminal outcome. None (the
+                         default) keeps the whole layer at one
+                         ``is None`` predicate per call site.
     """
 
     def __init__(self, model, *, max_batch: int = 256,
                  max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
-                 index_version: Optional[str] = None):
+                 index_version: Optional[str] = None,
+                 recorder: "Optional[reqtrace.FlightRecorder]" = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -191,6 +214,7 @@ class MicroBatcher:
         model.train_  # raises RuntimeError before fit — fail at build time
         self._model = model
         self._index_version = index_version
+        self.recorder = recorder
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue_rows = int(max_queue_rows)
@@ -212,16 +236,20 @@ class MicroBatcher:
     # -- client side -------------------------------------------------------
 
     def submit(self, features, kind: str = "predict",
-               deadline_ms: Optional[float] = None) -> AsyncResult:
+               deadline_ms: Optional[float] = None,
+               trace: "Optional[reqtrace.RequestTrace]" = None) -> AsyncResult:
         """Enqueue one request; returns the future immediately.
 
         ``features``: one query row ``[D]`` or a row batch ``[q, D]``
         (float32-coerced). ``deadline_ms`` bounds the QUEUE+DISPATCH time:
         a request still undispatched when it expires fails with
         :class:`DeadlineExceededError` instead of occupying a batch slot.
+        ``trace`` attaches a caller-built request context (the HTTP layer
+        passes one carrying the ``x-request-id``); with a ``recorder``
+        configured and no ``trace``, one is created here at admission.
         Raises :class:`OverloadError` when the queue is full, the batcher
-        is draining, or it is closed; :class:`ValueError` for shape
-        mismatches.
+        is draining, or it is closed (the trace, if any, is finished
+        ``rejected`` first); :class:`ValueError` for shape mismatches.
         """
         if kind not in KINDS:
             raise ValueError(f"unknown request kind {kind!r}; choose "
@@ -241,26 +269,44 @@ class MicroBatcher:
             time.monotonic_ns() + int(deadline_ms * 1e6)
             if deadline_ms is not None else None
         )
-        req = _Request(x, kind, deadline_ns)
-        with self._cond:
-            if self._closed:
-                instrument.record_serve_rejected("closed")
-                raise OverloadError("batcher is shut down")
-            if self._draining:
-                instrument.record_serve_rejected("draining")
-                raise OverloadError(
-                    "server is draining (shutting down); no new work "
-                    "accepted — retry against another replica"
-                )
-            if self._queued_rows + req.rows > self.max_queue_rows:
-                instrument.record_serve_rejected("queue_full")
-                raise OverloadError(
-                    f"request queue full ({self._queued_rows} rows queued, "
-                    f"bound {self.max_queue_rows}); retry after backoff"
-                )
-            self._queue.append(req)
-            self._queued_rows += req.rows
-            self._cond.notify_all()
+        if trace is None and self.recorder is not None:
+            trace = self.recorder.new_trace(kind, x.shape[0])
+        req = _Request(x, kind, deadline_ns, trace)
+        if trace is not None:
+            # Embedded callers learn their id from the future's meta (the
+            # HTTP layer already knows it — it minted the trace).
+            req.meta["request_id"] = trace.request_id
+        try:
+            with self._cond:
+                if self._closed:
+                    instrument.record_serve_rejected("closed")
+                    raise OverloadError("batcher is shut down")
+                if self._draining:
+                    instrument.record_serve_rejected("draining")
+                    raise OverloadError(
+                        "server is draining (shutting down); no new work "
+                        "accepted — retry against another replica"
+                    )
+                if self._queued_rows + req.rows > self.max_queue_rows:
+                    instrument.record_serve_rejected("queue_full")
+                    raise OverloadError(
+                        f"request queue full ({self._queued_rows} rows "
+                        f"queued, bound {self.max_queue_rows}); retry "
+                        f"after backoff"
+                    )
+                if trace is not None:
+                    trace.phase_start("queue_wait")
+                self._queue.append(req)
+                self._queued_rows += req.rows
+                self._cond.notify_all()
+        except OverloadError as e:
+            # A refused admission is still a terminal outcome the flight
+            # recorder must resolve (every response's request_id maps to a
+            # timeline — the chaos-soak invariant).
+            if trace is not None:
+                trace.annotate(error=f"OverloadError: {e}")
+                trace.finish("rejected")
+            raise
         instrument.record_serve_request(kind, req.rows)
         return req.handle()
 
@@ -520,6 +566,8 @@ class MicroBatcher:
         for req in live:
             if req.deadline_ns is not None and now_ns > req.deadline_ns:
                 instrument.record_serve_deadline_expired()
+                if req.trace is not None:
+                    req.trace.annotate(expired_where="mid-fallback")
                 req.fail(
                     DeadlineExceededError(
                         f"{req.kind} request deadline expired after "
@@ -548,75 +596,105 @@ class MicroBatcher:
             # the rung that last answered instead of paying a doomed
             # dispatch + ladder walk per batch.
             start = min(max(1, self._degraded_rung), len(rungs) - 1)
+        # Request-context weave: `traced` is updated IN PLACE when deadline
+        # expiries shrink `live`, so the activation below (the channel the
+        # breaker's transition events arrive through) always reflects the
+        # requests still being served. Empty when tracing is off.
+        traced = [r.trace for r in live if r.trace is not None]
+        for t in traced:
+            t.annotate(breaker=decision)
+            if decision == "open":
+                t.event("breaker.short_circuit", to_rung=rungs[start][0])
         last_err: Optional[Exception] = None
         pos = start
         feats = None  # rebuilt only when `live` shrinks, not per attempt
-        while pos < len(rungs):
-            if last_err is not None:
-                kept = self._expire_now(live)
-                if len(kept) != len(live):
-                    feats = None
-                live = kept
-                if not live:
-                    return live, None, None, None
-            name, fn = rungs[pos]
-            if feats is None:
-                feats = (
-                    live[0].features if len(live) == 1
-                    else np.concatenate([r.features for r in live])
-                )
-            try:
-                if pos == 0:
-                    if decision == "probe":
-                        with obs.span("breaker.probe",
-                                      breaker=self.breaker.name):
+        with reqtrace.activate(traced):
+            while pos < len(rungs):
+                if last_err is not None:
+                    kept = self._expire_now(live)
+                    if len(kept) != len(live):
+                        feats = None
+                        traced[:] = [r.trace for r in kept
+                                     if r.trace is not None]
+                    live = kept
+                    if not live:
+                        return live, None, None, None
+                name, fn = rungs[pos]
+                if feats is None:
+                    feats = (
+                        live[0].features if len(live) == 1
+                        else np.concatenate([r.features for r in live])
+                    )
+                t_rung = time.monotonic()
+                try:
+                    if pos == 0:
+                        if decision == "probe":
+                            with obs.span("breaker.probe",
+                                          breaker=self.breaker.name):
+                                faults.fault_point("serve.dispatch")
+                                out = self._call_rung(fn, feats)
+                        else:
                             faults.fault_point("serve.dispatch")
                             out = self._call_rung(fn, feats)
+                        self.breaker.record_success()
                     else:
-                        faults.fault_point("serve.dispatch")
                         out = self._call_rung(fn, feats)
-                    self.breaker.record_success()
-                else:
-                    out = self._call_rung(fn, feats)
-                    self._degraded_rung = pos
-                self._last_rung = name
-                return live, out[0], out[1], name
-            except DeviceError as e:
-                if e.oom and self.max_batch > 1:
-                    prev, self.max_batch = self.max_batch, max(
-                        1, self.max_batch // 2)
+                        self._degraded_rung = pos
+                    self._last_rung = name
+                    for t in traced:
+                        t.attempt(name, True,
+                                  (time.monotonic() - t_rung) * 1e3)
+                    return live, out[0], out[1], name
+                except DeviceError as e:
+                    for t in traced:
+                        t.attempt(name, False,
+                                  (time.monotonic() - t_rung) * 1e3,
+                                  error=type(e).__name__)
+                    if e.oom and self.max_batch > 1:
+                        prev, self.max_batch = self.max_batch, max(
+                            1, self.max_batch // 2)
+                        self._warn(
+                            f"serving dispatch OOM on rung '{name}'; halving "
+                            f"max_batch {prev} -> {self.max_batch}"
+                        )
+                        obs.counter_add(
+                            "knn_serve_fallback_total",
+                            help="serving-ladder moves (rung -> fallback "
+                                 "rung; from==to is an in-place max_batch "
+                                 "halving)",
+                            from_rung=name, to=name, reason="oom_halve_batch",
+                        )
+                        reqtrace.emit("fallback", from_rung=name, to=name,
+                                      reason="oom_halve_batch",
+                                      max_batch=self.max_batch)
+                        last_err = e
+                        continue  # same rung, smaller chunks
+                    last_err = e
+                except (CompileError, CollectiveError, OSError) as e:
+                    for t in traced:
+                        t.attempt(name, False,
+                                  (time.monotonic() - t_rung) * 1e3,
+                                  error=type(e).__name__)
+                    last_err = e
+                if pos == 0:
+                    self.breaker.record_failure()
+                nxt = rungs[pos + 1][0] if pos + 1 < len(rungs) else None
+                if nxt is not None:
                     self._warn(
-                        f"serving dispatch OOM on rung '{name}'; halving "
-                        f"max_batch {prev} -> {self.max_batch}"
+                        f"serving rung '{name}' failed "
+                        f"({type(last_err).__name__}: {last_err}); "
+                        f"falling back to '{nxt}'"
                     )
                     obs.counter_add(
                         "knn_serve_fallback_total",
                         help="serving-ladder moves (rung -> fallback rung; "
                              "from==to is an in-place max_batch halving)",
-                        from_rung=name, to=name, reason="oom_halve_batch",
+                        from_rung=name, to=nxt,
+                        reason=type(last_err).__name__,
                     )
-                    last_err = e
-                    continue  # same rung, smaller chunks
-                last_err = e
-            except (CompileError, CollectiveError, OSError) as e:
-                last_err = e
-            if pos == 0:
-                self.breaker.record_failure()
-            nxt = rungs[pos + 1][0] if pos + 1 < len(rungs) else None
-            if nxt is not None:
-                self._warn(
-                    f"serving rung '{name}' failed "
-                    f"({type(last_err).__name__}: {last_err}); "
-                    f"falling back to '{nxt}'"
-                )
-                obs.counter_add(
-                    "knn_serve_fallback_total",
-                    help="serving-ladder moves (rung -> fallback rung; "
-                         "from==to is an in-place max_batch halving)",
-                    from_rung=name, to=nxt,
-                    reason=type(last_err).__name__,
-                )
-            pos += 1
+                    reqtrace.emit("fallback", from_rung=name, to=nxt,
+                                  reason=type(last_err).__name__)
+                pos += 1
         assert last_err is not None
         raise last_err
 
@@ -634,8 +712,12 @@ class MicroBatcher:
             instrument.record_serve_queue_wait(
                 (now_ns - req.enqueued_ns) / 1e6, req.kind
             )
+            if req.trace is not None:
+                req.trace.phase_end("queue_wait")
             if req.deadline_ns is not None and now_ns > req.deadline_ns:
                 instrument.record_serve_deadline_expired()
+                if req.trace is not None:
+                    req.trace.annotate(expired_where="queue")
                 req.fail(
                     DeadlineExceededError(
                         f"{req.kind} request expired in queue after "
@@ -648,6 +730,10 @@ class MicroBatcher:
         if not live:
             return
         rows = sum(r.rows for r in live)
+        for req in live:
+            if req.trace is not None:
+                req.trace.phase_start("dispatch")
+                req.trace.annotate(batch_requests=len(live), batch_rows=rows)
         t0 = time.monotonic()
         try:
             with obs.span("serve.dispatch", requests=len(live), rows=rows):
@@ -661,6 +747,8 @@ class MicroBatcher:
                     off += req.rows
                     req.meta["index_version"] = version
                     req.meta["rung"] = rung
+                    if req.trace is not None:
+                        req.trace.annotate(index_version=version, rung=rung)
                     if req.kind == "kneighbors":
                         req.succeed((d, i))
                     elif isinstance(model, KNNClassifier):

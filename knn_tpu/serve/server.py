@@ -20,7 +20,24 @@ Endpoint contract (docs/SERVING.md):
   while draining.
 - ``GET /metrics``      → the Prometheus text exposition straight from
   the global :mod:`knn_tpu.obs` registry (``knn_serve_*`` plus every
-  model/backend metric the process has recorded).
+  model/backend metric the process has recorded); with ``Accept:
+  application/openmetrics-text``, the OpenMetrics exposition whose
+  ``knn_serve_request_ms`` buckets carry ``trace_id`` exemplars.
+- ``GET /debug/requests`` / ``GET /debug/slowest`` → the flight
+  recorder's last-N / slowest-K per-request timelines
+  (``?id=<request_id>`` resolves one, ``?format=perfetto`` exports
+  Chrome ``trace_event`` JSON — docs/OBSERVABILITY.md).
+
+Every request is tagged with a **request id** — the ``x-request-id``
+header when the client sent a valid one (≤128 printable ASCII chars;
+anything else is a 400), generated at admission otherwise — echoed on
+EVERY response (header + JSON body, errors included), resolvable in the
+flight recorder, stamped on latency-histogram exemplars, and keyed into
+the optional ``--access-log`` (one JSON line per terminal outcome,
+written by the handler thread after the response — off the dispatch hot
+path). Terminal outcomes also feed the SLO tracker
+(:mod:`knn_tpu.obs.slo` — availability / latency / fast-rung burn rates
+in ``/healthz`` and ``knn_slo_*`` gauges).
 - ``POST /admin/reload`` body ``{}`` or ``{"index": PATH}`` → hot index
   reload: load + validate the artifact off the serving path, warm it in
   the background, atomically swap; ANY failure rolls back with the old
@@ -45,15 +62,19 @@ from __future__ import annotations
 import contextlib
 import json
 import math
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from knn_tpu import obs
 from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.obs import reqtrace
+from knn_tpu.obs.slo import SLOTracker
 from knn_tpu.resilience.errors import (
     DataError,
     DeadlineExceededError,
@@ -64,6 +85,38 @@ from knn_tpu.serve.batcher import MicroBatcher
 
 #: Request bodies past this are rejected 413 before json.loads allocates.
 MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class AccessLog:
+    """One structured JSON line per terminal request outcome.
+
+    Lines are written by the HANDLER thread after its response went out —
+    never by the batcher worker, so logging cost stays off the dispatch
+    hot path. ``path='-'`` logs to stderr; anything else appends to the
+    file (line-buffered, one lock — the lines are small and terminal, so
+    contention is bounded by response rate, not dispatch rate)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._file = (sys.stderr if path == "-"
+                      else open(path, "a", buffering=1, encoding="utf-8"))
+
+    def write(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":"), default=str)
+        with self._lock:
+            try:
+                self._file.write(line + "\n")
+            except (OSError, ValueError):
+                pass  # a full disk / closed file must never fail a request
+
+    def close(self) -> None:
+        if self._file is not sys.stderr:
+            with self._lock:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
 
 
 class ReloadInProgress(OverloadError):
@@ -78,7 +131,10 @@ class ServeApp:
                  max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
                  deadline_ms: Optional[float] = None,
                  index_path: Optional[str] = None,
-                 index_version: Optional[str] = None):
+                 index_version: Optional[str] = None,
+                 flight_recorder_size: int = 256, slowest_k: int = 32,
+                 access_log: Optional[str] = None,
+                 slo: Optional[SLOTracker] = None):
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -86,9 +142,20 @@ class ServeApp:
         self.deadline_ms = deadline_ms
         self.index_path = index_path
         self.index_version = index_version
+        # Request tracing: the flight recorder holds the last-N completed
+        # request timelines + a slowest-K reservoir (/debug/requests,
+        # /debug/slowest). Size 0 disables the layer entirely (the batcher
+        # then pays one `trace is None` predicate per call site).
+        self.recorder = (
+            reqtrace.FlightRecorder(flight_recorder_size, slowest_k)
+            if flight_recorder_size > 0 else None
+        )
+        self.slo = slo if slo is not None else SLOTracker()
+        self.access_log = AccessLog(access_log) if access_log else None
         self.batcher = MicroBatcher(
             model, max_batch=max_batch, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, index_version=index_version,
+            recorder=self.recorder,
         )
         self.ready = False
         self.draining = False
@@ -257,9 +324,11 @@ class ServeApp:
     def close(self) -> None:
         self.ready = False
         self.batcher.close()
+        if self.access_log is not None:
+            self.access_log.close()
 
     def health(self) -> dict:
-        return {
+        h = {
             "ready": self.ready,
             "draining": self.draining,
             "index_version": self.index_version,
@@ -273,7 +342,13 @@ class ServeApp:
             "num_features": self.model.train_.num_features,
             "uptime_s": round(time.time() - self.started_unix, 1),
             "warmup_ms": self.warmup_ms,
+            # export() also refreshes the knn_slo_* gauges, so a /healthz
+            # poller keeps them current between /metrics scrapes.
+            "slo": self.slo.export(),
         }
+        if self.recorder is not None:
+            h["flight_recorder"] = self.recorder.stats()
+        return h
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -291,13 +366,44 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, format, *args):  # noqa: A002 — stdlib signature
         # Per-request stderr lines at serving rates are an accidental DoS
-        # on the process's own stderr; the /metrics endpoint is the log.
+        # on the process's own stderr; the /metrics endpoint (and the
+        # structured --access-log) is the log.
         pass
 
-    def _send(self, status: int, payload: dict, content_type="application/json"):
+    def _begin(self) -> bool:
+        """Adopt or mint the request id for this request. A client-supplied
+        ``x-request-id`` is echoed end to end (trace, flight recorder,
+        access log, response header + body); an oversized/malformed one is
+        a 400 with a generated id — never a traceback. Returns False when
+        the request was already answered."""
+        raw = self.headers.get("x-request-id")
+        if raw is None:
+            self._rid = reqtrace.gen_request_id()
+            return True
+        raw = raw.strip()
+        if not reqtrace.valid_request_id(raw):
+            self._rid = reqtrace.gen_request_id()
+            self.close_connection = True  # the body was never drained
+            self._send(400, {
+                "error": f"invalid x-request-id header: want 1-"
+                         f"{reqtrace.MAX_REQUEST_ID_LEN} printable "
+                         f"non-space ASCII characters, got {len(raw)} "
+                         f"byte(s)",
+            })
+            return False
+        self._rid = raw
+        return True
+
+    def _send(self, status: int, payload: dict,
+              content_type="application/json", tag_request_id=True):
+        rid = getattr(self, "_rid", None)
+        if tag_request_id and rid is not None and "request_id" not in payload:
+            payload = {**payload, "request_id": rid}
         body = (json.dumps(payload) + "\n").encode()
         self.send_response(status)
         self.send_header("Content-Type", content_type)
+        if rid is not None:
+            self.send_header("x-request-id", rid)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -306,6 +412,9 @@ class _Handler(BaseHTTPRequestHandler):
         body = text.encode()
         self.send_response(status)
         self.send_header("Content-Type", content_type)
+        rid = getattr(self, "_rid", None)
+        if rid is not None:
+            self.send_header("x-request-id", rid)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -313,17 +422,79 @@ class _Handler(BaseHTTPRequestHandler):
     # -- GET ---------------------------------------------------------------
 
     def do_GET(self):  # noqa: N802 — stdlib dispatch name
-        if self.path == "/healthz":
+        if not self._begin():
+            return
+        route = urlparse(self.path).path
+        if route == "/healthz":
             h = self.app.health()
             ok = h["ready"] and not h["draining"]
             self._send(200 if ok else 503, h)
-        elif self.path == "/metrics":
-            self._send_text(
-                200, obs.registry().to_prometheus(),
-                "text/plain; version=0.0.4",
-            )
+        elif route == "/metrics":
+            # Refresh the scrape-time gauges (knn_slo_*) before rendering.
+            self.app.slo.export()
+            accept = self.headers.get("Accept", "")
+            if "application/openmetrics-text" in accept:
+                self._send_text(
+                    200, obs.registry().to_openmetrics(),
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8",
+                )
+            else:
+                self._send_text(
+                    200, obs.registry().to_prometheus(),
+                    "text/plain; version=0.0.4",
+                )
+        elif route in ("/debug/requests", "/debug/slowest"):
+            self._do_debug(route)
         else:
             self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def _do_debug(self, route: str):
+        """The flight recorder's read side: ``/debug/requests`` (last-N
+        timelines, newest first) and ``/debug/slowest`` (the slowest-K
+        reservoir). ``?id=<request_id>`` resolves one timeline;
+        ``?n=<count>`` bounds the list; ``?format=perfetto`` returns the
+        timelines as Chrome/Perfetto ``trace_event`` JSON (one track per
+        request — load at ui.perfetto.dev)."""
+        rec = self.app.recorder
+        if rec is None:
+            self._send(404, {"error": "request tracing is disabled "
+                                      "(--flight-recorder-size 0)"})
+            return
+        q = parse_qs(urlparse(self.path).query)
+        fmt = q.get("format", ["json"])[0]
+        rid = q.get("id", [None])[0]
+        if rid is not None:
+            tl = rec.find(rid)
+            if tl is None:
+                self._send(404, {"error": f"request_id {rid!r} not in the "
+                                          f"flight recorder (evicted or "
+                                          f"never traced)"})
+                return
+            timelines = [tl]
+        elif route == "/debug/slowest":
+            timelines = rec.slowest()
+        else:
+            try:
+                n = int(q["n"][0]) if "n" in q else None
+            except ValueError:
+                self._send(400, {"error": f"bad n={q['n'][0]!r}: want an "
+                                          f"integer"})
+                return
+            timelines = rec.recent(n)
+        # No request_id injection here: these payloads are ABOUT other
+        # requests' ids — the debug GET's own id stamped on top (or into
+        # the Perfetto artifact CI uploads) would only mislead. The
+        # x-request-id response header still carries it.
+        if fmt == "perfetto":
+            self._send(200, rec.to_chrome_trace(timelines),
+                       tag_request_id=False)
+        elif fmt == "json":
+            self._send(200, {"requests": timelines, **rec.stats()},
+                       tag_request_id=False)
+        else:
+            self._send(400, {"error": f"bad format={fmt!r}: want json or "
+                                      f"perfetto"})
 
     # -- POST --------------------------------------------------------------
 
@@ -353,6 +524,8 @@ class _Handler(BaseHTTPRequestHandler):
         return body, None, None
 
     def do_POST(self):  # noqa: N802 — stdlib dispatch name
+        if not self._begin():
+            return
         if self.path == "/admin/reload":
             self._do_reload()
             return
@@ -393,11 +566,62 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, result)
 
+    def _account(self, kind: str, status: int, outcome: str, t0: float,
+                 trace=None, rung: Optional[str] = None,
+                 rows: Optional[int] = None,
+                 index_version: Optional[str] = None) -> None:
+        """Terminal-outcome bookkeeping, on the HANDLER thread after the
+        response went out: the SLO record (400s excluded — a malformed
+        body is the caller's defect, not service unavailability), the
+        trace's HTTP status annotation (+ finish, for requests the batcher
+        never admitted), and the structured access-log line."""
+        ms = (time.monotonic() - t0) * 1e3
+        if status != 400:
+            self.app.slo.record(status == 200, ms,
+                                degraded=(rung != "fast"))
+        if trace is not None:
+            trace.annotate(status=status)
+            if not trace.finished:
+                trace.finish(outcome)
+        if self.app.access_log is not None:
+            entry = {
+                "ts": round(time.time(), 6),
+                "request_id": self._rid,
+                "kind": kind,
+                "status": status,
+                "outcome": outcome,
+                "ms": round(ms, 3),
+                "rows": rows,
+                "rung": rung,
+                "index_version": index_version,
+            }
+            if trace is not None:
+                tl = trace.to_dict()
+                phases: dict = {}
+                for p in tl["phases"]:
+                    phases[p["phase"]] = round(
+                        phases.get(p["phase"], 0.0) + (p["ms"] or 0.0), 3)
+                entry["phases"] = phases
+                if tl["attempts"]:
+                    entry["attempts"] = [
+                        f"{a['rung']}:{'ok' if a['ok'] else a.get('error', 'fail')}"
+                        for a in tl["attempts"]
+                    ]
+                if "batch_requests" in tl:
+                    entry["batch_requests"] = tl["batch_requests"]
+            self.app.access_log.write(entry)
+
     def _do_inference(self, kind: str):
+        # Two clocks: t_recv covers body upload + parse (access-log only —
+        # a client trickling its body is the CLIENT's time), t0 below
+        # covers submit -> response (the service-side "ms" field and the
+        # latency SLI; a slow uploader must not burn the latency SLO).
+        t_recv = time.monotonic()
         body, err, status = self._read_json_body(required=True)
         if err is not None:
             self.close_connection = True
             self._send(status, {"error": err})
+            self._account(kind, status, "invalid", t_recv)
             return
         try:
             instances = body["instances"]
@@ -410,29 +634,48 @@ class _Handler(BaseHTTPRequestHandler):
             x = np.asarray(instances, dtype=np.float32)
         except (KeyError, TypeError, ValueError) as e:
             self._send(400, {"error": f"bad request body: {e}"})
+            self._account(kind, 400, "invalid", t_recv)
             return
+        rows = int(x.shape[0]) if x.ndim > 1 else 1
         t0 = time.monotonic()
+        trace = None
+        if self.app.recorder is not None:
+            # The request context: created at admission, carried through
+            # the batcher's queue -> batch -> ladder, committed to the
+            # flight recorder at its terminal outcome.
+            trace = self.app.recorder.new_trace(kind, rows,
+                                                request_id=self._rid)
+            if deadline_ms is not None:
+                trace.annotate(deadline_ms=deadline_ms)
         try:
-            handle = self.app.batcher.submit(x, kind, deadline_ms=deadline_ms)
+            handle = self.app.batcher.submit(x, kind, deadline_ms=deadline_ms,
+                                             trace=trace)
         except OverloadError as e:
             # While draining, 503 (not 429): the load balancer should take
             # this replica out of rotation, not have the client retry here.
-            self._send(503 if self.app.draining else 429, {"error": str(e)})
+            st = 503 if self.app.draining else 429
+            self._send(st, {"error": str(e)})
+            self._account(kind, st, "rejected", t0, trace=trace, rows=rows)
             return
         except ValueError as e:  # shape/kind rejection
             self._send(400, {"error": str(e)})
+            self._account(kind, 400, "invalid", t0, trace=trace, rows=rows)
             return
         timeout = deadline_ms / 1e3 if deadline_ms is not None else None
         try:
             value = handle.result(timeout=timeout)
         except DeadlineExceededError as e:
             self._send(504, {"error": str(e)})
+            self._account(kind, 504, "expired", t0, trace=trace, rows=rows,
+                          rung=(handle.meta or {}).get("rung"))
             return
         except Exception as e:  # noqa: BLE001 — the batcher delivers ANY
             # failure to the future (that is its worker-survival contract);
             # whatever arrives must become the documented JSON 500, never a
             # handler traceback + dropped connection.
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            self._account(kind, 500, "error", t0, trace=trace, rows=rows,
+                          rung=(handle.meta or {}).get("rung"))
             return
         ms = round((time.monotonic() - t0) * 1e3, 3)
         meta = handle.meta or {}
@@ -448,6 +691,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "index_version": meta.get("index_version"),
                 "ms": ms,
             })
+        self._account(kind, 200, "ok", t0, trace=trace,
+                      rung=meta.get("rung"), rows=rows,
+                      index_version=meta.get("index_version"))
 
 
 class KNNServer(ThreadingHTTPServer):

@@ -17,6 +17,13 @@ What it does:
      never returns is a hang and fails the gate;
    - every 200 body is **bit-identical to the synchronous oracle**
      (`knn_oracle` on the same rows) and carries ``index_version``;
+   - every terminal response (200/429/503/504) carries a ``request_id``,
+     and every request_id a client saw **resolves to exactly one
+     flight-recorder timeline** (``/debug/requests``) whose phases are
+     all closed and sum to within tolerance of its ``request_ms``;
+   - the SLO burn rate (the ``fast_rung`` objective — requests served by
+     a degradation rung spend its budget) RISES during the fault burst
+     and RECOVERS to ~0 after the breaker re-closes;
    - no response body ever contains a traceback;
    - zero 500s: in-loop degradation must absorb the whole fault burst;
    - the breaker OPENS under the burst and RE-CLOSES after it clears,
@@ -24,7 +31,9 @@ What it does:
      (availability back to 100%);
    - a final SIGTERM under load drains cleanly: exit code 0 within
      ``--drain-timeout-s`` + grace, in-flight requests answered;
-5. emit a BENCH-style availability / error-budget JSON on stdout.
+5. emit a BENCH-style availability / error-budget JSON on stdout, and
+   (``--perfetto-out``) save the per-request Perfetto trace of the soak's
+   recorded timelines — CI uploads it as a workflow artifact.
 
 Exit 0 when every invariant holds; 1 with a diagnosis otherwise.
 stdlib-only (urllib) — the gate must not depend on host tools.
@@ -66,6 +75,9 @@ def parse_args():
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--drain-timeout-s", type=float, default=5.0)
     p.add_argument("--json-out", default=None, metavar="FILE")
+    p.add_argument("--perfetto-out", default=None, metavar="FILE",
+                   help="save the soak's per-request Perfetto trace "
+                   "(/debug/requests?format=perfetto) here")
     args = p.parse_args()
     if args.window_s is None:
         args.window_s = 6.0 if args.short else 20.0
@@ -109,6 +121,8 @@ class Soak:
         self.ok_bit_identical = 0
         self.states_seen: set = set()
         self.draining_seen = False
+        self.request_ids: set = set()  # ids carried by terminal responses
+        self.max_fast_rung_burn = 0.0  # peak SLO burn seen by the poller
 
     def record(self, outcome: str) -> None:
         with self.lock:
@@ -148,6 +162,16 @@ class Soak:
                 self.violate(f"client {cid}: non-JSON body (status {st}): "
                              f"{body[:120]}")
                 continue
+            # Tracing invariant: EVERY terminal response carries a
+            # request_id (resolved against /debug/requests later).
+            rid = doc.get("request_id")
+            if st in (200, 429, 503, 504):
+                if not rid:
+                    self.violate(f"client {cid}: status {st} response "
+                                 f"without request_id: {body[:160]}")
+                else:
+                    with self.lock:
+                        self.request_ids.add(rid)
             if st == 200:
                 expect = self.want[lo:lo + 2].tolist()
                 if doc.get("predictions") != expect:
@@ -173,10 +197,17 @@ class Soak:
             try:
                 _, body = http(self.base, "/healthz", timeout=5)
                 doc = json.loads(body)
+                burns = (doc.get("slo") or {}).get("burn_rates") or {}
+                fast = max(
+                    (v for v in (burns.get("fast_rung") or {}).values()),
+                    default=0.0,
+                )
                 with self.lock:
                     self.states_seen.add(doc.get("breaker"))
                     if doc.get("draining"):
                         self.draining_seen = True
+                    self.max_fast_rung_burn = max(
+                        self.max_fast_rung_burn, fast)
             except Exception:  # noqa: BLE001 — the server may be gone
                 if self.sigterm_sent.is_set():
                     return
@@ -232,7 +263,12 @@ def main() -> int:
         proc = subprocess.Popen(
             [sys.executable, "-m", "knn_tpu.cli", "serve", index,
              "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
-             "--drain-timeout-s", str(args.drain_timeout_s)],
+             "--drain-timeout-s", str(args.drain_timeout_s),
+             # Tracing invariants: a recorder big enough to hold EVERY
+             # soak request (so all request_ids resolve), and SLO windows
+             # short enough that burn both rises during the burst and
+             # visibly recovers within the soak.
+             "--flight-recorder-size", "16384", "--slo-windows", "5,60"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
@@ -323,6 +359,98 @@ def main() -> int:
         print(f"chaos-soak: steady probe {steady_ok}/15 ok "
               f"(availability 100%, bit-identical)")
 
+        # -- phase 3.5: SLO burn rose during the burst, recovers to ~0 -----
+        with soak.lock:
+            max_burn = soak.max_fast_rung_burn
+        if max_burn <= 0.5:
+            soak.stop.set()
+            return fail(
+                f"knn_slo_burn_rate{{objective=fast_rung}} never rose "
+                f"during the fault burst (max seen: {max_burn}) — degraded "
+                f"responses are not spending the fast-rung budget", proc)
+        final_burn = None
+        recover_deadline = time.monotonic() + 30
+        while time.monotonic() < recover_deadline:
+            try:
+                _, body = http(base, "/healthz", timeout=5)
+                burns = (json.loads(body).get("slo") or {}) \
+                    .get("burn_rates") or {}
+                final_burn = (burns.get("fast_rung") or {}).get("5s")
+                if final_burn is not None and final_burn < 0.5:
+                    break
+            except Exception:  # noqa: BLE001 — keep polling
+                pass
+            time.sleep(0.25)
+        if final_burn is None or final_burn >= 0.5:
+            soak.stop.set()
+            return fail(f"fast_rung burn rate did not recover to ~0 after "
+                        f"the breaker re-closed (5s window: {final_burn}, "
+                        f"peak {round(max_burn, 2)})", proc)
+        print(f"chaos-soak: SLO burn cycle observed (fast_rung peak "
+              f"{round(max_burn, 2)} -> {final_burn} after recovery)")
+
+        # -- phase 3.6: every request_id resolves to a consistent timeline -
+        with soak.lock:
+            seen_ids = set(soak.request_ids)
+        st, body = http(base, "/debug/requests?n=20000", timeout=30)
+        if st != 200:
+            soak.stop.set()
+            return fail(f"/debug/requests: status {st}: {body[:200]}", proc)
+        doc = json.loads(body)
+        timelines = doc.get("requests", [])
+        recorded_ids = set()
+        for tl in timelines:
+            rid = tl.get("request_id")
+            if rid in recorded_ids:
+                soak.stop.set()
+                return fail(f"request_id {rid} maps to more than one "
+                            f"flight-recorder timeline", proc)
+            recorded_ids.add(rid)
+            if tl.get("outcome") is None:
+                soak.stop.set()
+                return fail(f"unfinished timeline in /debug/requests: "
+                            f"{json.dumps(tl)[:200]}", proc)
+            open_phases = [p["phase"] for p in tl.get("phases", ())
+                           if p.get("ms") is None]
+            if open_phases:
+                soak.stop.set()
+                return fail(f"timeline {rid} has unclosed phase(s) "
+                            f"{open_phases} after its terminal outcome",
+                            proc)
+            phase_sum = sum(p["ms"] for p in tl.get("phases", ()))
+            req_ms = tl.get("request_ms") or 0.0
+            if phase_sum > req_ms * 1.05 + 2.0:
+                soak.stop.set()
+                return fail(f"timeline {rid}: phases sum {phase_sum:.2f} ms "
+                            f"exceeds request_ms {req_ms:.2f} ms", proc)
+        unresolved = seen_ids - recorded_ids
+        if unresolved:
+            soak.stop.set()
+            return fail(f"{len(unresolved)} request_id(s) carried by "
+                        f"terminal responses do not resolve in the flight "
+                        f"recorder (first: {sorted(unresolved)[:3]})", proc)
+        print(f"chaos-soak: {len(seen_ids)} request_ids all resolve to "
+              f"consistent flight-recorder timelines "
+              f"({len(timelines)} recorded)")
+
+        if args.perfetto_out:
+            st, body = http(base, "/debug/requests?format=perfetto&n=2000",
+                            timeout=30)
+            if st != 200:
+                soak.stop.set()
+                return fail(f"perfetto export: status {st}", proc)
+            ev = json.loads(body).get("traceEvents", [])
+            b = sum(1 for e in ev if e.get("ph") == "B")
+            e_ = sum(1 for e in ev if e.get("ph") == "E")
+            if b != e_:
+                soak.stop.set()
+                return fail(f"perfetto export misnested: {b} B vs {e_} E "
+                            f"events", proc)
+            Path(args.perfetto_out).parent.mkdir(parents=True, exist_ok=True)
+            Path(args.perfetto_out).write_text(body)
+            print(f"chaos-soak: per-request Perfetto trace -> "
+                  f"{args.perfetto_out} ({len(ev)} events)")
+
         # -- phase 4: SIGTERM under load — graceful drain ------------------
         t_drain0 = time.monotonic()
         sigterm_sent.set()
@@ -375,6 +503,14 @@ def main() -> int:
                 "reclosed": True,
                 "states_seen": sorted(
                     s for s in soak.states_seen if s is not None),
+            },
+            "slo": {
+                "fast_rung_burn_peak": round(max_burn, 3),
+                "fast_rung_burn_recovered": final_burn,
+            },
+            "tracing": {
+                "request_ids_resolved": len(seen_ids),
+                "timelines_recorded": len(timelines),
             },
             "steady_probe": {"ok": steady_ok, "of": 15},
             "drain": {

@@ -1,0 +1,96 @@
+"""Disabled-path overhead gate (part of `make verify`).
+
+Every observability layer grown since PR 1 — spans, metrics, and now
+request tracing / exemplars / SLO burn rates — carries the same contract:
+**zero cost when disabled** (one predicate per call site). This gate pins
+that contract two ways:
+
+1. **functional** — with nothing enabled (the default import state), a
+   full predict plus a micro-batched serving call must record ZERO spans
+   and ZERO metric instruments, and the batcher must not allocate request
+   traces. This is deterministic: an accidentally-always-on layer fails
+   here on any machine.
+2. **timing** — medium-preset predict best-of mins must stay under a
+   budget (``KNN_TPU_OVERHEAD_BUDGET_MS``, default 60 ms — a gross-
+   regression tripwire sized for noisy CI boxes; the local reference
+   box measures ≈17 ms at PR 4, and the measured value is printed so the
+   trend is visible in every CI log even when the gate passes).
+
+Exit 0 when both hold; 1 with a diagnosis otherwise. Run on CPU jax.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+BEST_OF = 5
+
+
+def fail(msg: str) -> int:
+    print(f"disabled-overhead: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("KNN_TPU_OBS", "") not in ("", "0"):
+        return fail("KNN_TPU_OBS is set; this gate measures the DISABLED "
+                    "path — unset it")
+
+    from knn_tpu import obs
+
+    if obs.enabled():
+        return fail("knn_tpu.obs is enabled at import with no KNN_TPU_OBS "
+                    "set — the disabled-by-default contract is broken")
+
+    from bench import _load_medium  # noqa: E402 — repo-root import
+    from knn_tpu.models.knn import KNNClassifier
+    from knn_tpu.serve.batcher import MicroBatcher
+
+    train, test = _load_medium()
+    model = KNNClassifier(k=5).fit(train)
+    model.predict(test)  # warm: compile + first dispatch excluded
+
+    # -- 1. functional: the disabled path records nothing ------------------
+    obs.reset()
+    model.predict(test)
+    with MicroBatcher(model, max_batch=8, max_wait_ms=0.0) as b:
+        b.predict(test.features[0], timeout=60)
+    spans = obs.tracer().spans()
+    instruments = obs.registry().instruments()
+    if spans:
+        return fail(f"{len(spans)} span(s) recorded while disabled "
+                    f"(first: {spans[0].name!r})")
+    if instruments:
+        return fail(f"{len(instruments)} metric instrument(s) created "
+                    f"while disabled (first: {instruments[0].name!r})")
+    print("disabled-overhead: functional ok (0 spans, 0 instruments, "
+          "no request traces)")
+
+    # -- 2. timing: best-of mins under the budget --------------------------
+    budget_ms = float(os.environ.get("KNN_TPU_OVERHEAD_BUDGET_MS", "60"))
+    walls = []
+    for _ in range(BEST_OF):
+        t0 = time.monotonic()
+        model.predict(test)
+        walls.append((time.monotonic() - t0) * 1e3)
+    best = min(walls)
+    print(f"disabled-overhead: medium-preset predict best-of-{BEST_OF} min "
+          f"{best:.2f} ms (budget {budget_ms:.0f} ms; all: "
+          f"{[round(w, 1) for w in walls]})")
+    if best > budget_ms:
+        return fail(f"best-of min {best:.2f} ms exceeds the "
+                    f"{budget_ms:.0f} ms budget — the disabled path "
+                    f"regressed (KNN_TPU_OVERHEAD_BUDGET_MS overrides)")
+    print("disabled-overhead: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

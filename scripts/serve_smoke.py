@@ -6,10 +6,14 @@ banner, a real ephemeral-port bind, clean exit code).
 Steps:
 1. build a fixture index with `knn_tpu save-index` (small-train.arff);
 2. boot `knn_tpu serve --port 0` and wait for the ready banner;
-3. probe /healthz (ready, NOT draining, carries index_version — the
-   self-healing fields, docs/SERVING.md), /predict (predictions match an
-   in-process model on the same rows), /kneighbors (shapes), /metrics
-   (knn_serve_* counters present);
+3. probe /healthz (ready, NOT draining, carries index_version + the SLO
+   burn-rate block — the self-healing fields, docs/SERVING.md), /predict
+   (predictions match an in-process model on the same rows, a supplied
+   x-request-id echoes on header AND body), /kneighbors (shapes),
+   /metrics (knn_serve_* counters present; the OpenMetrics exposition
+   negotiated via Accept carries trace_id exemplars and ends `# EOF`),
+   /debug/requests + /debug/slowest (the predict's request_id resolves
+   to a finished timeline with closed phases; Perfetto export balanced);
 4. rebuild the index and SIGHUP: the hot reload must swap index_version
    while the process keeps serving bit-identical predictions;
 5. SIGINT and require a clean exit within the grace period.
@@ -47,17 +51,19 @@ def fail(msg: str, proc: "subprocess.Popen | None" = None) -> "int":
     return 1
 
 
-def request(base: str, path: str, payload=None):
+def request(base: str, path: str, payload=None, headers=None):
+    hdrs = {"Content-Type": "application/json"} if payload else {}
+    hdrs.update(headers or {})
     req = urllib.request.Request(
         base + path,
         data=json.dumps(payload).encode() if payload is not None else None,
-        headers={"Content-Type": "application/json"} if payload else {},
+        headers=hdrs,
     )
     try:
         with urllib.request.urlopen(req, timeout=30) as r:
-            return r.status, r.read().decode()
+            return r.status, r.read().decode(), dict(r.headers)
     except urllib.error.HTTPError as e:
-        return e.code, e.read().decode()
+        return e.code, e.read().decode(), dict(e.headers)
 
 
 def main() -> int:
@@ -117,7 +123,7 @@ def main() -> int:
             return fail("no ready banner within the boot timeout", proc)
 
         try:
-            st, body = request(base, "/healthz")
+            st, body, _ = request(base, "/healthz")
             health = json.loads(body)
             if st != 200 or not health.get("ready"):
                 return fail(f"/healthz not ready: {st} {body}", proc)
@@ -127,9 +133,13 @@ def main() -> int:
             boot_version = health.get("index_version")
             if not boot_version:
                 return fail(f"/healthz missing index_version: {body}", proc)
+            slo = health.get("slo") or {}
+            if "burn_rates" not in slo or "fast_rung" not in slo["burn_rates"]:
+                return fail(f"/healthz missing the SLO burn-rate block: "
+                            f"{body[:300]}", proc)
             print(f"serve-smoke: /healthz ok (train_rows="
                   f"{health['train_rows']}, index_version={boot_version}, "
-                  f"draining=false)")
+                  f"draining=false, slo windows={slo.get('windows')})")
 
             from knn_tpu.data.arff import load_arff
             from knn_tpu.models.knn import KNNClassifier
@@ -139,27 +149,95 @@ def main() -> int:
             want = KNNClassifier(k=3).fit(train).predict(
                 type(test)(rows, test.labels[:8])
             ).tolist()
-            st, body = request(base, "/predict", {"instances": rows.tolist()})
-            got = json.loads(body).get("predictions")
+            rid = "smoke-trace-0001"
+            st, body, hdrs = request(base, "/predict",
+                                     {"instances": rows.tolist()},
+                                     headers={"x-request-id": rid})
+            doc = json.loads(body)
+            got = doc.get("predictions")
             if st != 200 or got != want:
                 return fail(f"/predict {st}: got {got}, want {want}", proc)
+            if doc.get("request_id") != rid or hdrs.get("x-request-id") != rid:
+                return fail(f"/predict did not echo x-request-id {rid!r}: "
+                            f"body={doc.get('request_id')!r}, "
+                            f"header={hdrs.get('x-request-id')!r}", proc)
             print(f"serve-smoke: /predict ok ({len(got)} rows, "
-                  f"bit-identical to the in-process model)")
+                  f"bit-identical to the in-process model, "
+                  f"x-request-id echoed)")
 
-            st, body = request(
+            st, body, _ = request(
                 base, "/kneighbors", {"instances": rows[:2].tolist()})
             kn = json.loads(body)
             if st != 200 or len(kn["indices"]) != 2 or len(kn["indices"][0]) != 3:
                 return fail(f"/kneighbors {st}: {body[:200]}", proc)
             print("serve-smoke: /kneighbors ok")
 
-            st, metrics = request(base, "/metrics")
+            st, metrics, _ = request(base, "/metrics")
             needed = ("knn_serve_requests_total", "knn_serve_batch_size",
-                      "knn_serve_request_ms")
+                      "knn_serve_request_ms", "knn_slo_burn_rate")
             missing = [n for n in needed if n not in metrics]
             if st != 200 or missing:
                 return fail(f"/metrics {st}: missing {missing}", proc)
-            print("serve-smoke: /metrics ok (knn_serve_* present)")
+            print("serve-smoke: /metrics ok (knn_serve_* + knn_slo_* "
+                  "present)")
+
+            # OpenMetrics negotiation: exemplars link latency buckets to
+            # request ids; the exposition must end with "# EOF".
+            st, om, hdrs = request(
+                base, "/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            if st != 200 or not om.rstrip().endswith("# EOF"):
+                return fail(f"OpenMetrics exposition missing # EOF "
+                            f"terminator ({st})", proc)
+            if "application/openmetrics-text" not in hdrs.get(
+                    "Content-Type", ""):
+                return fail(f"OpenMetrics content type wrong: "
+                            f"{hdrs.get('Content-Type')}", proc)
+            ex_lines = [ln for ln in om.splitlines()
+                        if ln.startswith("knn_serve_request_ms_bucket")
+                        and "# {" in ln and "trace_id=" in ln]
+            if not ex_lines:
+                return fail("knn_serve_request_ms OpenMetrics buckets carry "
+                            "no trace_id exemplars", proc)
+            print(f"serve-smoke: OpenMetrics ok ({len(ex_lines)} exemplar "
+                  f"bucket(s), e.g. {ex_lines[0][:90]}...)")
+
+            # Flight recorder: the predict's request_id must resolve to a
+            # finished timeline with closed phases.
+            st, body, _ = request(base, f"/debug/requests?id={rid}")
+            if st != 200:
+                return fail(f"/debug/requests?id={rid}: {st} {body[:200]}",
+                            proc)
+            tl = json.loads(body)["requests"][0]
+            if tl.get("outcome") != "ok" or tl.get("status") != 200:
+                return fail(f"timeline {rid}: outcome={tl.get('outcome')} "
+                            f"status={tl.get('status')}", proc)
+            if any(p.get("ms") is None for p in tl.get("phases", ())):
+                return fail(f"timeline {rid} has unclosed phases: "
+                            f"{tl.get('phases')}", proc)
+            st, body, _ = request(base, "/debug/slowest")
+            if st != 200 or not json.loads(body).get("requests"):
+                return fail(f"/debug/slowest empty or failed ({st})", proc)
+            st, body, _ = request(base, "/debug/requests?format=perfetto")
+            ev = json.loads(body).get("traceEvents", [])
+            b_n = sum(1 for e in ev if e.get("ph") == "B")
+            e_n = sum(1 for e in ev if e.get("ph") == "E")
+            if st != 200 or not ev or b_n != e_n:
+                return fail(f"perfetto export bad: {st}, {b_n} B vs {e_n} E",
+                            proc)
+            print(f"serve-smoke: /debug ok (timeline for {rid} resolved, "
+                  f"phases {[p['phase'] for p in tl['phases']]}, perfetto "
+                  f"{len(ev)} events)")
+
+            # Oversized x-request-id: 400, never a traceback.
+            st, body, _ = request(base, "/predict",
+                                  {"instances": rows[:1].tolist()},
+                                  headers={"x-request-id": "y" * 4096})
+            if st != 400 or "request_id" not in json.loads(body):
+                return fail(f"oversized x-request-id: want 400 with a "
+                            f"generated request_id, got {st} {body[:200]}",
+                            proc)
+            print("serve-smoke: malformed x-request-id rejected 400")
 
             # Hot reload: rebuild the index (new created_unix -> new
             # version), SIGHUP, and require the swap while serving stays
@@ -176,7 +254,7 @@ def main() -> int:
             new_version = None
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
-                st, body = request(base, "/healthz")
+                st, body, _ = request(base, "/healthz")
                 v = json.loads(body).get("index_version")
                 if st == 200 and v and v != boot_version:
                     new_version = v
@@ -185,8 +263,8 @@ def main() -> int:
             if new_version is None:
                 return fail("SIGHUP reload never swapped index_version",
                             proc)
-            st, body = request(base, "/predict",
-                               {"instances": rows.tolist()})
+            st, body, _ = request(base, "/predict",
+                                  {"instances": rows.tolist()})
             got = json.loads(body)
             if st != 200 or got.get("predictions") != want:
                 return fail(f"/predict after reload {st}: got "

@@ -291,7 +291,16 @@ class TestServeExitCodes:
         for extra in (["--max-batch", "0"], ["--max-wait-ms", "-1"],
                       ["--deadline-ms", "0"], ["--port", "99999"],
                       ["--max-batch", "64", "--max-queue-rows", "8"],
-                      ["--warmup-batches", "a,b"]):
+                      ["--warmup-batches", "a,b"],
+                      # The observability knobs keep the same contract.
+                      ["--flight-recorder-size", "-1"],
+                      ["--slowest-k", "-1"],
+                      ["--slo-availability-target", "1.5"],
+                      ["--slo-latency-target", "0"],
+                      ["--slo-fast-rung-target", "-0.1"],
+                      ["--slo-latency-ms", "0"],
+                      ["--slo-windows", "5,x"],
+                      ["--slo-windows", "0"]):
             assert run(["serve", "/irrelevant/index", *extra]) == 2, extra
             assert "error:" in self._err(capsys)
 

@@ -1,0 +1,764 @@
+"""Request-tracing contract tests (docs/OBSERVABILITY.md §Request tracing).
+
+The load-bearing claims:
+
+- **propagation** — under concurrent mixed predict/kneighbors load, every
+  response's request_id maps to exactly ONE flight-recorder timeline whose
+  phase durations are all closed and sum to within tolerance of its
+  ``request_ms`` — including requests that degraded rungs under fault
+  injection and requests that expired mid-flight;
+- **the recorder is bounded** — a ring of the last N plus a slowest-K
+  reservoir, with a Perfetto export whose B/E events always match;
+- **exemplars** — the OpenMetrics exposition links histogram buckets to
+  trace ids, while the plain Prometheus exposition stays byte-compatible
+  (no exemplar syntax leaks into the 0.0.4 format);
+- **SLO burn rates** — the multi-window burn math, its ring rotation, and
+  the ``knn_slo_*`` gauge export;
+- **the HTTP weave** — ``x-request-id`` echo on every response (errors
+  included), malformed ids rejected 400, ``/debug`` endpoints, the
+  access log, the ``/healthz`` SLO block.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.obs.metrics import MetricsRegistry
+from knn_tpu.obs.reqtrace import (
+    MAX_REQUEST_ID_LEN,
+    FlightRecorder,
+    RequestTrace,
+    activate,
+    emit,
+    gen_request_id,
+    valid_request_id,
+)
+from knn_tpu.obs.slo import SLOTracker, window_label
+from knn_tpu.resilience import faults
+from knn_tpu.resilience.errors import DeadlineExceededError, OverloadError
+from knn_tpu.serve.batcher import MicroBatcher
+
+
+@pytest.fixture
+def obs_on():
+    """Enabled + isolated observability for metric assertions."""
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+def _problem(rng, n=300, q=40, d=5, c=5):
+    train_x = rng.integers(0, 4, (n, d)).astype(np.float32)
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.integers(0, 4, (q - q // 2, d)).astype(np.float32)]
+    )
+    return (Dataset(train_x, train_y),
+            Dataset(test_x, np.zeros(len(test_x), np.int32)))
+
+
+# ---------------------------------------------------------------------------
+# RequestTrace + FlightRecorder units
+
+
+class TestRequestIds:
+    def test_generated_ids_are_valid_and_distinct(self):
+        ids = {gen_request_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(valid_request_id(i) for i in ids)
+
+    @pytest.mark.parametrize("bad", [
+        "", "x" * (MAX_REQUEST_ID_LEN + 1), "has space", "tab\tchar",
+        "new\nline", "unicode-é", "ctrl\x01",
+    ])
+    def test_invalid_ids_rejected(self, bad):
+        assert not valid_request_id(bad)
+
+    def test_boundary_ok(self):
+        assert valid_request_id("x" * MAX_REQUEST_ID_LEN)
+        assert valid_request_id("a-b_c.d/e:f")
+
+
+class TestRequestTrace:
+    def test_phases_close_and_sum(self):
+        t = RequestTrace("predict", 1)
+        t.phase_start("queue_wait")
+        t.phase_end("queue_wait")
+        t.phase_start("dispatch")
+        t.phase_end("dispatch")
+        t.finish("ok")
+        d = t.to_dict()
+        assert [p["phase"] for p in d["phases"]] == ["queue_wait", "dispatch"]
+        assert all(p["ms"] is not None for p in d["phases"])
+        assert sum(p["ms"] for p in d["phases"]) <= d["request_ms"] + 0.001
+
+    def test_finish_closes_open_phases_and_is_idempotent(self):
+        t = RequestTrace("predict", 1)
+        t.phase_start("queue_wait")
+        t.finish("expired")
+        t.finish("ok")  # second outcome must NOT win
+        d = t.to_dict()
+        assert d["outcome"] == "expired"
+        assert d["phases"][0]["ms"] is not None
+        first_ms = d["request_ms"]
+        t.finish("error")
+        assert t.to_dict()["request_ms"] == first_ms
+
+    def test_annotations_visible_after_finish(self):
+        rec = FlightRecorder(capacity=2)
+        t = rec.new_trace("predict", 1, request_id="late-note")
+        t.finish("ok")
+        t.annotate(status=200)  # the handler stamps AFTER the worker
+        assert rec.find("late-note")["status"] == 200
+
+    def test_to_dict_is_a_snapshot(self):
+        t = RequestTrace("predict", 1)
+        t.phase_start("queue_wait")
+        t.finish("ok")
+        d = t.to_dict()
+        d["phases"][0]["ms"] = -1
+        d["outcome"] = "tampered"
+        assert t.to_dict()["phases"][0]["ms"] != -1
+        assert t.to_dict()["outcome"] == "ok"
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_newest_n(self):
+        rec = FlightRecorder(capacity=4, slowest_k=0)
+        for i in range(10):
+            rec.new_trace("predict", 1, request_id=f"r{i}").finish("ok")
+        recent = rec.recent()
+        assert [tl["request_id"] for tl in recent] == ["r9", "r8", "r7", "r6"]
+        assert rec.stats()["completed"] == 10
+        assert rec.recent(2) == recent[:2]
+
+    def test_slowest_reservoir(self):
+        # Drive the reservoir with deterministic walls: finish() computes
+        # request_ms from the wall clock, so build finished traces by hand
+        # and record() them with explicit latencies.
+        rec = FlightRecorder(capacity=2, slowest_k=3)
+        for i, ms in enumerate([5.0, 50.0, 1.0, 30.0, 2.0, 40.0]):
+            t = RequestTrace("predict", 1, request_id=f"s{i}", recorder=None)
+            t.outcome = "ok"
+            t.request_ms = ms
+            rec.record(t)
+        slowest = [tl["request_id"] for tl in rec.slowest()]
+        assert slowest == ["s1", "s5", "s3"]  # 50, 40, 30 — slowest first
+        # Ring evicted s0..s3, but the reservoir still resolves s1.
+        assert rec.find("s1")["request_ms"] == 50.0
+
+    def test_find_missing(self):
+        assert FlightRecorder(capacity=2).find("nope") is None
+
+    def test_perfetto_export_balanced(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(3):
+            t = rec.new_trace("predict", 1, request_id=f"p{i}")
+            t.phase_start("queue_wait")
+            t.phase_end("queue_wait")
+            t.phase_start("dispatch")
+            t.attempt("fast", False, 0.5, error="DeviceError")
+            t.attempt("xla", True, 0.4)
+            t.event("fallback", from_rung="fast", to="xla")
+            t.finish("ok")
+        doc = rec.to_chrome_trace()
+        ev = doc["traceEvents"]
+        assert sum(1 for e in ev if e["ph"] == "B") == \
+            sum(1 for e in ev if e["ph"] == "E")
+        names = {e["name"] for e in ev}
+        assert {"queue_wait", "dispatch", "attempt:fast", "attempt:xla",
+                "fallback", "thread_name"} <= names
+        # One track per request.
+        tids = {e["tid"] for e in ev if e["ph"] == "M"}
+        assert len(tids) == 3
+
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError, match="capacity"):
+            FlightRecorder(capacity=0)
+        with pytest.raises(ValueError, match="slowest_k"):
+            FlightRecorder(capacity=1, slowest_k=-1)
+
+
+class TestActiveContext:
+    def test_emit_is_noop_when_unarmed(self):
+        emit("nothing", x=1)  # must not raise, must not allocate traces
+
+    def test_emit_lands_in_all_armed_traces(self):
+        a, b = RequestTrace("predict", 1), RequestTrace("kneighbors", 1)
+        with activate([a, b]):
+            emit("breaker.transition", to_state="open")
+        emit("after", x=1)  # disarmed again
+        for t in (a, b):
+            evs = t.to_dict()["events"]
+            assert [e["event"] for e in evs] == ["breaker.transition"]
+
+    def test_in_place_list_update_reflects(self):
+        a, b = RequestTrace("predict", 1), RequestTrace("predict", 1)
+        armed = [a, b]
+        with activate(armed):
+            armed[:] = [a]  # b expired mid-fallback
+            emit("fallback", to="oracle")
+        assert len(a.to_dict()["events"]) == 1
+        assert len(b.to_dict()["events"]) == 0
+
+    def test_nesting_restores(self):
+        a, b = RequestTrace("predict", 1), RequestTrace("predict", 1)
+        with activate([a]):
+            with activate([b]):
+                emit("inner")
+            emit("outer")
+        assert [e["event"] for e in a.to_dict()["events"]] == ["outer"]
+        assert [e["event"] for e in b.to_dict()["events"]] == ["inner"]
+
+
+# ---------------------------------------------------------------------------
+# Exemplars + OpenMetrics exposition
+
+
+class TestExemplars:
+    def test_last_exemplar_wins_per_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0, 10.0))
+        h.observe(0.5, exemplar={"trace_id": "first"})
+        h.observe(0.7, exemplar={"trace_id": "second"})
+        h.observe(5.0)  # no exemplar: bucket 1 stays empty
+        ex = h.exemplars()
+        assert ex[0][0] == (("trace_id", "second"),)
+        assert ex[1] is None
+
+    def test_openmetrics_format(self):
+        reg = MetricsRegistry()
+        reg.counter("reqs_total", help="requests").add(3)
+        reg.gauge("temp").set(1.5)
+        h = reg.histogram("lat_ms", buckets=(1.0,))
+        h.observe(0.5, exemplar={"trace_id": "t1"})
+        om = reg.to_openmetrics()
+        lines = om.splitlines()
+        assert lines[-1] == "# EOF"
+        # Counter FAMILY drops _total; the sample keeps it.
+        assert "# TYPE reqs counter" in lines
+        assert any(ln.startswith("reqs_total 3") for ln in lines)
+        ex_line = next(ln for ln in lines if "# {" in ln)
+        assert ex_line.startswith('lat_ms_bucket{le="1"} 1 # '
+                                  '{trace_id="t1"} 0.5 ')
+
+    def test_prometheus_exposition_unchanged(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0,))
+        h.observe(0.5, exemplar={"trace_id": "t1"})
+        prom = reg.to_prometheus()
+        assert "# {" not in prom  # exemplar syntax must not leak into 0.0.4
+        assert 'lat_ms_bucket{le="1"} 1' in prom
+
+    def test_helper_routes_exemplar(self, obs_on):
+        obs.histogram_observe("x_ms", 0.5, buckets=(1.0,),
+                              exemplar={"trace_id": "via-helper"})
+        assert 'trace_id="via-helper"' in obs_on.to_openmetrics()
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+
+
+class TestSLOTracker:
+    def test_burn_math(self):
+        s = SLOTracker(availability_target=0.99, latency_target_ms=10.0,
+                       latency_target=0.9, fast_rung_target=0.9,
+                       windows_s=(60,))
+        for _ in range(9):
+            s.record(True, 1.0)
+        s.record(False, 1.0)  # 10% bad availability, budget 1% -> burn 10
+        burns = s.burn_rates()
+        assert burns["availability"]["1m"] == pytest.approx(10.0)
+        # latency: 9 good of 10 -> 10% bad over a 10% budget -> burn 1.
+        assert burns["latency"]["1m"] == pytest.approx(1.0)
+        assert burns["fast_rung"]["1m"] == pytest.approx(1.0)
+
+    def test_degraded_spends_fast_rung_budget_only(self):
+        s = SLOTracker(fast_rung_target=0.5, windows_s=(60,))
+        s.record(True, 1.0, degraded=True)
+        s.record(True, 1.0, degraded=False)
+        burns = s.burn_rates()
+        assert burns["availability"]["1m"] == 0.0
+        assert burns["fast_rung"]["1m"] == pytest.approx(1.0)  # 50%/50%
+
+    def test_no_traffic_no_burn(self):
+        s = SLOTracker(windows_s=(5,))
+        assert s.burn_rates()["availability"]["5s"] == 0.0
+
+    def test_ring_rotation_expires_old_outcomes(self, monkeypatch):
+        import knn_tpu.obs.slo as slo_mod
+
+        clock = [1000.0]
+        monkeypatch.setattr(slo_mod.time, "monotonic", lambda: clock[0])
+        s = SLOTracker(windows_s=(2, 5))
+        s.record(False, 1.0)
+        assert s.burn_rates()["availability"]["2s"] > 0
+        clock[0] += 3  # past the 2 s window, inside the 5 s one
+        burns = s.burn_rates()
+        assert burns["availability"]["2s"] == 0.0
+        assert burns["availability"]["5s"] > 0
+        clock[0] += 10  # past both
+        assert s.burn_rates()["availability"]["5s"] == 0.0
+
+    def test_slot_reuse_resets_stale_counts(self, monkeypatch):
+        import knn_tpu.obs.slo as slo_mod
+
+        clock = [0.0]
+        monkeypatch.setattr(slo_mod.time, "monotonic", lambda: clock[0])
+        s = SLOTracker(windows_s=(2,))
+        s.record(False, 1.0)
+        clock[0] += 2  # ring size 2: same slot index, new second
+        s.record(True, 1.0)
+        total, ok, _, _ = s.window_counts(2)
+        assert (total, ok) == (1, 1)  # the stale failure was reset
+
+    def test_long_windows_get_coarse_slots_bounded_ring(self):
+        # A 30-day window must not allocate 2.6M per-second slots: the
+        # ring is bounded at ~3600 slots via coarser slot widths.
+        month = 30 * 24 * 3600
+        s = SLOTracker(windows_s=(3600, month))
+        assert len(s._ring) <= 3600
+        assert s.slot_s == -(-month // 3600)
+        s.record(False, 1.0)
+        assert s.burn_rates()["availability"]["720h"] > 0
+        # Default windows keep per-second resolution.
+        assert SLOTracker().slot_s == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="availability_target"):
+            SLOTracker(availability_target=1.0)
+        with pytest.raises(ValueError, match="latency_target_ms"):
+            SLOTracker(latency_target_ms=0)
+        with pytest.raises(ValueError, match="windows_s"):
+            SLOTracker(windows_s=())
+
+    def test_export_sets_gauges(self, obs_on):
+        s = SLOTracker(windows_s=(300, 3600))
+        s.record(True, 1.0)
+        out = s.export()
+        assert out["windows"] == ["5m", "1h"]
+        prom = obs_on.to_prometheus()
+        assert 'knn_slo_burn_rate{objective="availability",window="5m"}' \
+            in prom
+        assert 'knn_slo_target{objective="fast_rung"}' in prom
+
+    def test_window_labels(self):
+        assert window_label(300) == "5m"
+        assert window_label(3600) == "1h"
+        assert window_label(5) == "5s"
+
+
+# ---------------------------------------------------------------------------
+# Trace-context propagation through the batcher under concurrent load
+# (the satellite: N threads x mixed kinds, every request_id -> exactly one
+# timeline whose phases sum to ~request_ms, degraded + expired included)
+
+
+class TestBatcherTracePropagation:
+    TOLERANCE_NOTE = "phases are contiguous: queue_wait + dispatch ~ total"
+
+    def _check_timeline(self, tl):
+        assert tl["outcome"] is not None
+        open_phases = [p for p in tl["phases"] if p["ms"] is None]
+        assert not open_phases, (tl["request_id"], open_phases)
+        phase_sum = sum(p["ms"] for p in tl["phases"])
+        # Contiguity tolerance: scheduling gaps between enqueue->pickup->
+        # terminal are what's NOT covered; they must stay small relative
+        # to the request (2 ms absolute floor for coarse CI clocks).
+        assert phase_sum <= tl["request_ms"] * 1.05 + 2.0, tl
+        if tl["outcome"] == "ok":
+            assert tl["rung"] is not None
+            assert tl["phases"][-1]["phase"] == "dispatch"
+
+    def test_concurrent_mixed_load_every_id_resolves(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.predict(test)  # warm: traced walls exclude compile
+        rec = FlightRecorder(capacity=4096, slowest_k=8)
+        results = {}
+        lock = threading.Lock()
+
+        def client(cid):
+            mine = {}
+            for i in range(12):
+                kind = "predict" if (cid + i) % 2 == 0 else "kneighbors"
+                lo = (cid * 12 + i) % (test.num_instances - 3)
+                rows = test.features[lo:lo + 1 + (i % 3)]
+                trace = rec.new_trace(kind, rows.shape[0])
+                try:
+                    h = batcher.submit(rows, kind, trace=trace)
+                    h.result(timeout=60)
+                    mine[trace.request_id] = "ok"
+                except Exception as e:  # noqa: BLE001 — recorded
+                    mine[trace.request_id] = type(e).__name__
+            with lock:
+                results.update(mine)
+
+        with MicroBatcher(model, max_batch=8, max_wait_ms=1.0,
+                          recorder=rec) as batcher:
+            # A short seeded fault burst: early dispatches degrade to the
+            # xla... -> oracle rungs, so the propagation proof covers
+            # degraded requests, not just clean ones.
+            with faults.inject("serve.dispatch=3:device", seed=11):
+                threads = [threading.Thread(target=client, args=(c,))
+                           for c in range(6)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+        assert len(results) == 6 * 12
+        timelines = {tl["request_id"]: tl for tl in rec.recent()}
+        assert len(timelines) == len(results), "duplicate or dropped ids"
+        degraded = 0
+        for rid, outcome in results.items():
+            tl = timelines[rid]
+            self._check_timeline(tl)
+            if outcome == "ok":
+                assert tl["outcome"] == "ok"
+                if tl["rung"] != "fast" or any(
+                        not a["ok"] for a in tl["attempts"]):
+                    degraded += 1
+        assert degraded > 0, "the fault burst never degraded a request"
+
+    def test_expired_requests_own_consistent_timelines(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        rec = FlightRecorder(capacity=64)
+        # A coalescing window far past the deadline: expiry in queue is
+        # deterministic.
+        with MicroBatcher(model, max_batch=64, max_wait_ms=2000.0,
+                          recorder=rec) as batcher:
+            h = batcher.submit(test.features[:1], "predict", deadline_ms=20)
+            with pytest.raises(DeadlineExceededError):
+                h.result(timeout=30)
+            rid = h.meta["request_id"]
+            deadline = __import__("time").monotonic() + 10
+            while rec.find(rid) is None and \
+                    __import__("time").monotonic() < deadline:
+                __import__("time").sleep(0.01)
+        tl = rec.find(rid)
+        assert tl is not None and tl["outcome"] == "expired"
+        self._check_timeline(tl)
+        assert tl["expired_where"] == "queue"
+
+    def test_expired_mid_fallback_timeline(self, rng, obs_on, monkeypatch):
+        """A deadline that passes WHILE a higher rung is failing: the 504's
+        timeline must show the failed attempt, name the expiry site, and
+        still sum consistently; the deadline-free batchmate's timeline
+        records the whole ladder walk down to the rung that answered."""
+        import time as _time
+
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+
+        def slow_boom(ds):
+            _time.sleep(0.4)
+            from knn_tpu.resilience.errors import DeviceError
+            raise DeviceError("slowly dying device")
+
+        rec = FlightRecorder(capacity=16)
+        b = MicroBatcher(model, max_batch=64, max_wait_ms=50.0, recorder=rec)
+        try:
+            monkeypatch.setattr(model, "kneighbors", slow_boom)
+            ha = b.submit(test.features[0], deadline_ms=200)
+            hb = b.submit(test.features[1])
+            with pytest.raises(DeadlineExceededError, match="degradation"):
+                ha.result(timeout=60)
+            hb.result(timeout=60)
+        finally:
+            monkeypatch.undo()
+            b.close()
+        expired = rec.find(ha.meta["request_id"])
+        assert expired["outcome"] == "expired"
+        assert expired["expired_where"] == "mid-fallback"
+        assert [a["ok"] for a in expired["attempts"]] == [False]
+        assert expired["attempts"][0]["rung"] == "fast"
+        self._check_timeline(expired)
+        survivor = rec.find(hb.meta["request_id"])
+        assert survivor["outcome"] == "ok" and survivor["rung"] == "oracle"
+        assert [a["rung"] for a in survivor["attempts"]] == \
+            ["fast", "oracle"]
+        assert any(e["event"] == "fallback" for e in survivor["events"])
+        self._check_timeline(survivor)
+
+    def test_rejected_submission_resolves_too(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        rec = FlightRecorder(capacity=8)
+        with MicroBatcher(model, max_batch=2, max_queue_rows=2,
+                          max_wait_ms=2000.0, recorder=rec) as batcher:
+            # One row parks in the 2 s coalescing window; two more rows on
+            # top exceed the queue bound deterministically.
+            parked = batcher.submit(test.features[:1], "predict")
+            with pytest.raises(OverloadError):
+                batcher.submit(test.features[1:3], "predict")
+            parked.result(timeout=30)
+        rejected = [tl for tl in rec.recent() if tl["outcome"] == "rejected"]
+        assert len(rejected) == 1
+        assert "OverloadError" in rejected[0]["error"]
+
+    def test_meta_carries_request_id(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        rec = FlightRecorder(capacity=8)
+        with MicroBatcher(model, max_batch=4, max_wait_ms=0.5,
+                          recorder=rec) as batcher:
+            h = batcher.submit(test.features[:1], "predict")
+            h.result(timeout=60)
+        assert rec.find(h.meta["request_id"])["outcome"] == "ok"
+
+    def test_no_recorder_means_no_traces(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        with MicroBatcher(model, max_batch=4, max_wait_ms=0.5) as batcher:
+            h = batcher.submit(test.features[:1], "predict")
+            h.result(timeout=60)
+        assert "request_id" not in h.meta
+
+
+# ---------------------------------------------------------------------------
+# The HTTP weave
+
+
+def _get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+def _post(base, path, payload, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+@pytest.fixture
+def served(rng, obs_on, tmp_path):
+    """A warmed in-process server with tracing + access log on."""
+    from knn_tpu.obs.slo import SLOTracker
+    from knn_tpu.serve.server import ServeApp, make_server
+
+    train, test = _problem(rng)
+    model = KNNClassifier(k=3, engine="xla").fit(train)
+    log_path = tmp_path / "access.log"
+    app = ServeApp(model, max_batch=16, max_wait_ms=1.0,
+                   access_log=str(log_path),
+                   slo=SLOTracker(windows_s=(5, 60)))
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    app.warm((1, 4))
+    try:
+        yield f"http://{host}:{port}", model, test, app, log_path
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close()
+        thread.join(timeout=10)
+
+
+class TestServerRequestIds:
+    def test_request_id_echoed_on_success(self, served):
+        base, _, test, _, _ = served
+        st, hdrs, body = _post(base, "/predict",
+                               {"instances": test.features[:2].tolist()},
+                               headers={"x-request-id": "caller-1"})
+        assert st == 200
+        assert hdrs.get("x-request-id") == "caller-1"
+        assert body["request_id"] == "caller-1"
+
+    def test_request_id_generated_when_absent(self, served):
+        base, _, test, _, _ = served
+        st, hdrs, body = _post(base, "/predict",
+                               {"instances": test.features[:1].tolist()})
+        assert st == 200
+        assert valid_request_id(body["request_id"])
+        assert hdrs.get("x-request-id") == body["request_id"]
+
+    def test_request_id_on_error_bodies(self, served):
+        base, _, test, _, _ = served
+        # 400 bad body
+        st, hdrs, body = _post(base, "/predict", {"rows": [[1.0]]},
+                               headers={"x-request-id": "err-1"})
+        assert st == 400 and body["request_id"] == "err-1"
+        assert hdrs.get("x-request-id") == "err-1"
+        # 404 unknown endpoint
+        st, hdrs, body = _post(base, "/train", {"instances": []},
+                               headers={"x-request-id": "err-2"})
+        assert st == 404 and body["request_id"] == "err-2"
+        # 404 on GET too
+        st, hdrs, raw = _get(base, "/nope", headers={"x-request-id": "err-3"})
+        assert st == 404 and json.loads(raw)["request_id"] == "err-3"
+
+    @pytest.mark.parametrize("bad", ["x" * 4096, "has spaces here"])
+    def test_malformed_header_is_400_not_traceback(self, served, bad):
+        base, _, test, _, _ = served
+        st, hdrs, body = _post(base, "/predict",
+                               {"instances": test.features[:1].tolist()},
+                               headers={"x-request-id": bad})
+        assert st == 400
+        assert "invalid x-request-id" in body["error"]
+        # A fresh id is generated so even the rejection is traceable.
+        assert valid_request_id(body["request_id"])
+
+
+class TestDebugEndpoints:
+    def test_resolve_and_slowest(self, served):
+        base, _, test, _, _ = served
+        _post(base, "/predict", {"instances": test.features[:2].tolist()},
+              headers={"x-request-id": "dbg-1"})
+        st, _, raw = _get(base, "/debug/requests?id=dbg-1")
+        assert st == 200
+        tl = json.loads(raw)["requests"][0]
+        assert tl["outcome"] == "ok" and tl["status"] == 200
+        assert {"queue_wait", "dispatch"} == \
+            {p["phase"] for p in tl["phases"]}
+        st, _, raw = _get(base, "/debug/slowest")
+        assert st == 200 and json.loads(raw)["requests"]
+
+    def test_unknown_id_404_and_bad_params_400(self, served):
+        base = served[0]
+        assert _get(base, "/debug/requests?id=missing")[0] == 404
+        assert _get(base, "/debug/requests?format=xml")[0] == 400
+        assert _get(base, "/debug/requests?n=zap")[0] == 400
+
+    def test_perfetto_export(self, served):
+        base, _, test, _, _ = served
+        _post(base, "/predict", {"instances": test.features[:1].tolist()})
+        st, _, raw = _get(base, "/debug/requests?format=perfetto")
+        doc = json.loads(raw)
+        ev = doc["traceEvents"]
+        assert st == 200 and ev
+        assert sum(1 for e in ev if e["ph"] == "B") == \
+            sum(1 for e in ev if e["ph"] == "E")
+
+    def test_disabled_recorder_is_404(self, rng, obs_on):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, _ = _problem(rng)
+        app = ServeApp(KNNClassifier(k=3, engine="xla").fit(train),
+                       flight_recorder_size=0)
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            st, _, raw = _get(f"http://{host}:{port}", "/debug/requests")
+            assert st == 404 and "disabled" in json.loads(raw)["error"]
+            assert app.batcher.recorder is None
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+
+
+class TestServerSLOAndAccessLog:
+    def test_healthz_carries_slo_block(self, served):
+        base, _, test, _, _ = served
+        _post(base, "/predict", {"instances": test.features[:1].tolist()})
+        st, _, raw = _get(base, "/healthz")
+        h = json.loads(raw)
+        assert st == 200
+        burns = h["slo"]["burn_rates"]
+        assert set(burns) == {"availability", "latency", "fast_rung"}
+        assert burns["availability"]["5s"] == 0.0  # all-200 traffic
+        assert h["flight_recorder"]["completed"] >= 1
+
+    def test_openmetrics_negotiation_with_exemplars(self, served):
+        base, _, test, _, _ = served
+        _post(base, "/predict", {"instances": test.features[:1].tolist()},
+              headers={"x-request-id": "ex-1"})
+        st, hdrs, raw = _get(base, "/metrics",
+                             headers={"Accept":
+                                      "application/openmetrics-text"})
+        assert st == 200
+        assert "application/openmetrics-text" in hdrs["Content-Type"]
+        assert raw.rstrip().endswith("# EOF")
+        assert 'trace_id="ex-1"' in raw
+        # Default scrape stays plain Prometheus, exemplar-free.
+        st, hdrs, raw = _get(base, "/metrics")
+        assert "text/plain" in hdrs["Content-Type"] and "# {" not in raw
+
+    def test_access_log_one_line_per_terminal_outcome(self, served):
+        base, _, test, app, log_path = served
+        _post(base, "/predict", {"instances": test.features[:2].tolist()},
+              headers={"x-request-id": "log-ok"})
+        _post(base, "/predict", {"rows": "bad"},
+              headers={"x-request-id": "log-bad"})
+        app.access_log._file.flush()
+        entries = [json.loads(ln) for ln in
+                   log_path.read_text().splitlines()]
+        by_id = {e["request_id"]: e for e in entries}
+        ok = by_id["log-ok"]
+        assert (ok["status"], ok["outcome"], ok["kind"], ok["rows"]) == \
+            (200, "ok", "predict", 2)
+        assert ok["rung"] == "fast" and "queue_wait" in ok["phases"]
+        bad = by_id["log-bad"]
+        assert (bad["status"], bad["outcome"]) == (400, "invalid")
+
+    def test_rejection_spends_availability_budget(self, rng, obs_on):
+        from knn_tpu.obs.slo import SLOTracker
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, test = _problem(rng)
+        # A coalescing window far longer than the test: the parked 1-row
+        # request holds the queue open however loaded the box is (a 2 s
+        # window flaked under full-suite load); close() in the teardown
+        # gives it a typed outcome, so the park thread always exits.
+        app = ServeApp(KNNClassifier(k=3, engine="xla").fit(train),
+                       max_batch=2, max_queue_rows=2, max_wait_ms=60000.0,
+                       slo=SLOTracker(windows_s=(60,)))
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+        t = None
+        try:
+            app.warm((1,))
+
+            def park():
+                _post(base, "/predict",
+                      {"instances": test.features[:1].tolist()})
+
+            t = threading.Thread(target=park, daemon=True)
+            t.start()
+            import time as _time
+            deadline = _time.monotonic() + 30
+            st = None
+            while _time.monotonic() < deadline:
+                st, _, body = _post(
+                    base, "/predict",
+                    {"instances": test.features[1:3].tolist()})
+                if st == 429:
+                    break
+                _time.sleep(0.01)
+            assert st == 429
+            assert app.slo.burn_rates()["availability"]["1m"] > 0
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+            if t is not None:
+                t.join(timeout=30)
