@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak bench parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -98,6 +98,18 @@ chaos-soak:
 
 bench:
 	python3 bench.py
+
+# The perf-regression gate (docs/OBSERVABILITY.md §Device & fleet):
+# measure the CPU-runnable gate record (bench.bench_gate_config — medium
+# predict/kneighbors walls, serving c8 p50, ingest) and compare it
+# against this environment's committed baseline with the best-of-mins +
+# MAD-tolerance rule (knn_tpu/obs/regress.py). No baseline for this
+# environment -> unarmed pass with a candidate record saved; refresh a
+# baseline with `python3 scripts/bench_gate.py --write-baseline`. The
+# verdict JSON lands in build/ (CI uploads it as a workflow artifact).
+bench-gate:
+	JAX_PLATFORMS=cpu python3 scripts/bench_gate.py \
+		--out build/bench_gate_verdict.json
 
 parity:
 	python3 scripts/parity_report.py

@@ -86,6 +86,8 @@ def load_large():
 # methodology for bench.py and every scripts/tune_* sweep); the private
 # aliases keep this file's call sites and historical probe scripts stable.
 from knn_tpu.obs.bench_timing import (  # noqa: E402
+    PEAK_TF_BF16,
+    PEAK_TF_F32,
     drop_superroofline as _drop_superroofline,
     interleaved_slope_trials as _interleaved_slope_trials,
     median as _median,
@@ -198,11 +200,17 @@ def bench_mnist():
          "matmul": (step_matmul, sbufs),
          "matmul_f32": (step_matmul_f32, sbufs)}, R_LO, R_HI,
     )
-    # The flop count per step bounds every case identically; trials whose
-    # implied rate beats the chip peak are stall artifacts — drop them
-    # before taking medians or the record can carry impossible numbers.
+    # The flop count per step bounds every case identically, but the PEAK
+    # depends on the case's operand dtype: filtering an f32 trial against
+    # the bf16 peak admits slopes that are physically impossible for f32
+    # (ADVICE r5 #3) — so each case is filtered against its own roofline
+    # before medians, or the record can carry impossible numbers.
+    case_peak = {"f32": PEAK_TF_F32, "matmul_f32": PEAK_TF_F32,
+                 "bf16": PEAK_TF_BF16, "matmul": PEAK_TF_BF16}
     for name in slopes:
-        slopes[name] = _drop_superroofline(slopes[name], 2 * q * n * d)
+        slopes[name] = _drop_superroofline(
+            slopes[name], 2 * q * n * d, peak_tf=case_peak[name]
+        )
     per_step, bf16_step = _median(slopes["f32"]), _median(slopes["bf16"])
     mm_step = _median(slopes["matmul"])
     mm32_step = _median(slopes["matmul_f32"])
@@ -1100,6 +1108,133 @@ def bench_serving():
     return record
 
 
+def bench_gate_config(serving_trials=3, predict_reps=7):
+    """The perf-regression gate's record (`make bench-gate`,
+    scripts/bench_gate.py): a minutes-scale, CPU-runnable subset of the
+    bench surface whose every metric is a TRIAL LIST, so obs/regress.py
+    can apply the best-of-mins + MAD-tolerance rule. Three layers, one
+    metric each:
+
+    - ``predict_wall_ms``  — medium-preset warm predict wall (the kernel +
+      dispatch path the disabled-overhead gate also watches);
+    - ``kneighbors_wall_ms`` — the retrieval API wall (what serving
+      dispatches ride);
+    - ``serve_c8_p50_ms``  — micro-batched closed-loop p50 at c=8 (the
+      serving hot path), one p50 per repeat so batching-policy regressions
+      gate too;
+    - ``ingest_ms``        — the ARFF parse (native parser when built,
+      labeled which).
+
+    NOT the full bench: the device-bound configs (mnist/xl/xxl) need the
+    real chip and hours; this gate is the tripwire that runs everywhere.
+    """
+    import threading
+
+    from knn_tpu.data import pyarff
+    from knn_tpu.models.knn import KNNClassifier
+    from knn_tpu.serve.batcher import MicroBatcher
+
+    train, test = _load_medium()
+    model = KNNClassifier(k=K, engine="auto").fit(train)
+    model.predict(test)  # warm: compile + device cache
+    predict_trials = []
+    for _ in range(predict_reps):
+        t0 = time.monotonic()
+        model.predict(test)
+        predict_trials.append(round((time.monotonic() - t0) * 1e3, 3))
+    log(f"gate predict: best {min(predict_trials)} ms of {predict_trials}")
+
+    model.kneighbors(test)  # warm the retrieval executable
+    kn_trials = []
+    for _ in range(predict_reps):
+        t0 = time.monotonic()
+        model.kneighbors(test)
+        kn_trials.append(round((time.monotonic() - t0) * 1e3, 3))
+    log(f"gate kneighbors: best {min(kn_trials)} ms")
+
+    # Obs stays in whatever state the caller left it: the gate compares
+    # gate-to-gate records, so baseline and fresh measure the same
+    # (default: uninstrumented) path.
+    serve_trials = []
+    reqs, conc = 15, 8
+    for _ in range(serving_trials):
+        lats = []
+        lock = threading.Lock()
+        batcher = MicroBatcher(model, max_batch=64, max_wait_ms=2.0)
+        try:
+            batcher.predict(test.features[0], timeout=120)  # warm the path
+
+            def client(cid):
+                mine = []
+                for i in range(reqs):
+                    row = test.features[(cid * reqs + i) % test.num_instances]
+                    t0 = time.monotonic()
+                    try:
+                        batcher.predict(row, timeout=120)
+                    except Exception:  # noqa: BLE001 — gate is best-effort
+                        continue
+                    mine.append((time.monotonic() - t0) * 1e3)
+                with lock:
+                    lats.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            batcher.close()
+        if lats:
+            serve_trials.append(round(float(np.percentile(lats, 50)), 3))
+    log(f"gate serving c8 p50: {serve_trials} ms")
+
+    d = Path(__file__).parent / "build" / "fixtures"
+    ref = Path("/root/reference/datasets")
+    train_path = str((ref if ref.exists() else d) / "medium-train.arff")
+    try:
+        from knn_tpu.native import arff_native
+
+        parse, parser = (lambda: arff_native.parse(train_path)), "native"
+    except (ImportError, OSError):
+        parse = lambda: pyarff.parse_arff_file(train_path)  # noqa: E731
+        parser = "python"
+    parse()  # warm the page cache
+    ingest_trials = []
+    for _ in range(predict_reps):
+        t0 = time.monotonic()
+        parse()
+        ingest_trials.append(round((time.monotonic() - t0) * 1e3, 3))
+    log(f"gate ingest[{parser}]: best {min(ingest_trials)} ms")
+
+    import os
+
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "metric": "bench_gate",
+        "value": round(min(predict_trials), 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "env": {
+            "platform": jax.default_backend(),
+            "device_kind": dev.device_kind,
+            "cpus": os.cpu_count(),
+        },
+        "metrics": {
+            "predict_wall_ms": {"trials": predict_trials,
+                                "direction": "lower", "unit": "ms"},
+            "kneighbors_wall_ms": {"trials": kn_trials,
+                                   "direction": "lower", "unit": "ms"},
+            "serve_c8_p50_ms": {"trials": serve_trials,
+                                "direction": "lower", "unit": "ms"},
+            "ingest_ms": {"trials": ingest_trials, "direction": "lower",
+                          "unit": "ms", "parser": parser},
+        },
+    }
+
+
 _SECONDARY_CONFIGS = {
     "mnist784": bench_mnist,
     "xl": bench_xl,
@@ -1212,7 +1347,8 @@ def main():
 
 if __name__ == "__main__":
     if "--config" in sys.argv:
-        fns = dict(_SECONDARY_CONFIGS, headline=bench_headline, mnist=bench_mnist)
+        fns = dict(_SECONDARY_CONFIGS, headline=bench_headline,
+                   mnist=bench_mnist, gate=bench_gate_config)
         idx = sys.argv.index("--config") + 1
         name = sys.argv[idx] if idx < len(sys.argv) else None
         if name not in fns:

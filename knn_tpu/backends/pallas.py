@@ -38,10 +38,19 @@ def predict(
     from knn_tpu.obs.instrument import record_transfer
 
     if obs.enabled():
+        from knn_tpu.obs import devprof
+
         record_transfer(
             train.features.nbytes + train.labels.nbytes
             + test.features.nbytes, backend="tpu-pallas",
         )
+        # First dispatch of this signature compiles the kernel (miss);
+        # repeats ride Mosaic's executable cache (hit).
+        devprof.record_executable_lookup("tpu-pallas", (
+            train.features.shape, train.features.dtype.str,
+            test.features.shape, k, train.num_classes,
+            block_q, block_n, precision, engine,
+        ))
     from knn_tpu.resilience.retry import guarded_call
 
     # precision="auto" resolves inside predict_pallas (exact for narrow

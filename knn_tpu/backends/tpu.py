@@ -209,6 +209,25 @@ knn_forward_candidates = jax.jit(
 # [Q, N] float32 distance-matrix cells above which the tiled path is used.
 _FULL_MATRIX_CELL_LIMIT = 16 * 1024 * 1024
 
+
+def _record_stripe_lookup(train_x, test_x, k, num_classes, precision,
+                          query_batch) -> None:
+    """Executable-cache attribution for the stripe dispatch points. The
+    kernel's host entry pads internally, so the raw signature is a
+    conservative key: a raw-shape change that pads to the same blocks
+    counts as a miss here while the kernel actually reuses its executable
+    — never the other way around."""
+    from knn_tpu import obs
+
+    if not obs.enabled():
+        return
+    from knn_tpu.obs import devprof
+
+    devprof.record_executable_lookup("tpu", (
+        "stripe", train_x.shape, train_x.dtype.str, test_x.shape,
+        k, num_classes, precision, query_batch,
+    ))
+
 # Sampled-recall guard for approx mode (VERDICT r4 #7). approx_max_k's
 # recall target assumes the true top-k land at ~random positions; inputs
 # whose near-neighbors sit at regular strides (e.g. a dataset built by
@@ -387,6 +406,8 @@ def predict_arrays(
         from knn_tpu.ops.pallas_knn import stripe_classify_arrays
         from knn_tpu.resilience.retry import guarded_call
 
+        _record_stripe_lookup(train_x, test_x, k, num_classes, precision,
+                              query_batch)
         # The stripe host entry transfers + compiles + fetches internally:
         # nested guards give both fault points (and both failure classes)
         # coverage over the one call.
@@ -412,6 +433,8 @@ def predict_arrays(
         from knn_tpu.ops.pallas_knn import stripe_classify_arrays
         from knn_tpu.resilience.retry import guarded_call
 
+        _record_stripe_lookup(train_x, test_x, k, num_classes, precision,
+                              query_batch)
         return guarded_call("device.put", lambda: guarded_call(
             "backend.compile", lambda: stripe_classify_arrays(
                 train_x, train_y, test_x, k, num_classes, precision=precision,
@@ -429,6 +452,15 @@ def predict_arrays(
     from knn_tpu.resilience.retry import guarded_call
 
     if approx or (not force_tiled and q * n <= _FULL_MATRIX_CELL_LIMIT):
+        if obs.enabled():
+            from knn_tpu.obs import devprof
+
+            # Host-side executable-cache attribution: first dispatch of
+            # this signature since enable/reset compiles (miss).
+            devprof.record_executable_lookup("tpu", (
+                "xla-full", train_x.shape, train_x.dtype.str, test_x.shape,
+                k, num_classes, precision, approx, recall_target,
+            ))
         with obs.span("prepare", engine="xla-full"):
             txj, tyj, qxj = guarded_call("device.put", lambda: (
                 jnp.asarray(train_x), jnp.asarray(train_y),
@@ -448,6 +480,17 @@ def predict_arrays(
             return guarded_call("device.put", lambda: np.asarray(out))
 
     train_tile = max(train_tile, k)  # per-tile top-k needs k <= tile width
+    if obs.enabled():
+        from knn_tpu.obs import devprof
+
+        # Key on the PADDED shapes — those are the executable's operand
+        # shapes, so two raw sizes padding to one quantum share a hit.
+        devprof.record_executable_lookup("tpu", (
+            "xla-tiled", -(-n // train_tile) * train_tile,
+            train_x.shape[1], train_x.dtype.str,
+            -(-q // query_tile) * query_tile,
+            k, num_classes, precision, query_tile, train_tile,
+        ))
     with obs.span("prepare", engine="xla-tiled"):
         tx, _ = pad_axis_to_multiple(train_x, train_tile, axis=0)
         ty, _ = pad_axis_to_multiple(train_y, train_tile, axis=0)

@@ -26,6 +26,7 @@ above is untouched):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 from typing import Optional, Sequence
@@ -253,6 +254,14 @@ def _add_classify_args(p: argparse.ArgumentParser) -> None:
         "spans to FILE (open in chrome://tracing or ui.perfetto.dev). "
         "Implies enabling the knn_tpu.obs tracer for this run",
     )
+    p.add_argument(
+        "--profile-out", default=None, metavar="FILE",
+        help="capture a jax.profiler device profile spanning the classify "
+        "region and write ONE merged Perfetto-loadable trace to FILE: host "
+        "phase spans ride the device timeline as TraceAnnotations "
+        "(docs/OBSERVABILITY.md §Device & fleet). Implies enabling the "
+        "knn_tpu.obs tracer for this run",
+    )
     p.add_argument("--trace-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--warmup", action="store_true",
                    help="run once before timing (excludes compile time)")
@@ -293,11 +302,11 @@ def _setup_obs(args) -> Optional[str]:
     """Enable the span tracer when observability artifacts were requested,
     failing fast (before any parse/compute) on unwritable destinations.
     Returns an error message or None."""
-    if not (args.metrics_out or args.trace_out):
+    if not (args.metrics_out or args.trace_out or args.profile_out):
         return None
     from knn_tpu.obs.export import check_parent_dir
 
-    for path in (args.metrics_out, args.trace_out):
+    for path in (args.metrics_out, args.trace_out, args.profile_out):
         if path:
             try:
                 check_parent_dir(path)
@@ -306,6 +315,34 @@ def _setup_obs(args) -> Optional[str]:
     obs.enable()
     obs.reset()  # artifacts describe THIS run, not ambient prior spans
     return None
+
+
+@contextlib.contextmanager
+def _maybe_capture(path: Optional[str]):
+    """Wrap the classify region in a device-profile capture when
+    ``--profile-out`` was given (obs/devprof.py); yields the Capture (its
+    ``.trace`` is readable after the region) or None."""
+    if not path:
+        yield None
+        return
+    from knn_tpu.obs import devprof
+
+    with devprof.capture() as cap:
+        yield cap
+
+
+def _write_profile(path: str, cap) -> bool:
+    """Write the captured device profile, keeping the artifact-write
+    contract (after the result line; one-line error + exit 1 on failure)."""
+    import json
+
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(cap.trace, f)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return False
+    return True
 
 
 def _phase_breakdown(classify_span) -> dict:
@@ -647,14 +684,15 @@ def _run_classify(args, stdout) -> int:
             if args.warmup:
                 sweep_k(train, test, sweep_ks, metric=args.metric,
                         engine=args.engine)
-            with maybe_profile(args.trace_dir):
-                with RegionTimer() as t:
-                    with obs.span("classify", mode="sweep",
-                                  engine=args.engine) as classify_span:
-                        preds_by_k = sweep_k(
-                            train, test, sweep_ks, metric=args.metric,
-                            engine=args.engine,
-                        )
+            with _maybe_capture(args.profile_out) as capture:
+                with maybe_profile(args.trace_dir):
+                    with RegionTimer() as t:
+                        with obs.span("classify", mode="sweep",
+                                      engine=args.engine) as classify_span:
+                            preds_by_k = sweep_k(
+                                train, test, sweep_ks, metric=args.metric,
+                                engine=args.engine,
+                            )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return EXIT_RUNTIME
@@ -681,6 +719,9 @@ def _run_classify(args, stdout) -> int:
                     return 1
         if not _write_obs_artifacts(args, classify_span,
                                     round(t.ns / 1e6, 3)):
+            return 1
+        if capture is not None and not _write_profile(args.profile_out,
+                                                      capture):
             return 1
         return 0
 
@@ -764,14 +805,15 @@ def _run_classify(args, stdout) -> int:
             # warmup survived on, so the timed region measures the serving
             # configuration rather than re-walking the failures.
             backend_name, opts = warm.backend, warm.opts
-        with maybe_profile(args.trace_dir):
-            with RegionTimer() as t:
-                with obs.span("classify",
-                              backend=backend_name) as classify_span:
-                    result = degrade.predict_with_ladder(
-                        backend_name, train, test, args.k, opts,
-                        no_fallback=args.no_fallback,
-                    )
+        with _maybe_capture(args.profile_out) as capture:
+            with maybe_profile(args.trace_dir):
+                with RegionTimer() as t:
+                    with obs.span("classify",
+                                  backend=backend_name) as classify_span:
+                        result = degrade.predict_with_ladder(
+                            backend_name, train, test, args.k, opts,
+                            no_fallback=args.no_fallback,
+                        )
         predictions = result.predictions
         backend_name = result.backend  # report where it actually ran
     except ResilienceError as e:
@@ -802,6 +844,8 @@ def _run_classify(args, stdout) -> int:
     # The artifact records the precise region wall (float ms); the result
     # line above keeps the reference's integer-floor contract.
     if not _write_obs_artifacts(args, classify_span, round(t.ns / 1e6, 3)):
+        return 1
+    if capture is not None and not _write_profile(args.profile_out, capture):
         return 1
     return 0
 

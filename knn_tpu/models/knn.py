@@ -61,6 +61,30 @@ def _kneighbors_arrays(
         "exact", train_x.shape[1], k
     ):
         engine = "stripe"
+    if obs.enabled():
+        from knn_tpu.obs import devprof
+
+        # Executable-cache attribution for the retrieval core — the path
+        # every serving dispatch (batcher -> kneighbors) rides, so the
+        # serve /healthz cache block reflects live traffic. The XLA path
+        # pads queries to 128 and train to its tile, so the key uses the
+        # PADDED shapes — the executable's real operand shapes; otherwise
+        # every coalesced serving batch size would read as a fresh miss
+        # while XLA reuses one executable. (Stripe pads inside its own
+        # entry; its raw-shape key is conservative, never the reverse.)
+        if engine == "stripe":
+            sig = (engine, train_x.shape, train_x.dtype.str, test_x.shape,
+                   k, form)
+        else:
+            n_tile = max(min(2048, train_x.shape[0]), k)
+            sig = (
+                engine,
+                -(-train_x.shape[0] // n_tile) * n_tile, train_x.shape[1],
+                train_x.dtype.str,
+                -(-test_x.shape[0] // 128) * 128,
+                k, form,
+            )
+        devprof.record_executable_lookup("retrieval", sig)
     if engine == "stripe":
         if not euclidean:
             raise ValueError("the stripe engine implements euclidean only")

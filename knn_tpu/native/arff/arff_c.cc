@@ -805,10 +805,17 @@ void count_segment(const char* s, size_t b, size_t e, SegCount& out) {
 // comma directly after a token is its terminator, ",," and leading ','
 // are empty-cell errors, '%' comments at true line start, '{' first char
 // is a sparse-row error). Tokens at global index >= `complete` belong to
-// the discarded partial row at EOF and are not written.
+// the discarded partial row at EOF and are not written. `tok_budget` is
+// the segment's PASS-1 token count: writes are clamped to it (counting
+// continues, so the caller's mismatch check still fires and discards the
+// result) because the prefixes of the following segments were computed
+// from pass 1 — a tokenizer divergence that produced extra pass-2 tokens
+// would otherwise store into the next worker's index range, a concurrent
+// unsynchronized write even though the committed result is re-parsed
+// serially.
 void convert_segment(const char* s, size_t b, size_t e, ParseState& wst,
-                     size_t tok_prefix, size_t complete, float* cells,
-                     size_t d, SegResult& out) {
+                     size_t tok_prefix, size_t tok_budget, size_t complete,
+                     float* cells, size_t d, SegResult& out) {
   size_t pos = b;
   size_t cnt = 0;  // tokens seen in this segment
   while (pos < e) {
@@ -852,7 +859,7 @@ void convert_segment(const char* s, size_t b, size_t e, ParseState& wst,
       size_t t0 = pos;
       while (pos < e && !kStructural[(unsigned char)s[pos]]) pos++;
       size_t g = tok_prefix + cnt;
-      if (g < complete) {
+      if (cnt < tok_budget && g < complete) {
         float v;
         if (!cell_view_to_float(s + t0, pos - t0, wst.attrs[g % d], &v,
                                 wst)) {
@@ -933,13 +940,15 @@ bool try_parse_data_parallel(std::string_view data, size_t pos,
       wstates[i].line = line0 + (int)nl_prefix;
       if (i)
         pool.emplace_back(convert_segment, s, bounds[i], bounds[i + 1],
-                          std::ref(wstates[i]), tok_prefix, complete,
-                          st.cells.data(), d, std::ref(results[i]));
+                          std::ref(wstates[i]), tok_prefix,
+                          counts[i].tokens, complete, st.cells.data(), d,
+                          std::ref(results[i]));
       tok_prefix += counts[i].tokens;
       nl_prefix += counts[i].newlines;
     }
-    convert_segment(s, bounds[0], bounds[1], wstates[0], 0, complete,
-                    st.cells.data(), d, results[0]);
+    convert_segment(s, bounds[0], bounds[1], wstates[0], 0,
+                    counts[0].tokens, complete, st.cells.data(), d,
+                    results[0]);
     for (auto& t : pool) t.join();
     total_nl = nl_prefix;
   }

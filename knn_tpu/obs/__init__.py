@@ -21,7 +21,17 @@ pair around the KNN loop printed as a single milliseconds number
   ``/debug/requests``/``/debug/slowest``, per-request Perfetto export,
   and the active-context channel the breaker/ladder emit through;
 - :mod:`knn_tpu.obs.slo`     — SLO objectives and multi-window
-  error-budget burn rates (``knn_slo_*`` gauges).
+  error-budget burn rates (``knn_slo_*`` gauges);
+- :mod:`knn_tpu.obs.devprof` — the device-side half: ``jax.profiler``
+  capture sessions (``--profile-out``, ``/debug/profile``),
+  ``knn_device_memory_bytes`` gauges, compile-event counters/walls via
+  ``jax.monitoring``, executable-cache hit/miss counters;
+- :mod:`knn_tpu.obs.aggregate` — multihost fleet aggregation: per-process
+  registry snapshots merged on process 0 with ``{proc=…}`` labels, plus
+  straggler gauges over the sharded dispatch walls;
+- :mod:`knn_tpu.obs.regress`  — the noise-aware perf-regression
+  comparison (best-of-mins with MAD tolerance) behind
+  ``scripts/bench_gate.py`` / ``make bench-gate``.
 
 Everything is OFF by default and zero-cost when off: ``span()`` returns a
 shared no-op context manager and the metric helpers return immediately, so
@@ -80,6 +90,12 @@ def enable(jax_annotations: bool = False) -> None:
     _ENABLED = True
     _JAX_ANNOTATIONS = bool(jax_annotations)
     _TRACER.jax_annotations = _JAX_ANNOTATIONS
+    # Device-side compile attribution (obs/devprof.py): the jax.monitoring
+    # listener is registered once here — never at import — and its body
+    # gates on enabled(), so the disabled path stays zero-record.
+    from knn_tpu.obs import devprof
+
+    devprof.install_compile_listeners()
 
 
 def disable() -> None:
@@ -97,10 +113,11 @@ def reset() -> None:
     predict per backend records ``knn_first_call_wall_ms`` again."""
     _TRACER.reset()
     _REGISTRY.reset()
-    from knn_tpu.obs import instrument
+    from knn_tpu.obs import devprof, instrument
 
     with instrument._first_call_lock:
         instrument._first_call_seen.clear()
+    devprof.reset_state()
 
 
 def tracer() -> SpanTracer:

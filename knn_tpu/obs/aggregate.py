@@ -1,0 +1,207 @@
+"""Multihost fleet aggregation: merge per-process metric registries on
+process 0, with ``{proc=…}`` labels and straggler gauges.
+
+Every process in a multi-controller run (``parallel/multihost.py``) keeps
+its own private :class:`~knn_tpu.obs.metrics.MetricsRegistry`; until now
+those never met, so a fleet-wide question ("which shard is the
+straggler?") had no answer. This module closes that gap:
+
+- :func:`snapshot_registry` — one process's registry as a plain
+  JSON-able list (raw bucket counts for histograms, so merging is exact);
+- :func:`merge_snapshots`   — process 0 folds the per-process snapshots
+  into one registry, every instrument gaining a ``proc`` label (counters
+  stay per-process — summing them is the scrape consumer's choice, the
+  merge must not destroy attribution);
+- :func:`straggler_gauges`  — derived fleet gauges over each process's
+  ``knn_shard_dispatch_ms`` sample (``obs/instrument.py::
+  record_shard_dispatch`` — recorded by the query-sharded, train-sharded,
+  and ring strategies): ``knn_shard_dispatch_ms_max`` /
+  ``knn_shard_dispatch_ms_min`` / ``knn_shard_dispatch_skew`` per path.
+  A skew ratio near 1.0 means a balanced fleet; the straggler is the
+  proc whose gauge equals the max.
+- :func:`aggregate_multihost` — the transport: snapshots cross hosts as
+  length-prefixed uint8 arrays through
+  ``jax.experimental.multihost_utils.process_allgather`` (the same
+  device fabric the predict collectives use — no side channel to
+  configure). Process 0 returns the merged registry + straggler dict;
+  other processes return ``(None, {})``. Single-process: merges its own
+  snapshot (proc 0), so the output shape is launcher-independent.
+
+Where jaxlib lacks multi-process collectives (the CPU test box), the
+merge/straggler math is pinned by fake-registry unit tests instead
+(tests/test_aggregate.py) — the acceptance contract of ISSUE 6.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from knn_tpu import obs
+from knn_tpu.obs.metrics import Histogram, MetricsRegistry
+
+#: The sharded strategies whose dispatch walls feed the straggler gauges.
+STRATEGY_PATHS = ("query-sharded", "train-sharded", "ring")
+
+
+def snapshot_registry(registry: Optional[MetricsRegistry] = None) -> List[dict]:
+    """One registry as a JSON-able list of instrument records. Histograms
+    carry RAW (non-cumulative) bucket counts so a merge can reconstruct
+    them exactly; the exposition-side cumulative form is derivable, the
+    reverse only up to the shared bucket ladder."""
+    reg = registry if registry is not None else obs.registry()
+    out = []
+    for inst in reg.instruments():
+        rec = {
+            "name": inst.name,
+            "kind": inst.kind,
+            "labels": dict(inst.labels),
+            "help": inst.help,
+        }
+        if isinstance(inst, Histogram):
+            rec.update(
+                buckets=list(inst.buckets),
+                counts=inst.bucket_counts(),
+                sum=inst.sum,
+                count=inst.count,
+            )
+        else:
+            rec["value"] = inst.value
+        out.append(rec)
+    return out
+
+
+def merge_snapshots(
+    snapshots: Dict[int, List[dict]],
+    registry: Optional[MetricsRegistry] = None,
+) -> MetricsRegistry:
+    """Fold per-process snapshots into one registry, adding ``proc=<id>``
+    to every label set. Values stay per-process (a counter from proc 1
+    never adds into proc 0's) — fleet-level sums are a query over the
+    merged registry, not a lossy pre-aggregation."""
+    reg = registry if registry is not None else MetricsRegistry()
+    for proc in sorted(snapshots):
+        for rec in snapshots[proc]:
+            labels = dict(rec["labels"])
+            labels["proc"] = str(proc)
+            help_ = rec.get("help", "")
+            if rec["kind"] == "counter":
+                reg.counter(rec["name"], help=help_, **labels).add(
+                    rec["value"]
+                )
+            elif rec["kind"] == "gauge":
+                reg.gauge(rec["name"], help=help_, **labels).set(
+                    rec["value"]
+                )
+            elif rec["kind"] == "histogram":
+                h = reg.histogram(
+                    rec["name"], buckets=rec["buckets"], help=help_, **labels
+                )
+                h.merge_counts(rec["counts"], rec["sum"], rec["count"])
+            else:
+                raise ValueError(
+                    f"snapshot record {rec['name']!r} has unknown kind "
+                    f"{rec['kind']!r}"
+                )
+    return reg
+
+
+def straggler_gauges(
+    snapshots: Dict[int, List[dict]],
+    registry: MetricsRegistry,
+) -> Dict[str, dict]:
+    """Derive the fleet straggler gauges from each process's
+    ``knn_shard_dispatch_ms`` sample: per strategy path, set
+    ``knn_shard_dispatch_ms_max`` / ``_min`` and
+    ``knn_shard_dispatch_skew`` (= max/min) on ``registry`` and return
+    ``{path: {"max_ms", "min_ms", "skew", "max_proc", "procs"}}``.
+    Paths no process dispatched are absent from the result."""
+    per_path: Dict[str, Dict[int, float]] = {}
+    for proc, snap in snapshots.items():
+        for rec in snap:
+            if rec["name"] != "knn_shard_dispatch_ms":
+                continue
+            path = rec["labels"].get("path", "?")
+            per_path.setdefault(path, {})[proc] = float(rec["value"])
+    out: Dict[str, dict] = {}
+    for path, by_proc in sorted(per_path.items()):
+        vals = list(by_proc.values())
+        mx, mn = max(vals), min(vals)
+        # A 0 ms min (the gauge rounds to 3 decimals, so a sub-µs wall
+        # stores 0.0) must not read as INFINITE skew — inf also breaks
+        # strict-JSON consumers of the --metrics-out artifact. Clamp the
+        # denominator to the rounding floor: the ratio then means "at
+        # least this skewed", stays finite, and a fleet of all-zero walls
+        # is exactly balanced.
+        skew = 1.0 if mx == 0 else mx / max(mn, 0.001)
+        max_proc = max(by_proc, key=by_proc.get)
+        registry.gauge(
+            "knn_shard_dispatch_ms_max",
+            help="slowest process's sharded dispatch->fetch wall ms",
+            path=path,
+        ).set(mx)
+        registry.gauge(
+            "knn_shard_dispatch_ms_min",
+            help="fastest process's sharded dispatch->fetch wall ms",
+            path=path,
+        ).set(mn)
+        registry.gauge(
+            "knn_shard_dispatch_skew",
+            help="straggler ratio: max/min sharded dispatch wall across "
+                 "processes (1.0 = balanced; min clamped to the 0.001 ms "
+                 "rounding floor so the gauge stays finite)",
+            path=path,
+        ).set(round(skew, 4))
+        out[path] = {
+            "max_ms": mx,
+            "min_ms": mn,
+            "skew": skew,
+            "max_proc": max_proc,
+            "procs": len(by_proc),
+        }
+    return out
+
+
+def aggregate_multihost(
+    registry: Optional[MetricsRegistry] = None,
+) -> Tuple[Optional[MetricsRegistry], Dict[str, dict]]:
+    """Gather every process's registry snapshot and merge on process 0.
+
+    Returns ``(merged_registry, stragglers)`` on process 0 and
+    ``(None, {})`` elsewhere. Single-process (no launcher): merges the
+    local snapshot as proc 0 so callers see one output shape.
+
+    Transport: the JSON snapshot rides ``process_allgather`` as a padded
+    uint8 array (lengths gathered first) — the collectives fabric the
+    predicts already proved works, no extra RPC channel. The gather is
+    symmetric (every process participates and receives all snapshots);
+    only process 0 pays the merge.
+    """
+    import jax
+
+    local = snapshot_registry(registry)
+    if jax.process_count() <= 1:
+        snaps = {0: local}
+        merged = merge_snapshots(snaps)
+        return merged, straggler_gauges(snaps, merged)
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(
+        json.dumps(local, separators=(",", ":")).encode(), dtype=np.uint8
+    )
+    lengths = np.asarray(
+        multihost_utils.process_allgather(np.int64(payload.size))
+    ).reshape(-1)
+    buf = np.zeros(int(lengths.max()), np.uint8)
+    buf[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    if jax.process_index() != 0:
+        return None, {}
+    snaps = {
+        p: json.loads(bytes(gathered[p][: int(lengths[p])]).decode())
+        for p in range(gathered.shape[0])
+    }
+    merged = merge_snapshots(snaps)
+    return merged, straggler_gauges(snaps, merged)

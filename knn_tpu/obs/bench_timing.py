@@ -136,7 +136,17 @@ def slope_trials(step, bufs, r_lo, r_hi, trials=5, inner=2):
     )["case"]
 
 
-def drop_superroofline(trials_s, flops, peak_tf=207.0):
+# Chip-peak filter bounds for drop_superroofline, per operand precision:
+# the v5e bf16 MXU peak (197 TF) plus 5% margin, and the f32 peak at
+# roughly half of it (the MXU decomposes f32 contractions — ADVICE r5 #3:
+# filtering an f32 trial against the bf16 peak admits physically
+# impossible f32 slopes). Callers pass the peak matching the CASE's
+# operand dtype, not one blanket number.
+PEAK_TF_BF16 = 207.0
+PEAK_TF_F32 = 104.0
+
+
+def drop_superroofline(trials_s, flops, peak_tf=PEAK_TF_BF16):
     """Drop slope trials whose implied Tflop/s exceeds the chip's peak —
     nothing computes faster than the hardware, so such a trial is a
     measurement artifact by definition (a host stall inflating the r_lo

@@ -1,0 +1,416 @@
+"""Device-side observability: profiler capture sessions, device-memory
+gauges, compile-event attribution, and executable-cache hit/miss counters.
+
+PRs 1 and 5 made the host side legible (spans, per-request timelines, SLO
+burn rates); the device was still a black box — nothing reported HBM in
+use, compile events, or an actual XLA timeline. This module is the
+device-side half of ``knn_tpu.obs``:
+
+- :func:`capture` / :func:`capture_for` — on-demand ``jax.profiler``
+  capture sessions returning ONE Perfetto-loadable Chrome ``trace_event``
+  JSON object. During the window the global tracer's
+  ``jax.profiler.TraceAnnotation`` pass-through (``obs/tracer.py``) is
+  forced on, so every host span recorded while the capture runs appears
+  *inside* the device timeline — the serve spans and the XLA executable
+  events line up on one time axis. Exposed as ``--profile-out`` on the
+  classify CLI and ``GET /debug/profile?ms=N`` on the serve front-end.
+- :func:`record_device_memory` — ``knn_device_memory_bytes{kind=in_use|
+  peak}`` gauges per device from ``device.memory_stats()``; where a
+  backend reports none (CPU jaxlib), falls back to summing the client's
+  live device buffers, with a module-tracked running peak, and labels the
+  sample ``source="live_buffers"`` so the two can never be confused.
+- :func:`install_compile_listeners` — ``jax.monitoring`` duration events
+  (``/jax/core/compile/*``) become ``knn_compile_events_total{event=…}``
+  counters and ``knn_compile_wall_ms{event=…}`` histograms: the *timed*
+  compile walls the backend itself reports, with the registry-level
+  ``knn_first_call_wall_ms`` (obs/instrument.py) remaining the fallback
+  upper bound where jax emits nothing. Registered at ``obs.enable()``;
+  the listener body gates on ``obs.enabled()`` so the disabled path
+  records nothing (pinned by scripts/check_disabled_overhead.py).
+- :func:`record_executable_lookup` — host-side executable-cache hit/miss
+  counters (``knn_executable_cache_total{backend,outcome}``): the first
+  dispatch of a (backend, signature) since enable/reset is a ``miss``
+  (XLA compiles), repeats are ``hit``s. An explicit ``lower().compile()``
+  can be timed with :func:`timed_compile` where a caller holds a
+  lowerable fn — NOT on a serving path, because jax's jit call cache is
+  not seeded by explicit compiles (measured: the next ``fn(x)`` compiles
+  again).
+
+Everything gates on ``obs.enabled()``: one predicate per call site while
+off, nothing recorded, no listeners doing work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+from knn_tpu import obs
+
+# Compile walls span sub-ms jaxpr traces through multi-minute TPU compiles.
+COMPILE_MS_BUCKETS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+    2500.0, 5000.0, 15000.0, 60000.0, 300000.0,
+)
+
+#: /debug/profile refuses windows past this (a capture pins one handler
+#: thread and the global capture lock for its whole duration).
+MAX_CAPTURE_MS = 10_000
+
+
+class CaptureBusy(RuntimeError):
+    """A profiler capture is already running; one at a time — the backend
+    profiler is a process-global singleton (jax raises otherwise, and two
+    interleaved windows would attribute each other's events)."""
+
+
+_capture_lock = threading.Lock()
+
+_listener_lock = threading.Lock()
+_listeners_installed = False
+
+_exec_lock = threading.Lock()
+_exec_seen: set = set()
+
+# Fallback-peak tracking for backends whose memory_stats() is None: the
+# running max of summed live-buffer bytes per device, since process start
+# (or the last obs.reset()).
+_peak_lock = threading.Lock()
+_live_peak: dict = {}
+
+
+def reset_state() -> None:
+    """Clear the first-seen executable signatures and the fallback peak
+    tracking (called from ``obs.reset()`` so a reset registry and the
+    hit/miss memory stay consistent)."""
+    with _exec_lock:
+        _exec_seen.clear()
+    with _peak_lock:
+        _live_peak.clear()
+
+
+# -- device memory ----------------------------------------------------------
+
+
+def device_memory_stats(devices=None) -> List[dict]:
+    """Per-device memory sample: ``[{"device", "platform", "in_use",
+    "peak", "source"}, ...]``. ``source`` is ``"memory_stats"`` when the
+    backend reports real allocator stats (TPU/GPU ``bytes_in_use`` /
+    ``peak_bytes_in_use``) and ``"live_buffers"`` for the host-side
+    fallback (sum of live device-buffer bytes; ``peak`` is the running max
+    this process has observed, not the allocator's)."""
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    out = []
+    for d in devices:
+        label = f"{getattr(d, 'platform', '?')}:{getattr(d, 'id', 0)}"
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — a backend without the API
+            stats = None
+        if stats:
+            in_use = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", in_use))
+            source = "memory_stats"
+        else:
+            in_use = _live_buffer_bytes(d)
+            with _peak_lock:
+                peak = max(_live_peak.get(label, 0), in_use)
+                _live_peak[label] = peak
+            source = "live_buffers"
+        out.append({
+            "device": label,
+            "platform": getattr(d, "platform", "?"),
+            "in_use": in_use,
+            "peak": peak,
+            "source": source,
+        })
+    return out
+
+
+def _live_buffer_bytes(d) -> int:
+    try:
+        client = d.client
+        total = 0
+        for buf in client.live_buffers():
+            try:
+                dev = buf.device  # property on new jaxlib, method on old
+                if callable(dev):
+                    dev = dev()
+                if dev is d:
+                    total += int(getattr(buf, "nbytes", 0) or 0)
+            except Exception:  # noqa: BLE001 — a donated/deleted buffer
+                continue
+        return total
+    except Exception:  # noqa: BLE001 — no client/live_buffers on this jaxlib
+        return 0
+
+
+def record_device_memory(devices=None) -> List[dict]:
+    """Sample device memory and (when obs is enabled) publish the
+    ``knn_device_memory_bytes{kind=in_use|peak, device=…}`` gauges. Returns
+    the sample either way so ``/healthz`` can embed it."""
+    stats = device_memory_stats(devices)
+    if obs.enabled():
+        for s in stats:
+            for kind in ("in_use", "peak"):
+                obs.gauge_set(
+                    "knn_device_memory_bytes", s[kind],
+                    help="device memory bytes (memory_stats where the "
+                         "backend reports it, live-buffer sum fallback)",
+                    kind=kind, device=s["device"], source=s["source"],
+                )
+    return stats
+
+
+# -- compile events ---------------------------------------------------------
+
+
+def _event_leaf(name: str) -> str:
+    """``/jax/core/compile/backend_compile_duration`` -> ``backend_compile``."""
+    leaf = name.rsplit("/", 1)[-1]
+    if leaf.endswith("_duration"):
+        leaf = leaf[: -len("_duration")]
+    return leaf
+
+
+def _on_event_duration(name: str, dur_s: float, **kw) -> None:
+    if not obs.enabled() or "compile" not in name:
+        return
+    leaf = _event_leaf(name)
+    obs.counter_add(
+        "knn_compile_events_total", 1,
+        help="XLA/jax compile events (jax.monitoring durations)",
+        event=leaf,
+    )
+    obs.histogram_observe(
+        "knn_compile_wall_ms", dur_s * 1e3, buckets=COMPILE_MS_BUCKETS,
+        help="per-event compile wall ms (jax.monitoring durations)",
+        event=leaf,
+    )
+
+
+def install_compile_listeners() -> bool:
+    """Register the ``jax.monitoring`` duration listener (idempotent —
+    jax offers no unregistration, so the body gates on ``obs.enabled()``).
+    Returns True when the listener is installed."""
+    global _listeners_installed
+    with _listener_lock:
+        if _listeners_installed:
+            return True
+        try:
+            import jax.monitoring
+        except ImportError:
+            return False
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+        _listeners_installed = True
+        return True
+
+
+def timed_compile(jitted_fn, *args, label: str = "explicit", **kwargs):
+    """Explicitly ``lower().compile()`` a jitted fn, recording the wall as
+    ``knn_compile_explicit_wall_ms{label=…}``. Returns the Compiled object.
+
+    For probing/benchmarks only — jax's jit call cache is NOT seeded by an
+    explicit compile (measured: ``fn(x)`` after ``fn.lower(x).compile()``
+    compiles again), so calling this on a serving path doubles compile
+    cost. The live serving compile walls come from the monitoring listener
+    instead."""
+    lowered = jitted_fn.lower(*args, **kwargs)
+    t0 = time.monotonic()
+    compiled = lowered.compile()
+    wall_ms = (time.monotonic() - t0) * 1e3
+    obs.gauge_set(
+        "knn_compile_explicit_wall_ms", round(wall_ms, 3),
+        help="explicit lower().compile() wall ms (probing paths)",
+        label=label,
+    )
+    return compiled
+
+
+# -- executable-cache hit/miss ----------------------------------------------
+
+
+def record_executable_lookup(backend: str, key: tuple) -> str:
+    """Count one dispatch against the host-side executable-signature set:
+    the first (backend, key) since enable/reset is a ``miss`` (the dispatch
+    will compile), repeats are ``hit``s. Returns "hit"/"miss", or "off"
+    (nothing recorded) while obs is disabled. ``key`` must capture
+    everything that forces a new executable — shapes, dtypes, and every
+    static argument."""
+    if not obs.enabled():
+        return "off"
+    full = (backend, key)
+    with _exec_lock:
+        outcome = "hit" if full in _exec_seen else "miss"
+        _exec_seen.add(full)
+    obs.counter_add(
+        "knn_executable_cache_total", 1,
+        help="dispatches by executable-cache outcome (host-side signature "
+             "tracking: first dispatch of a signature compiles)",
+        backend=backend, outcome=outcome,
+    )
+    return outcome
+
+
+# -- summaries (the /healthz device block) ----------------------------------
+
+
+def compile_summary() -> dict:
+    """``{event: {"count": n, "wall_ms_total": x}}`` from the registry's
+    compile instruments (empty dict when none recorded)."""
+    out: dict = {}
+    for inst in obs.registry().instruments():
+        labels = dict(inst.labels)
+        if inst.name == "knn_compile_events_total":
+            out.setdefault(labels.get("event", "?"), {}).update(
+                count=inst.value
+            )
+        elif inst.name == "knn_compile_wall_ms":
+            out.setdefault(labels.get("event", "?"), {}).update(
+                wall_ms_total=round(inst.sum, 3)
+            )
+    return out
+
+
+def executable_cache_summary() -> dict:
+    """``{"hits": h, "misses": m}`` summed over backends."""
+    hits = misses = 0
+    for inst in obs.registry().instruments():
+        if inst.name != "knn_executable_cache_total":
+            continue
+        outcome = dict(inst.labels).get("outcome")
+        if outcome == "hit":
+            hits += inst.value
+        elif outcome == "miss":
+            misses += inst.value
+    return {"hits": hits, "misses": misses}
+
+
+# -- capture sessions -------------------------------------------------------
+
+
+class Capture:
+    """Result slot for one profiler capture: ``trace`` (the merged Chrome
+    ``trace_event`` dict) is set when the context exits; ``error`` carries
+    a profiler failure message (the trace then falls back to host spans
+    only, with the error noted in ``otherData``)."""
+
+    __slots__ = ("trace", "error")
+
+    def __init__(self):
+        self.trace: Optional[dict] = None
+        self.error: Optional[str] = None
+
+
+@contextlib.contextmanager
+def capture(annotate: bool = True):
+    """Run a ``jax.profiler`` capture around the with-block, yielding a
+    :class:`Capture` whose ``.trace`` is the Perfetto-loadable Chrome
+    ``trace_event`` JSON after exit.
+
+    ``annotate=True`` (default) forces the global tracer's
+    ``TraceAnnotation`` pass-through on for the window, so host spans
+    recorded meanwhile appear inside the device timeline (restored after).
+    One capture at a time: a concurrent attempt raises
+    :class:`CaptureBusy` immediately (the serve endpoint maps it to 409).
+    """
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy(
+            "a profiler capture is already running (one at a time)"
+        )
+    cap = Capture()
+    tmp = tempfile.mkdtemp(prefix="knn_devprof_")
+    tracer = obs.tracer()
+    prev_anno = tracer.jax_annotations
+    started = False
+    t0 = time.monotonic()
+    try:
+        try:
+            import jax.profiler
+
+            if annotate:
+                tracer.jax_annotations = True
+            jax.profiler.start_trace(tmp)
+            started = True
+        except Exception as e:  # noqa: BLE001 — backend without a profiler
+            cap.error = f"{type(e).__name__}: {e}"
+        try:
+            yield cap
+        finally:
+            tracer.jax_annotations = prev_anno
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    cap.error = f"{type(e).__name__}: {e}"
+        cap.trace = _load_profile_trace(tmp, cap.error)
+        cap.trace["otherData"]["capture_wall_ms"] = round(
+            (time.monotonic() - t0) * 1e3, 3
+        )
+        obs.counter_add(
+            "knn_profile_captures_total", 1,
+            help="profiler capture sessions, by outcome",
+            outcome="error" if cap.error else "ok",
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        _capture_lock.release()
+
+
+def capture_for(duration_ms: float, annotate: bool = True) -> dict:
+    """Blocking fixed-window capture (the ``/debug/profile?ms=N`` shape):
+    start, sleep ``duration_ms`` while other threads keep working, stop,
+    return the trace dict. The caller's thread contributes nothing to the
+    window — the interesting events come from the threads serving load."""
+    with capture(annotate=annotate) as cap:
+        time.sleep(max(0.0, float(duration_ms)) / 1e3)
+    return cap.trace
+
+
+def _load_profile_trace(tmpdir: str, error: Optional[str]) -> dict:
+    """Read the profiler's Chrome trace (``**/*.trace.json.gz``) and wrap
+    it with provenance. When the profiler produced nothing (unsupported
+    backend, start failure), fall back to the global tracer's host spans
+    so the artifact is still a loadable timeline — with the degradation
+    named in ``otherData`` instead of silently thinner data."""
+    other = {"producer": "knn_tpu.obs.devprof", "epoch_unix_s": time.time()}
+    if error:
+        other["profiler_error"] = error
+    paths = sorted(glob.glob(
+        os.path.join(tmpdir, "**", "*.trace.json.gz"), recursive=True
+    ))
+    if paths:
+        try:
+            with gzip.open(paths[-1], "rt", encoding="utf-8") as f:
+                data = json.load(f)
+            events = data.get("traceEvents", [])
+            out = {
+                "traceEvents": events,
+                "displayTimeUnit": data.get("displayTimeUnit", "ns"),
+                "otherData": {**other, "source": "jax.profiler",
+                              **{k: v for k, v in
+                                 (data.get("metadata") or {}).items()
+                                 if isinstance(v, (str, int, float))}},
+            }
+            return out
+        except (OSError, ValueError) as e:
+            other["profiler_error"] = f"unreadable profiler trace: {e}"
+    # Host-span fallback: still a valid Perfetto file, clearly labeled.
+    fallback = obs.tracer().to_chrome_trace()
+    fallback["otherData"].update(other)
+    fallback["otherData"]["source"] = "host_spans_fallback"
+    return fallback

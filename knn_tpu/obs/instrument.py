@@ -196,6 +196,22 @@ def record_transfer(nbytes: int, direction: str = "h2d",
         )
 
 
+def record_shard_dispatch(path: str, t0_monotonic: float) -> None:
+    """Record this process's dispatch->fetch wall for one sharded predict
+    (``knn_shard_dispatch_ms{path=...}``, last call wins). THE per-process
+    straggler signal: obs/aggregate.py collects this gauge across the
+    fleet's registry snapshots and derives
+    ``knn_shard_dispatch_ms_max/min`` and the skew ratio on process 0."""
+    if obs.enabled():
+        obs.gauge_set(
+            "knn_shard_dispatch_ms",
+            round((time.monotonic() - t0_monotonic) * 1e3, 3),
+            help="this process's last sharded dispatch->fetch wall ms "
+                 "(the fleet straggler signal — obs/aggregate.py)",
+            path=path,
+        )
+
+
 def record_collective(path: str, op: str, nbytes: int) -> None:
     """Count modeled collective-traffic bytes for one sharded predict call.
 

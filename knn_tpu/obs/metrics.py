@@ -144,6 +144,24 @@ class Histogram(_Instrument):
                     value, time.time(),
                 )
 
+    def merge_counts(self, counts, sum_, count) -> None:
+        """Fold another histogram's raw (non-cumulative) bucket counts into
+        this one — the multihost aggregation primitive (obs/aggregate.py):
+        a process-0 merge registry reconstructs each remote histogram from
+        its snapshot instead of replaying observations. ``counts`` must
+        match this instrument's bucket count (+1 for +Inf)."""
+        counts = list(counts)
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram {self.name}: cannot merge {len(counts)} bucket "
+                f"counts into {len(self._counts)} buckets"
+            )
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self._sum += float(sum_)
+            self._count += int(count)
+
     @property
     def count(self) -> int:
         return self._count

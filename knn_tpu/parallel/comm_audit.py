@@ -31,11 +31,22 @@ _DTYPE_BYTES = {
     "ui64": 8, "ui32": 4, "ui16": 2, "ui8": 1,
 }
 
+# Both StableHLO spellings: the quoted generic form
+# ('"stablehlo.all_gather"(...) ... -> tensor<...>') and the unquoted
+# pretty-printed one a future jax's lower().as_text() may emit (ADVICE r5
+# #5) — a parser matching only one would silently return [] on the other
+# and fail the audit with a misleading shape-mismatch message.
 _COLLECTIVE_RE = re.compile(
-    r'"stablehlo\.(all_gather|collective_permute|all_reduce|reduce_scatter'
-    r'|all_to_all)"'
+    r'"?stablehlo\.(all_gather|collective_permute|all_reduce|reduce_scatter'
+    r'|all_to_all)"?'
     r'.*?->\s*tensor<((?:\d+x)*)([a-z]+\d+)>',
 )
+
+
+class CollectiveParseError(AssertionError):
+    """Zero collectives parsed from a lowering that is KNOWN to
+    communicate: the lowering text format changed (or the regex rotted) —
+    a parser defect, distinct from a genuine byte-model mismatch."""
 
 
 def collective_ops(lowered_text: str) -> List[Tuple[str, Tuple[int, ...], str, int]]:
@@ -104,6 +115,12 @@ def audit_train_sharded(lowered_text: str, q_local: int, k: int, n_t: int):
     elements. Returns ``(measured_bytes, expected_bytes)`` per device per
     step (post-gather buffer size, all three ops)."""
     ops = collective_ops(lowered_text)
+    if not ops:
+        raise CollectiveParseError(
+            "no collectives parsed from the train-sharded lowering — "
+            "lowering format changed? (_COLLECTIVE_RE matched nothing in a "
+            "program known to all-gather)"
+        )
     gathers = [o for o in ops if o[0] == "all_gather"]
     others = [o for o in ops if o[0] != "all_gather"]
     if others:
@@ -134,6 +151,12 @@ def audit_ring(lowered_text: str, shard_bytes: int, label_bytes: int, n_dev: int
     nothing else crosses the wire. Returns ``(measured_total, expected_total)``
     bytes moved per device per call (per-step payload x (P-1) steps)."""
     ops = collective_ops(lowered_text)
+    if not ops:
+        raise CollectiveParseError(
+            "no collectives parsed from the ring lowering — lowering "
+            "format changed? (_COLLECTIVE_RE matched nothing in a program "
+            "known to collective-permute)"
+        )
     permutes = [o for o in ops if o[0] == "collective_permute"]
     others = [o for o in ops if o[0] != "collective_permute"]
     if others:

@@ -222,8 +222,12 @@ def predict_query_sharded_global(
     g_test_x = make_global(np.ascontiguousarray(qx), P("q"))
     g_nv = make_global(np.asarray(n, np.int32), P())
 
+    from knn_tpu.obs.instrument import record_shard_dispatch
     from knn_tpu.resilience.retry import guarded_call
 
+    import time
+
+    t0 = time.monotonic()
     out = guarded_call(
         "collective.step", lambda: fn(g_train_x, g_train_y, g_test_x, g_nv)
     )
@@ -239,7 +243,12 @@ def predict_query_sharded_global(
         # process holds a full copy as its addressable data.
         return np.asarray(out.addressable_data(0))[:q]
 
-    return guarded_call("collective.step", fetch)
+    preds = guarded_call("collective.step", fetch)
+    # This process's dispatch->fetch wall IS the fleet straggler signal:
+    # obs/aggregate.py derives knn_shard_dispatch_ms_max/min + skew from
+    # this gauge across the merged {proc=...} snapshots.
+    record_shard_dispatch("query-sharded", t0)
+    return preds
 
 
 def _worker_main(argv) -> int:
@@ -257,6 +266,13 @@ def _worker_main(argv) -> int:
                    "for exact narrow-feature problems)")
     p.add_argument("--dump-predictions", default=None,
                    help="rank 0 writes the prediction vector here (npy)")
+    p.add_argument("--metrics-out", default=None,
+                   help="rank 0 writes the AGGREGATED fleet metrics here "
+                   "(JSON): every process's registry snapshot merged with "
+                   "{proc=N} labels plus the straggler gauges "
+                   "(knn_shard_dispatch_ms_max/min, skew) — "
+                   "obs/aggregate.py. Implies enabling knn_tpu.obs on "
+                   "every process")
     args = p.parse_args(argv)
 
     import jax
@@ -264,6 +280,11 @@ def _worker_main(argv) -> int:
     from knn_tpu import obs
     from knn_tpu.resilience import faults
     from knn_tpu.resilience.errors import WorkerLostError, classify_exception
+
+    if args.metrics_out:
+        # Every process records; rank 0 merges after the predict. Enabled
+        # BEFORE init so even the init-retry/degrade counters aggregate.
+        obs.enable()
 
     def degrade_to_solo(e: Exception) -> None:
         err = classify_exception(e, "multihost.init")
@@ -350,6 +371,12 @@ def _worker_main(argv) -> int:
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
 
+    if args.metrics_out:
+        # Fleet aggregation is a COLLECTIVE (process_allgather): every
+        # process must enter it, not just rank 0.
+        from knn_tpu.obs import aggregate
+
+        merged, stragglers = aggregate.aggregate_multihost()
     if rank == 0:  # rank-0 reporting, like mpi.cpp:188-199
         acc = accuracy(confusion_matrix(preds, test.labels, test.num_classes))
         print(
@@ -360,6 +387,22 @@ def _worker_main(argv) -> int:
         )
         if args.dump_predictions:
             np.save(args.dump_predictions, preds)
+        if args.metrics_out and merged is not None:
+            import json
+
+            try:
+                with open(args.metrics_out, "w", encoding="utf-8") as f:
+                    json.dump(
+                        {
+                            "processes": jax.process_count(),
+                            "stragglers": stragglers,
+                            "metrics": merged.to_json(),
+                        },
+                        f, indent=1,
+                    )
+            except OSError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
     return 0
 
 

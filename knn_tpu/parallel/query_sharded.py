@@ -12,6 +12,7 @@ query counts (Gatherv's variable per-rank lengths) become pad + slice
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -23,7 +24,7 @@ from knn_tpu import obs
 from knn_tpu.backends import register
 from knn_tpu.backends.tpu import forward_tiled_core
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.obs.instrument import record_collective
+from knn_tpu.obs.instrument import record_collective, record_shard_dispatch
 from knn_tpu.parallel.mesh import make_mesh, shard_map_compat
 from knn_tpu.resilience.retry import guarded_call
 from knn_tpu.utils.padding import pad_axis_to_multiple
@@ -202,13 +203,16 @@ def _predict_query_sharded_stripe(
             "query-sharded", "scatter_gather",
             model_query_sharded_bytes(qx.shape[0], qx.shape[1]),
         )
+    t0 = time.monotonic()
     with obs.span("dispatch", path="query-sharded", engine="stripe"):
         out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
         ))
     with obs.span("fetch", path="query-sharded"):
-        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
+        preds = guarded_call("collective.step", lambda: np.asarray(out)[:q])
+    record_shard_dispatch("query-sharded", t0)
+    return preds
 
 
 def predict_query_sharded(
@@ -259,13 +263,16 @@ def predict_query_sharded(
             "query-sharded", "scatter_gather",
             model_query_sharded_bytes(qx.shape[0], qx.shape[1]),
         )
+    t0 = time.monotonic()
     with obs.span("dispatch", path="query-sharded", engine="xla"):
         out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(train_x.shape[0], jnp.int32),
         ))
     with obs.span("fetch", path="query-sharded"):
-        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
+        preds = guarded_call("collective.step", lambda: np.asarray(out)[:q])
+    record_shard_dispatch("query-sharded", t0)
+    return preds
 
 
 @register("tpu-sharded")

@@ -28,6 +28,7 @@ Per-step scoring engines (VERDICT r1 #1/#3):
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -40,7 +41,7 @@ from knn_tpu import obs
 from knn_tpu.backends import register
 from knn_tpu.backends.tpu import forward_candidates_core
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.obs.instrument import record_collective
+from knn_tpu.obs.instrument import record_collective, record_shard_dispatch
 from knn_tpu.ops.distance import _DIST_FNS
 from knn_tpu.ops.topk import merge_topk_labeled
 from knn_tpu.ops.vote import vote
@@ -240,13 +241,17 @@ def predict_ring(
                     shard_cols * ty.itemsize, n_dev,
                 ),
             )
+        t0 = time.monotonic()
         with obs.span("dispatch", path="ring", engine="stripe"):
             out = guarded_call("collective.step", lambda: fn(
                 jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
                 jnp.asarray(n, jnp.int32),
             ))
         with obs.span("fetch", path="ring"):
-            return guarded_call("collective.step", lambda: np.asarray(out)[:q])
+            preds = guarded_call(
+                "collective.step", lambda: np.asarray(out)[:q])
+        record_shard_dispatch("ring", t0)
+        return preds
 
     with obs.span("prepare", path="ring", engine=engine):
         if engine == "tiled":
@@ -278,13 +283,16 @@ def predict_ring(
                 shard_rows_eff * ty.itemsize, n_dev,
             ),
         )
+    t0 = time.monotonic()
     with obs.span("dispatch", path="ring", engine=engine):
         out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
         ))
     with obs.span("fetch", path="ring"):
-        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
+        preds = guarded_call("collective.step", lambda: np.asarray(out)[:q])
+    record_shard_dispatch("ring", t0)
+    return preds
 
 
 @register("tpu-ring")

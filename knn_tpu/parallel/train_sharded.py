@@ -13,6 +13,7 @@ rule regardless of shard boundaries — and only then voted on.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -25,7 +26,7 @@ from knn_tpu import obs
 from knn_tpu.backends import register
 from knn_tpu.backends.tpu import forward_candidates_core
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.obs.instrument import record_collective
+from knn_tpu.obs.instrument import record_collective, record_shard_dispatch
 from knn_tpu.ops.vote import vote
 from knn_tpu.parallel.mesh import make_mesh, make_mesh_2d, default_mesh_shape, shard_map_compat
 from knn_tpu.resilience.retry import guarded_call
@@ -221,13 +222,16 @@ def _predict_train_sharded_stripe(
             "train-sharded", "all_gather",
             model_train_sharded_bytes(qx.shape[0] // n_q, k, n_t),
         )
+    t0 = time.monotonic()
     with obs.span("dispatch", path="train-sharded", engine="stripe"):
         out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(txT), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
         ))
     with obs.span("fetch", path="train-sharded"):
-        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
+        preds = guarded_call("collective.step", lambda: np.asarray(out)[:q])
+    record_shard_dispatch("train-sharded", t0)
+    return preds
 
 
 def predict_train_sharded(
@@ -277,13 +281,16 @@ def predict_train_sharded(
             "train-sharded", "all_gather",
             model_train_sharded_bytes(qx.shape[0] // n_q, k, n_t),
         )
+    t0 = time.monotonic()
     with obs.span("dispatch", path="train-sharded", engine="xla"):
         out = guarded_call("collective.step", lambda: fn(
             jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(qx),
             jnp.asarray(train_x.shape[0], jnp.int32),
         ))
     with obs.span("fetch", path="train-sharded"):
-        return guarded_call("collective.step", lambda: np.asarray(out)[:q])
+        preds = guarded_call("collective.step", lambda: np.asarray(out)[:q])
+    record_shard_dispatch("train-sharded", t0)
+    return preds
 
 
 @register("tpu-train-sharded")

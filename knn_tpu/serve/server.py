@@ -27,6 +27,12 @@ Endpoint contract (docs/SERVING.md):
   recorder's last-N / slowest-K per-request timelines
   (``?id=<request_id>`` resolves one, ``?format=perfetto`` exports
   Chrome ``trace_event`` JSON — docs/OBSERVABILITY.md).
+- ``GET /debug/profile?ms=N`` → an on-demand ``jax.profiler`` capture
+  (``obs/devprof.py``): the handler holds the window open for N ms
+  (default 200, cap 10 s) while the other handler threads keep serving,
+  then returns ONE Perfetto-loadable trace in which the serve host spans
+  (via the tracer's ``TraceAnnotation`` pass-through) and the device/XLA
+  events share a time axis. 409 while another capture runs.
 
 Every request is tagged with a **request id** — the ``x-request-id``
 header when the client sent a valid one (≤128 printable ASCII chars;
@@ -345,10 +351,24 @@ class ServeApp:
             # export() also refreshes the knn_slo_* gauges, so a /healthz
             # poller keeps them current between /metrics scrapes.
             "slo": self.slo.export(),
+            "device": self._device_block(),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
         return h
+
+    @staticmethod
+    def _device_block() -> dict:
+        """The device-side health summary (obs/devprof.py): memory per
+        device (also refreshing the knn_device_memory_bytes gauges),
+        compile events/walls, executable-cache hit/miss."""
+        from knn_tpu.obs import devprof
+
+        return {
+            "memory": devprof.record_device_memory(),
+            "compile": devprof.compile_summary(),
+            "executable_cache": devprof.executable_cache_summary(),
+        }
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -430,8 +450,12 @@ class _Handler(BaseHTTPRequestHandler):
             ok = h["ready"] and not h["draining"]
             self._send(200 if ok else 503, h)
         elif route == "/metrics":
-            # Refresh the scrape-time gauges (knn_slo_*) before rendering.
+            # Refresh the scrape-time gauges (knn_slo_* and
+            # knn_device_memory_bytes) before rendering.
             self.app.slo.export()
+            from knn_tpu.obs import devprof
+
+            devprof.record_device_memory()
             accept = self.headers.get("Accept", "")
             if "application/openmetrics-text" in accept:
                 self._send_text(
@@ -446,8 +470,46 @@ class _Handler(BaseHTTPRequestHandler):
                 )
         elif route in ("/debug/requests", "/debug/slowest"):
             self._do_debug(route)
+        elif route == "/debug/profile":
+            self._do_profile()
         else:
             self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def _do_profile(self):
+        """On-demand device profile: ``?ms=N`` holds a ``jax.profiler``
+        capture open for N ms on THIS handler thread (the other threads
+        keep dispatching — their spans/annotations and XLA events are the
+        payload), then returns the merged Chrome ``trace_event`` JSON.
+        One capture at a time (409); the window is capped so a typo'd
+        ``ms`` cannot pin the capture lock for minutes."""
+        from knn_tpu.obs import devprof
+
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            ms = float(q.get("ms", ["200"])[0])
+            if not math.isfinite(ms) or ms < 0:
+                raise ValueError
+        except ValueError:
+            self._send(400, {"error": f"bad ms={q.get('ms', [''])[0]!r}: "
+                                      f"want a number of milliseconds >= 0"})
+            return
+        if ms > devprof.MAX_CAPTURE_MS:
+            self._send(400, {"error": f"ms={ms:.0f} exceeds the "
+                                      f"{devprof.MAX_CAPTURE_MS} ms capture "
+                                      f"bound"})
+            return
+        try:
+            trace = devprof.capture_for(ms)
+        except devprof.CaptureBusy as e:
+            self._send(409, {"error": str(e)})
+            return
+        # Compact separators: a capture under load easily holds 10^5
+        # events, and the default pretty separators add ~20% to a payload
+        # that is already the biggest thing this server ever sends. (No
+        # request-id stamping either — the payload is a timeline about
+        # OTHER requests, the /debug/requests rule.)
+        self._send_text(200, json.dumps(trace, separators=(",", ":")),
+                        "application/json")
 
     def _do_debug(self, route: str):
         """The flight recorder's read side: ``/debug/requests`` (last-N

@@ -1,0 +1,144 @@
+"""Perf-regression gate (`make bench-gate`): measure a fresh bench gate
+record and compare it against the committed baseline with the noise-aware
+best-of-mins + MAD-tolerance rule (knn_tpu/obs/regress.py).
+
+Flow:
+
+1. Fresh record: ``bench.bench_gate_config()`` (or ``--fresh FILE`` to
+   gate a pre-measured/synthetic record — what the tests and the
+   "synthetically slowed" acceptance leg use).
+2. Baseline: ``BENCH_GATE_BASELINE.json`` at the repo root — a map of
+   environment-fingerprint keys (``{platform}-{device_kind}-cpu{N}``) to
+   gate records, because trial lists measured on a v5e say nothing about
+   a 2-vCPU CI runner. No entry for this environment → the gate reports
+   ``no-baseline`` and exits 0 (with the fresh record written as a
+   candidate), because failing every new box would train people to delete
+   the gate; ``--write-baseline`` records this environment's entry.
+3. Verdict JSON (``pass``, per-metric checks, params) goes to ``--out``
+   (default ``build/bench_gate_verdict.json``) — the artifact CI uploads.
+
+Exit 0 = pass / no-baseline / baseline-written; 1 = a gated metric
+regressed past its tolerance; 2 = usage (unreadable files).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_BASELINE = REPO / "BENCH_GATE_BASELINE.json"
+DEFAULT_OUT = REPO / "build" / "bench_gate_verdict.json"
+
+
+def env_key(record: dict) -> str:
+    env = record.get("env") or {}
+    return (f"{env.get('platform', '?')}-{env.get('device_kind', '?')}"
+            f"-cpu{env.get('cpus', '?')}").replace(" ", "_")
+
+
+def write_json(path: Path, doc: dict) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=1) + "\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_gate.py")
+    p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                   help="committed baseline file (per-environment entries)")
+    p.add_argument("--fresh", default=None, metavar="FILE",
+                   help="gate a pre-measured record instead of measuring "
+                   "(tests / synthetic-regression legs)")
+    p.add_argument("--out", default=str(DEFAULT_OUT),
+                   help="verdict JSON destination")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record the fresh measurement as this "
+                   "environment's baseline entry and exit 0")
+    p.add_argument("--rel-tol", type=float, default=None,
+                   help="relative tolerance (default: obs/regress.py's)")
+    p.add_argument("--mad-k", type=float, default=None,
+                   help="baseline-MAD multiples of tolerance")
+    args = p.parse_args(argv)
+
+    from knn_tpu.obs import regress
+
+    rel_tol = (regress.DEFAULT_REL_TOL if args.rel_tol is None
+               else args.rel_tol)
+    mad_k = regress.DEFAULT_MAD_K if args.mad_k is None else args.mad_k
+
+    if args.fresh:
+        try:
+            fresh = json.loads(Path(args.fresh).read_text())
+        except (OSError, ValueError) as e:
+            print(f"bench-gate: error: --fresh {args.fresh}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        import bench
+
+        print("bench-gate: measuring the fresh gate record "
+              "(bench.bench_gate_config)...", file=sys.stderr)
+        fresh = bench.bench_gate_config()
+
+    key = env_key(fresh)
+    baseline_path = Path(args.baseline)
+    entries = {}
+    if baseline_path.exists():
+        try:
+            entries = json.loads(baseline_path.read_text()).get("entries", {})
+        except (OSError, ValueError) as e:
+            print(f"bench-gate: error: unreadable baseline "
+                  f"{baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        entries[key] = fresh
+        write_json(baseline_path, {
+            "comment": "bench-gate baselines, one entry per environment "
+                       "fingerprint (scripts/bench_gate.py "
+                       "--write-baseline refreshes the current one)",
+            "entries": entries,
+        })
+        write_json(Path(args.out), {
+            "status": "baseline-written", "pass": True, "env": key,
+        })
+        print(f"bench-gate: baseline entry written for {key} -> "
+              f"{baseline_path}")
+        return 0
+
+    baseline = entries.get(key)
+    if baseline is None:
+        candidate = REPO / "build" / "bench_gate_candidate.json"
+        write_json(candidate, fresh)
+        write_json(Path(args.out), {
+            "status": "no-baseline", "pass": True, "env": key,
+            "known_envs": sorted(entries),
+            "note": f"no committed baseline for this environment; fresh "
+                    f"record saved to {candidate} (commit it with "
+                    f"--write-baseline to arm the gate here)",
+        })
+        print(f"bench-gate: no baseline for env {key} (known: "
+              f"{sorted(entries)}); PASS (unarmed), candidate saved")
+        return 0
+
+    verdict = regress.compare_records(baseline, fresh, rel_tol=rel_tol,
+                                      mad_k=mad_k)
+    verdict["status"] = "compared"
+    verdict["env"] = key
+    write_json(Path(args.out), verdict)
+    print(regress.summarize(verdict))
+    if not verdict["pass"]:
+        print(f"bench-gate: FAIL — regression past tolerance "
+              f"(verdict: {args.out})", file=sys.stderr)
+        return 1
+    print(f"bench-gate: PASS ({len(verdict['checks'])} metrics within "
+          f"tolerance; verdict: {args.out})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,31 @@ def main() -> int:
     print("disabled-overhead: functional ok (0 spans, 0 instruments, "
           "no request traces)")
 
+    # -- 1b. the device-side layer (obs/devprof.py) off-state --------------
+    # Even with the compile listener having been registered by a PRIOR
+    # enable (jax.monitoring offers no unregister), a disabled process
+    # must record nothing: force the listener in, compile a fresh shape,
+    # sample device memory, probe the executable-cache tracker.
+    from knn_tpu.obs import devprof
+
+    devprof.install_compile_listeners()
+    import jax
+    import jax.numpy as jnp
+
+    jax.jit(lambda x: x * 2 + 1)(jnp.ones((17, 3))).block_until_ready()
+    devprof.record_device_memory()
+    if devprof.record_executable_lookup("gate", ("probe",)) != "off":
+        return fail("devprof.record_executable_lookup tracked a signature "
+                    "while disabled")
+    instruments = obs.registry().instruments()
+    if instruments:
+        return fail(f"devprof recorded {len(instruments)} instrument(s) "
+                    f"while disabled (first: {instruments[0].name!r}) — "
+                    f"the compile listener / memory gauges must gate on "
+                    f"obs.enabled()")
+    print("disabled-overhead: devprof off-state ok (compile listener, "
+          "memory sample, cache tracker all recorded nothing)")
+
     # -- 2. timing: best-of mins under the budget --------------------------
     budget_ms = float(os.environ.get("KNN_TPU_OVERHEAD_BUDGET_MS", "60"))
     walls = []

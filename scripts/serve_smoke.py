@@ -51,7 +51,7 @@ def fail(msg: str, proc: "subprocess.Popen | None" = None) -> "int":
     return 1
 
 
-def request(base: str, path: str, payload=None, headers=None):
+def request(base: str, path: str, payload=None, headers=None, timeout=30):
     hdrs = {"Content-Type": "application/json"} if payload else {}
     hdrs.update(headers or {})
     req = urllib.request.Request(
@@ -60,7 +60,7 @@ def request(base: str, path: str, payload=None, headers=None):
         headers=hdrs,
     )
     try:
-        with urllib.request.urlopen(req, timeout=30) as r:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, r.read().decode(), dict(r.headers)
     except urllib.error.HTTPError as e:
         return e.code, e.read().decode(), dict(e.headers)
@@ -228,6 +228,72 @@ def main() -> int:
             print(f"serve-smoke: /debug ok (timeline for {rid} resolved, "
                   f"phases {[p['phase'] for p in tl['phases']]}, perfetto "
                   f"{len(ev)} events)")
+
+            # Device observability (PR 6): knn_device_memory_bytes gauges
+            # in the scrape, and /debug/profile returning ONE
+            # Perfetto-loadable trace that carries both serve host spans
+            # (TraceAnnotation pass-through) and device-side events —
+            # captured UNDER LOAD from a background client thread. The
+            # trace is saved to build/ so CI can upload it as an artifact.
+            st, metrics, _ = request(base, "/metrics")
+            if st != 200 or "knn_device_memory_bytes" not in metrics:
+                return fail(f"/metrics missing knn_device_memory_bytes "
+                            f"({st})", proc)
+            dev = json.loads(request(base, "/healthz")[1]).get("device") or {}
+            if "memory" not in dev or "executable_cache" not in dev:
+                return fail(f"/healthz missing the device block: {dev}",
+                            proc)
+            stop_load = threading.Event()
+
+            def load_loop():
+                while not stop_load.is_set():
+                    try:
+                        request(base, "/predict",
+                                {"instances": rows[:2].tolist()})
+                    except Exception:  # noqa: BLE001 — load gen best-effort
+                        pass
+                    # Gentle load: the point is spans inside the window,
+                    # not saturating the CI box while the profiler's
+                    # xplane->trace conversion competes for the same cores.
+                    time.sleep(0.01)
+
+            loader = threading.Thread(target=load_loop, daemon=True)
+            loader.start()
+            try:
+                st, body, _ = request(base, "/debug/profile?ms=150",
+                                      timeout=180)
+            finally:
+                stop_load.set()
+                loader.join(timeout=10)
+            if st != 200:
+                return fail(f"/debug/profile {st}: {body[:200]}", proc)
+            trace = json.loads(body)
+            ev_names = {e.get("name", "") for e in
+                        trace.get("traceEvents", ()) if isinstance(e, dict)}
+            if not ev_names:
+                return fail("/debug/profile returned an empty trace", proc)
+            serve_spans = [n for n in ev_names if n.startswith("serve.")]
+            device_evs = [n for n in ev_names
+                          if not n.startswith(("serve.", "$"))
+                          and n not in ("", "process_name", "thread_name",
+                                        "process_sort_index",
+                                        "thread_sort_index")]
+            if trace["otherData"].get("source") == "jax.profiler" and (
+                    not serve_spans or not device_evs):
+                return fail(f"/debug/profile trace lacks serve spans "
+                            f"({serve_spans[:3]}) or device events "
+                            f"({device_evs[:3]})", proc)
+            out = REPO / "build" / "serve-profile-trace.json"
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_text(body)
+            st_bad, body_bad, _ = request(base, "/debug/profile?ms=notanum")
+            if st_bad != 400:
+                return fail(f"/debug/profile?ms=notanum: want 400, got "
+                            f"{st_bad}", proc)
+            print(f"serve-smoke: /debug/profile ok "
+                  f"({len(trace['traceEvents'])} events, "
+                  f"source={trace['otherData'].get('source')}, serve spans "
+                  f"{serve_spans[:3]}, saved to {out.name})")
 
             # Oversized x-request-id: 400, never a traceback.
             st, body, _ = request(base, "/predict",

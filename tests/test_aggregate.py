@@ -1,0 +1,216 @@
+"""Fleet aggregation (knn_tpu/obs/aggregate.py): registry snapshots,
+proc-labeled merges, straggler math — pinned with fake registries where
+jaxlib lacks multi-process collectives (the ISSUE 6 acceptance contract)
+— plus the per-strategy knn_shard_dispatch_ms gauges the straggler
+signal is built from.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.obs import aggregate
+from knn_tpu.obs.metrics import MetricsRegistry
+
+
+@pytest.fixture()
+def global_obs():
+    obs.reset()
+    obs.enable()
+    yield obs
+    obs.disable()
+    obs.reset()
+
+
+def make_proc_registry(proc: int, dispatch_ms: dict) -> MetricsRegistry:
+    """A fake per-process registry with the instrument mix the real
+    strategies record."""
+    reg = MetricsRegistry()
+    reg.counter("knn_predict_calls_total", backend="tpu").add(10 + proc)
+    reg.gauge("knn_predict_qps", backend="tpu").set(100.0 * (proc + 1))
+    h = reg.histogram("knn_predict_wall_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0 + proc):
+        h.observe(v)
+    for path, ms in dispatch_ms.items():
+        reg.gauge("knn_shard_dispatch_ms", path=path).set(ms)
+    return reg
+
+
+class TestSnapshot:
+    def test_round_trips_all_kinds(self):
+        reg = make_proc_registry(0, {"ring": 12.5})
+        snap = aggregate.snapshot_registry(reg)
+        by_name = {r["name"]: r for r in snap}
+        assert by_name["knn_predict_calls_total"]["value"] == 10
+        assert by_name["knn_predict_qps"]["value"] == 100.0
+        h = by_name["knn_predict_wall_ms"]
+        assert h["buckets"] == [1.0, 10.0, 100.0]
+        assert h["counts"] == [1, 1, 1, 0]  # raw, incl. +Inf overflow
+        assert h["count"] == 3
+        assert by_name["knn_shard_dispatch_ms"]["labels"] == {"path": "ring"}
+
+    def test_json_round_trip(self):
+        import json
+
+        snap = aggregate.snapshot_registry(make_proc_registry(1, {}))
+        assert json.loads(json.dumps(snap)) == snap
+
+
+class TestMerge:
+    def test_merge_adds_proc_labels_and_preserves_values(self):
+        snaps = {
+            p: aggregate.snapshot_registry(make_proc_registry(p, {}))
+            for p in (0, 1, 2)
+        }
+        merged = aggregate.merge_snapshots(snaps)
+        counters = {
+            dict(i.labels)["proc"]: i.value
+            for i in merged.instruments()
+            if i.name == "knn_predict_calls_total"
+        }
+        assert counters == {"0": 10, "1": 11, "2": 12}
+        # Per-proc attribution survives: nothing was summed across procs.
+        gauges = {
+            dict(i.labels)["proc"]: i.value
+            for i in merged.instruments()
+            if i.name == "knn_predict_qps"
+        }
+        assert gauges == {"0": 100.0, "1": 200.0, "2": 300.0}
+
+    def test_histogram_merge_exact(self):
+        snaps = {
+            p: aggregate.snapshot_registry(make_proc_registry(p, {}))
+            for p in (0, 1)
+        }
+        merged = aggregate.merge_snapshots(snaps)
+        hists = [i for i in merged.instruments()
+                 if i.name == "knn_predict_wall_ms"]
+        assert len(hists) == 2
+        for h in hists:
+            assert h.count == 3
+            assert h.bucket_counts() == [1, 1, 1, 0]
+
+    def test_merge_into_shared_registry_twice_accumulates_counters(self):
+        reg = MetricsRegistry()
+        snap = aggregate.snapshot_registry(make_proc_registry(0, {}))
+        aggregate.merge_snapshots({0: snap}, registry=reg)
+        aggregate.merge_snapshots({0: snap}, registry=reg)
+        c = [i for i in reg.instruments()
+             if i.name == "knn_predict_calls_total"][0]
+        assert c.value == 20  # counters add; the caller owns merge cadence
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            aggregate.merge_snapshots({0: [{
+                "name": "x", "kind": "mystery", "labels": {}, "value": 1,
+            }]})
+
+
+class TestStragglers:
+    def test_max_min_skew_per_path(self):
+        snaps = {
+            0: aggregate.snapshot_registry(
+                make_proc_registry(0, {"ring": 10.0, "query-sharded": 5.0})),
+            1: aggregate.snapshot_registry(
+                make_proc_registry(1, {"ring": 40.0, "query-sharded": 5.0})),
+        }
+        merged = aggregate.merge_snapshots(snaps)
+        out = aggregate.straggler_gauges(snaps, merged)
+        assert out["ring"] == {
+            "max_ms": 40.0, "min_ms": 10.0, "skew": 4.0, "max_proc": 1,
+            "procs": 2,
+        }
+        assert out["query-sharded"]["skew"] == 1.0
+        gauges = {
+            (i.name, dict(i.labels)["path"]): i.value
+            for i in merged.instruments()
+            if i.name.startswith("knn_shard_dispatch_")
+            and "proc" not in dict(i.labels)
+        }
+        assert gauges[("knn_shard_dispatch_ms_max", "ring")] == 40.0
+        assert gauges[("knn_shard_dispatch_ms_min", "ring")] == 10.0
+        assert gauges[("knn_shard_dispatch_skew", "ring")] == 4.0
+
+    def test_zero_min_stays_finite(self):
+        # The gauge rounds walls to 3 decimals, so a sub-µs wall stores
+        # 0.0 — the skew must clamp to the rounding floor (finite, JSON-
+        # safe), never float('inf').
+        import json
+        import math
+
+        snaps = {
+            0: aggregate.snapshot_registry(
+                make_proc_registry(0, {"ring": 0.0})),
+            1: aggregate.snapshot_registry(
+                make_proc_registry(1, {"ring": 2.0})),
+        }
+        merged = aggregate.merge_snapshots(snaps)
+        out = aggregate.straggler_gauges(snaps, merged)
+        assert math.isfinite(out["ring"]["skew"])
+        assert out["ring"]["skew"] == 2.0 / 0.001
+        json.loads(json.dumps(out, allow_nan=False))  # strict-JSON safe
+        both_zero = {
+            0: aggregate.snapshot_registry(
+                make_proc_registry(0, {"ring": 0.0})),
+        }
+        merged2 = aggregate.merge_snapshots(both_zero)
+        assert aggregate.straggler_gauges(
+            both_zero, merged2)["ring"]["skew"] == 1.0
+
+    def test_paths_without_dispatch_absent(self):
+        snaps = {0: aggregate.snapshot_registry(make_proc_registry(0, {}))}
+        merged = aggregate.merge_snapshots(snaps)
+        assert aggregate.straggler_gauges(snaps, merged) == {}
+
+
+class TestSingleProcessAggregate:
+    def test_aggregate_multihost_solo(self, global_obs):
+        obs.gauge_set("knn_shard_dispatch_ms", 7.0, path="ring")
+        merged, stragglers = aggregate.aggregate_multihost()
+        assert merged is not None
+        assert stragglers["ring"]["procs"] == 1
+        procs = {dict(i.labels).get("proc") for i in merged.instruments()
+                 if i.name == "knn_shard_dispatch_ms"}
+        assert procs == {"0"}
+
+
+class TestStrategiesRecordDispatchGauge:
+    """Each sharded strategy must feed the straggler signal."""
+
+    @pytest.fixture()
+    def toy(self, rng):
+        tx = rng.random((64, 7), np.float32)
+        ty = rng.integers(0, 3, 64).astype(np.int32)
+        qx = rng.random((16, 7), np.float32)
+        return tx, ty, qx
+
+    def _gauge_paths(self):
+        return {
+            dict(i.labels)["path"]
+            for i in obs.registry().instruments()
+            if i.name == "knn_shard_dispatch_ms"
+        }
+
+    def test_query_sharded(self, global_obs, toy):
+        from knn_tpu.parallel.query_sharded import predict_query_sharded
+
+        tx, ty, qx = toy
+        predict_query_sharded(tx, ty, qx, 3, 3, num_devices=2, engine="xla")
+        assert "query-sharded" in self._gauge_paths()
+
+    def test_train_sharded(self, global_obs, toy):
+        from knn_tpu.parallel.train_sharded import predict_train_sharded
+
+        tx, ty, qx = toy
+        predict_train_sharded(tx, ty, qx, 3, 3, num_devices=2,
+                              mesh_shape=(1, 2), engine="xla")
+        assert "train-sharded" in self._gauge_paths()
+
+    def test_ring(self, global_obs, toy):
+        from knn_tpu.parallel.ring import predict_ring
+
+        tx, ty, qx = toy
+        predict_ring(tx, ty, qx, 3, 3, num_devices=2, engine="full")
+        assert "ring" in self._gauge_paths()

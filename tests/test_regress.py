@@ -1,0 +1,214 @@
+"""Perf-regression gate (knn_tpu/obs/regress.py + scripts/bench_gate.py):
+the best-of-mins + MAD-tolerance rule — clean pass, injected regression
+fails, noise within the MAD tolerance passes (ISSUE 6 acceptance).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from knn_tpu.obs import regress
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def record(metrics: dict) -> dict:
+    return {
+        "env": {"platform": "cpu", "device_kind": "cpu", "cpus": 2},
+        "metrics": {
+            name: {"trials": trials, "direction": direction, "unit": "ms"}
+            for name, (trials, direction) in metrics.items()
+        },
+    }
+
+
+class TestMad:
+    def test_median_and_mad(self):
+        assert regress.median([3.0, 1.0, 2.0]) == 2.0
+        assert regress.median([4.0, 1.0, 2.0, 3.0]) == 2.5
+        assert regress.mad([10.0, 12.0, 11.0, 50.0]) == 1.0  # robust to 50
+        assert regress.mad([7.0]) == 0.0
+
+
+class TestCompareMetric:
+    BASE = [10.0, 10.5, 11.0, 10.2, 10.8]  # best 10, MAD 0.3
+
+    def test_clean_pass(self):
+        c = regress.compare_metric("m", self.BASE, [10.1, 10.4, 10.9])
+        assert not c["regressed"] and not c["improved"]
+
+    def test_injected_regression_fails(self):
+        c = regress.compare_metric("m", self.BASE,
+                                   [t * 2 for t in self.BASE])
+        assert c["regressed"]
+        assert c["delta"] == pytest.approx(10.0)
+
+    def test_noise_within_mad_tolerance_passes(self):
+        # tolerance = max(5% * 10, 5 * MAD 0.3, 0.5 floor) = 1.5 ms;
+        # +1.2 ms of noise on the best must NOT gate...
+        c = regress.compare_metric("m", self.BASE, [11.2, 11.4, 11.3])
+        assert c["tolerance"] == pytest.approx(1.5)
+        assert not c["regressed"]
+        # ...and just past it does.
+        c2 = regress.compare_metric("m", self.BASE, [11.6, 12.0])
+        assert c2["regressed"]
+
+    def test_higher_is_better_direction(self):
+        base = [100.0, 98.0, 99.0]  # qps-style
+        ok = regress.compare_metric("q", base, [97.0, 96.5],
+                                    direction="higher")
+        assert not ok["regressed"]  # within 5% of 100
+        bad = regress.compare_metric("q", base, [50.0, 49.0],
+                                     direction="higher")
+        assert bad["regressed"]
+
+    def test_improvement_reported_not_failed(self):
+        c = regress.compare_metric("m", self.BASE, [5.0, 5.2])
+        assert c["improved"] and not c["regressed"]
+
+    def test_abs_floor_shields_microsecond_metrics(self):
+        c = regress.compare_metric("m", [0.01, 0.012], [0.3, 0.31])
+        assert not c["regressed"]  # 0.29 ms delta < 0.5 ms floor
+
+    def test_empty_trials_fail(self):
+        assert regress.compare_metric("m", [], [1.0])["regressed"]
+        assert regress.compare_metric("m", [1.0], [])["regressed"]
+
+    def test_bad_direction_raises(self):
+        with pytest.raises(ValueError):
+            regress.compare_metric("m", [1.0], [1.0], direction="sideways")
+
+
+class TestCompareRecords:
+    def test_verdict_shape_and_pass(self):
+        base = record({"a": ([10.0, 10.1], "lower"),
+                       "b": ([5.0, 5.1], "lower")})
+        v = regress.compare_records(base, base)
+        assert v["pass"] is True
+        assert len(v["checks"]) == 2
+        assert v["new_metrics"] == []
+
+    def test_missing_metric_fails(self):
+        base = record({"a": ([10.0], "lower"), "b": ([5.0], "lower")})
+        fresh = record({"a": ([10.0], "lower")})
+        v = regress.compare_records(base, fresh)
+        assert v["pass"] is False
+        missing = [c for c in v["checks"] if "reason" in c]
+        assert missing and missing[0]["metric"] == "b"
+
+    def test_new_metric_reported_not_gated(self):
+        base = record({"a": ([10.0], "lower")})
+        fresh = record({"a": ([10.0], "lower"), "c": ([1.0], "lower")})
+        v = regress.compare_records(base, fresh)
+        assert v["pass"] is True
+        assert v["new_metrics"] == ["c"]
+
+    def test_summarize_names_the_regression(self):
+        base = record({"a": ([10.0, 10.1], "lower")})
+        fresh = record({"a": ([30.0, 30.5], "lower")})
+        v = regress.compare_records(base, fresh)
+        assert "REGRESSED a:" in regress.summarize(v)
+
+
+class TestBenchGateScript:
+    """scripts/bench_gate.py exit-code contract, driven with --fresh
+    records so no measurement runs."""
+
+    @pytest.fixture()
+    def gate(self):
+        sys.path.insert(0, str(REPO / "scripts"))
+        import bench_gate
+
+        return bench_gate
+
+    def _write(self, path: Path, doc: dict) -> str:
+        path.write_text(json.dumps(doc))
+        return str(path)
+
+    def test_clean_run_passes(self, gate, tmp_path):
+        rec = record({"a": ([10.0, 10.3, 10.1], "lower")})
+        baseline = self._write(tmp_path / "base.json",
+                               {"entries": {gate.env_key(rec): rec}})
+        out = tmp_path / "verdict.json"
+        rc = gate.main(["--fresh", self._write(tmp_path / "fresh.json", rec),
+                        "--baseline", baseline, "--out", str(out)])
+        assert rc == 0
+        verdict = json.loads(out.read_text())
+        assert verdict["pass"] is True and verdict["status"] == "compared"
+
+    def test_synthetically_slowed_record_fails(self, gate, tmp_path):
+        rec = record({"a": ([10.0, 10.3, 10.1], "lower")})
+        slow = record({"a": ([20.0, 20.6, 20.2], "lower")})
+        baseline = self._write(tmp_path / "base.json",
+                               {"entries": {gate.env_key(rec): rec}})
+        out = tmp_path / "verdict.json"
+        rc = gate.main(["--fresh", self._write(tmp_path / "slow.json", slow),
+                        "--baseline", baseline, "--out", str(out)])
+        assert rc == 1
+        verdict = json.loads(out.read_text())
+        assert verdict["pass"] is False
+        assert verdict["checks"][0]["regressed"]
+
+    def test_unknown_env_passes_unarmed(self, gate, tmp_path):
+        rec = record({"a": ([10.0], "lower")})
+        rec["env"]["device_kind"] = "never-seen-device"
+        baseline = self._write(tmp_path / "base.json", {"entries": {}})
+        out = tmp_path / "verdict.json"
+        rc = gate.main(["--fresh", self._write(tmp_path / "f.json", rec),
+                        "--baseline", baseline, "--out", str(out)])
+        assert rc == 0
+        assert json.loads(out.read_text())["status"] == "no-baseline"
+
+    def test_write_baseline_then_compare(self, gate, tmp_path):
+        rec = record({"a": ([10.0, 10.2], "lower")})
+        baseline = tmp_path / "base.json"
+        out = tmp_path / "verdict.json"
+        fresh = self._write(tmp_path / "f.json", rec)
+        rc = gate.main(["--fresh", fresh, "--baseline", str(baseline),
+                        "--out", str(out), "--write-baseline"])
+        assert rc == 0
+        assert gate.env_key(rec) in json.loads(
+            baseline.read_text())["entries"]
+        rc = gate.main(["--fresh", fresh, "--baseline", str(baseline),
+                        "--out", str(out)])
+        assert rc == 0
+
+    def test_unreadable_fresh_exits_2(self, gate, tmp_path):
+        rc = gate.main(["--fresh", str(tmp_path / "absent.json"),
+                        "--out", str(tmp_path / "v.json")])
+        assert rc == 2
+
+    def test_committed_baseline_is_valid(self, gate):
+        """The repo's committed baseline parses and carries trial lists."""
+        doc = json.loads((REPO / "BENCH_GATE_BASELINE.json").read_text())
+        assert doc["entries"]
+        for rec in doc["entries"].values():
+            for m in rec["metrics"].values():
+                assert m["trials"], "baseline metric with no trials"
+
+
+class TestCommAuditParseError:
+    """ISSUE 6 satellite: an empty collective parse raises the DISTINCT
+    format-changed error, and the unquoted StableHLO spelling parses."""
+
+    def test_unquoted_spelling_parses(self):
+        from knn_tpu.parallel import comm_audit
+
+        text = ('%3 = stablehlo.all_gather(%2) {dims = [1]} : '
+                '(tensor<4x15xf32>) -> tensor<4x30xf32>')
+        ops = comm_audit.collective_ops(text)
+        assert ops == [("all_gather", (4, 30), "f32", 4 * 30 * 4)]
+
+    def test_empty_parse_raises_distinct_error(self):
+        from knn_tpu.parallel import comm_audit
+
+        with pytest.raises(comm_audit.CollectiveParseError,
+                           match="lowering format changed"):
+            comm_audit.audit_train_sharded("no collectives here", 4, 3, 2)
+        with pytest.raises(comm_audit.CollectiveParseError,
+                           match="lowering format changed"):
+            comm_audit.audit_ring("nothing", 100, 10, 2)
