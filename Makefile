@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -95,6 +95,17 @@ serve-smoke:
 chaos-soak:
 	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/chaos_soak.py \
 		--short --perfetto-out build/chaos-soak-trace.json
+
+# The answer-quality gate (docs/OBSERVABILITY.md §Quality & drift): boot
+# the server with shadow scoring at rate 1.0 under the chaos-soak fault
+# burst and assert (1) the recall SLI holds exactly 1.0 across every
+# exact rung the burst exercised — any divergence is a real bug — then
+# (2) inject index corruption via the SIGUSR2 test hook and assert the
+# quality burn rate rises and /debug/quality localizes it to the
+# answering rung. The verdict JSON lands in build/ (CI uploads it).
+quality-soak:
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/quality_soak.py \
+		--short --json-out build/quality-soak-verdict.json
 
 bench:
 	python3 bench.py

@@ -152,6 +152,25 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--slo-windows", default=None, metavar="S1,S2,...",
                    help="burn-rate windows in seconds (default: 300,3600 — "
                    "the 5m/1h pair)")
+    p.add_argument("--shadow-rate", type=float, default=0.0,
+                   help="shadow-score this fraction of served requests "
+                   "against the exact oracle rung in a background worker "
+                   "(recall/accuracy SLIs, knn_quality_* metrics, "
+                   "/debug/quality — docs/OBSERVABILITY.md §Quality & "
+                   "drift); 0 (default) disables the layer entirely")
+    p.add_argument("--drift-rate", type=float, default=0.0,
+                   help="fold this fraction of served query rows into the "
+                   "query-drift sketch, scored against the artifact's "
+                   "training sketch (knn_drift_* gauges); 0 disables")
+    p.add_argument("--quality-queue", type=int, default=256,
+                   help="bounded shadow/drift sample queue: a full queue "
+                   "sheds samples (counted), never blocks serving")
+    p.add_argument("--quality-seed", type=int, default=0,
+                   help="RNG seed for shadow/drift sampling (deterministic "
+                   "sample selection in soak gates)")
+    p.add_argument("--slo-quality-target", type=float, default=0.999,
+                   help="quality SLO: target fraction of shadow-scored "
+                   "requests whose answers match the oracle rung exactly")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -523,6 +542,15 @@ def _run_serve(args, stdout) -> int:
          f"{args.slo_fast_rung_target}"),
         (args.slo_latency_ms <= 0,
          f"--slo-latency-ms must be > 0, got {args.slo_latency_ms}"),
+        (not 0 <= args.shadow_rate <= 1,
+         f"--shadow-rate must be in [0, 1], got {args.shadow_rate}"),
+        (not 0 <= args.drift_rate <= 1,
+         f"--drift-rate must be in [0, 1], got {args.drift_rate}"),
+        (args.quality_queue < 1,
+         f"--quality-queue must be >= 1, got {args.quality_queue}"),
+        (not 0 < args.slo_quality_target < 1,
+         f"--slo-quality-target must be in (0, 1), got "
+         f"{args.slo_quality_target}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -561,7 +589,8 @@ def _run_serve(args, stdout) -> int:
 
     try:
         model = artifact.load_index(args.index)
-        version = artifact.index_version(artifact.read_manifest(args.index))
+        manifest = artifact.read_manifest(args.index)
+        version = artifact.index_version(manifest)
     except DataError as e:
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
@@ -575,6 +604,7 @@ def _run_serve(args, stdout) -> int:
         latency_target_ms=args.slo_latency_ms,
         latency_target=args.slo_latency_target,
         fast_rung_target=args.slo_fast_rung_target,
+        quality_target=args.slo_quality_target,
         windows_s=slo_windows or DEFAULT_WINDOWS_S,
     )
     try:
@@ -584,9 +614,15 @@ def _run_serve(args, stdout) -> int:
             index_path=args.index, index_version=version,
             flight_recorder_size=args.flight_recorder_size,
             slowest_k=args.slowest_k, access_log=args.access_log, slo=slo,
+            shadow_rate=args.shadow_rate, drift_rate=args.drift_rate,
+            quality_queue=args.quality_queue, quality_seed=args.quality_seed,
+            reference_sketch=artifact.reference_sketch(manifest),
         )
     except OSError as e:  # an unwritable --access-log path
         print(f"error: --access-log {args.access_log}: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    except ValueError as e:  # a malformed/mismatched manifest drift sketch
+        print(f"error: {args.index}: {e}", file=sys.stderr)
         return EXIT_USAGE
     try:
         server = make_server(app, args.host, args.port)

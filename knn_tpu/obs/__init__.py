@@ -21,7 +21,17 @@ pair around the KNN loop printed as a single milliseconds number
   ``/debug/requests``/``/debug/slowest``, per-request Perfetto export,
   and the active-context channel the breaker/ladder emit through;
 - :mod:`knn_tpu.obs.slo`     — SLO objectives and multi-window
-  error-budget burn rates (``knn_slo_*`` gauges);
+  error-budget burn rates (``knn_slo_*`` gauges), including the
+  shadow-scored ``quality`` objective;
+- :mod:`knn_tpu.obs.quality` — shadow-scored answer quality: sampled
+  serving requests re-answered on the oracle rung off the hot path,
+  streaming recall@k + vote agreement attributed per answering rung
+  (``knn_quality_*``, ``GET /debug/quality``);
+- :mod:`knn_tpu.obs.drift`   — query-distribution drift: streaming
+  per-feature Welford/P² sketches scored against the training-set
+  reference sketch stored in the index artifact (``knn_drift_*``);
+  both quality layers ride :mod:`knn_tpu.obs.shedqueue`'s bounded
+  shed-on-overload sample queue (the never-block-serving primitive);
 - :mod:`knn_tpu.obs.devprof` — the device-side half: ``jax.profiler``
   capture sessions (``--profile-out``, ``/debug/profile``),
   ``knn_device_memory_bytes`` gauges, compile-event counters/walls via

@@ -7,8 +7,9 @@ error budget is being spent — the Monarch/SRE-workbook alerting shape
 in hours, page someone", and multi-window (a short and a long window
 together) separates a real incident from one bad scrape.
 
-Three serving SLIs, recorded once per terminal HTTP outcome
-(``serve/server.py``):
+Three serving SLIs recorded once per terminal HTTP outcome
+(``serve/server.py``), plus a fourth recorded at shadow-scoring cadence
+(``obs/quality.py``):
 
 - ``availability`` — good = the request answered 200. Overload shedding
   (429/503), deadline 504s, and 500s spend budget; client-side 400s are
@@ -18,6 +19,14 @@ Three serving SLIs, recorded once per terminal HTTP outcome
   engine, NOT a degradation rung. The motivation's "a request silently
   rode the oracle rung" is exactly this SLI burning while availability
   stays green — bit-identical answers, degraded capacity.
+- ``quality``      — good = a shadow-scored request whose served answer
+  matched the oracle rung exactly (recall 1.0, vote agreement —
+  ``obs/quality.py``). Recorded via :meth:`SLOTracker.record_quality` by
+  the background scorer, NOT per HTTP outcome: only sampled requests
+  spend or bank quality budget, so the burn rate is meaningful at any
+  ``--shadow-rate``. This is the SLI ROADMAP item 4's approximate
+  retrieval will be held to — a wrong-answer rung burns quality while
+  availability/latency stay green.
 
 Implementation: a per-second ring of counters sized to the longest window
 (default 5 m / 1 h, env- and CLI-tunable), one lock, O(window) only on
@@ -41,7 +50,7 @@ from knn_tpu import obs
 #: budget view. The soak gate shortens these via ``--slo-windows``.
 DEFAULT_WINDOWS_S = (300, 3600)
 
-OBJECTIVES = ("availability", "latency", "fast_rung")
+OBJECTIVES = ("availability", "latency", "fast_rung", "quality")
 
 
 def window_label(seconds: int) -> str:
@@ -65,10 +74,12 @@ class SLOTracker:
                  latency_target_ms: float = 100.0,
                  latency_target: float = 0.99,
                  fast_rung_target: float = 0.99,
+                 quality_target: float = 0.999,
                  windows_s: Sequence[int] = DEFAULT_WINDOWS_S):
         for name, t in (("availability_target", availability_target),
                         ("latency_target", latency_target),
-                        ("fast_rung_target", fast_rung_target)):
+                        ("fast_rung_target", fast_rung_target),
+                        ("quality_target", quality_target)):
             if not 0.0 < t < 1.0:
                 raise ValueError(f"{name} must be in (0, 1), got {t}")
         if latency_target_ms <= 0:
@@ -81,6 +92,7 @@ class SLOTracker:
             "availability": float(availability_target),
             "latency": float(latency_target),
             "fast_rung": float(fast_rung_target),
+            "quality": float(quality_target),
         }
         self.latency_target_ms = float(latency_target_ms)
         self.windows_s = ws
@@ -95,6 +107,10 @@ class SLOTracker:
         self._lock = threading.Lock()
         # Ring slot: [slot_stamp, total, ok, latency_ok, fast_ok]
         self._ring = [[0, 0, 0, 0, 0] for _ in range(size)]
+        # Quality rides its own ring at shadow-scoring cadence: a sampled
+        # request scored seconds after it was served must not perturb the
+        # per-HTTP-outcome counters above. Slot: [slot_stamp, total, good].
+        self._qring = [[0, 0, 0] for _ in range(size)]
 
     # -- recording (O(1)) --------------------------------------------------
 
@@ -116,6 +132,19 @@ class SLOTracker:
                 if not degraded:
                     slot[4] += 1
 
+    def record_quality(self, good: bool) -> None:
+        """One shadow-scored request (``obs/quality.py``): ``good`` = the
+        served answer matched the oracle rung (recall 1.0 and vote
+        agreement). Only sampled requests move this SLI."""
+        now = int(time.monotonic() // self.slot_s)
+        slot = self._qring[now % len(self._qring)]
+        with self._lock:
+            if slot[0] != now:
+                slot[0], slot[1], slot[2] = now, 0, 0
+            slot[1] += 1
+            if good:
+                slot[2] += 1
+
     # -- aggregation (O(window), scrape-time only) -------------------------
 
     def window_counts(self, window_s: int) -> Tuple[int, int, int, int]:
@@ -132,19 +161,39 @@ class SLOTracker:
                     fast += slot[4]
         return total, ok, lat, fast
 
+    def quality_window_counts(self, window_s: int) -> Tuple[int, int]:
+        """``(scored, good)`` shadow-scored events over the trailing
+        window."""
+        now = int(time.monotonic() // self.slot_s)
+        lo = now - max(1, int(window_s) // self.slot_s)
+        total = good = 0
+        with self._lock:
+            for slot in self._qring:
+                if lo < slot[0] <= now:
+                    total += slot[1]
+                    good += slot[2]
+        return total, good
+
     def burn_rates(self) -> Dict[str, Dict[str, float]]:
         """``{objective: {window_label: burn}}``; burn 1.0 = spending the
         error budget exactly at the sustainable rate."""
         out: Dict[str, Dict[str, float]] = {o: {} for o in OBJECTIVES}
         for w in self.windows_s:
             total, ok, lat, fast = self.window_counts(w)
+            q_total, q_good = self.quality_window_counts(w)
             label = window_label(w)
-            goods = {"availability": ok, "latency": lat, "fast_rung": fast}
+            counts = {
+                "availability": (total, ok),
+                "latency": (total, lat),
+                "fast_rung": (total, fast),
+                "quality": (q_total, q_good),
+            }
             for objective in OBJECTIVES:
-                if total == 0:
+                obj_total, obj_good = counts[objective]
+                if obj_total == 0:
                     burn = 0.0
                 else:
-                    bad_frac = 1.0 - goods[objective] / total
+                    bad_frac = 1.0 - obj_good / obj_total
                     burn = bad_frac / (1.0 - self.targets[objective])
                 out[objective][label] = round(burn, 4)
         return out

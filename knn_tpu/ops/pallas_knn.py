@@ -704,19 +704,57 @@ def stripe_inputs_finite(*arrays: np.ndarray) -> bool:
     return True
 
 
-def _wide_tile_fits(precision: str, d_pad: int, k: int) -> bool:
-    """Whether the wide-feature matmul stripe route can compile at ALL: even
-    the FLOOR train tile (block_n=128) must leave the minimum query block
-    (256 rows) inside the kernel's 64 MB VMEM budget once double-buffered.
-    Past that, Mosaic hard-fails — and the no-fallback dispatch points
-    (kneighbors, the distributed paths) have no merge path to rescue an
-    auto route (ADVICE r4). Mirrors stripe_block_sizes' cost model:
-    2 * block_n * d_pad train tiles at their store width, plus per-query-row
-    distance buffer + candidate scratch + query row."""
+#: Admission budget for the wide-feature matmul stripe ROUTE — deliberately
+#: 48 MB, not the kernel's 64 MB ``vmem_limit_bytes``: the limit must also
+#: hold what the cost model below does not count — the ``[block_q, 128k]``
+#: candidate outputs XLA places on the VMEM stack (S(1)) whenever the
+#: retirement loop keeps them live, plus Mosaic's own scheduling slack —
+#: so routing admits only shapes that leave that ~25% headroom. A shape
+#: that fails here must stay on the merge/XLA formulations: the
+#: no-fallback dispatch points (kneighbors, the distributed paths) have no
+#: rescue path after Mosaic hard-fails (ADVICE r4).
+WIDE_ROUTE_VMEM_BUDGET = 48 << 20
+
+
+def _wide_tile_bytes(block_n: int, d_pad: int, precision: str) -> int:
+    """The double-buffered train tile at its STORE width (bf16 ships the
+    transposed operand half-width) — THE fixed VMEM cost of the wide
+    matmul stripe forms. One definition shared by the block resolver
+    (:func:`stripe_block_sizes`) and the route guard
+    (:func:`_wide_tile_fits`), so the two can never drift apart again
+    (ADVICE r5 #2)."""
     store_bytes = 2 if precision == "bf16" else 4
-    tiles = 2 * 128 * d_pad * store_bytes
-    per_row = 4 * 128 + 8 * 128 * k + 4 * d_pad
-    return tiles + 256 * per_row <= (48 << 20)
+    return 2 * block_n * d_pad * store_bytes
+
+
+def _wide_row_bytes(block_n: int, d_pad: int, k: int) -> int:
+    """Per-query-row VMEM for the wide matmul forms: the f32 distance
+    stripe (``4 * block_n``), candidate scratch (``2 x [row, 128k]`` at
+    d+i widths = ``8 * 128 * k``), and the query row (``4 * d_pad``)."""
+    return 4 * block_n + 8 * 128 * k + 4 * d_pad
+
+
+def _wide_vmem_bytes(block_q: int, block_n: int, d_pad: int, k: int,
+                     precision: str) -> int:
+    """Modeled VMEM for one wide-form stripe invocation at the given
+    blocks — the shared cost function both consumers evaluate."""
+    return (_wide_tile_bytes(block_n, d_pad, precision)
+            + block_q * _wide_row_bytes(block_n, d_pad, k))
+
+
+def _wide_tile_fits(precision: str, d_pad: int, k: int) -> bool:
+    """Whether the wide-feature matmul stripe route can compile at ALL:
+    resolve the blocks :func:`stripe_block_sizes` would actually choose
+    for the minimum query block (256 rows — the resolver's own block_q
+    floor), then evaluate the shared cost model against
+    :data:`WIDE_ROUTE_VMEM_BUDGET`. At the widths where this guard
+    matters the resolver's 16 MB tile cap has already floored block_n at
+    128, so the verdict is the tightest shape the kernel could run."""
+    block_q, block_n = stripe_block_sizes(
+        None, None, q=256, k=k, d_pad=d_pad, precision=precision
+    )
+    return (_wide_vmem_bytes(block_q, block_n, d_pad, k, precision)
+            <= WIDE_ROUTE_VMEM_BUDGET)
 
 
 def stripe_route_ok(precision: str, d: int, k: int) -> bool:
@@ -898,22 +936,23 @@ def stripe_block_sizes(
         # the auto dispatch points outside predict_pallas have no merge
         # fallback. Cap the tiles at ~16 MB of the 64 MB kernel budget
         # (e.g. d_pad=8192 f32 fast -> block_n 256).
+        # The tile cap divides by the tile-bytes helper's per-row-of-block_n
+        # cost so the double-buffered tile (_wide_tile_bytes) stays ~16 MB.
         store_cap = 2 if precision == "bf16" else 4
         tile_cap = (16 << 20) // (2 * max(d_pad, 1) * store_cap) // 128 * 128
         block_n = max(128, min(block_n, max(tile_cap, 128)))
         if block_q is None:
-            # Rough per-row VMEM: d_full (4*block_n) + scratch (8*128k) +
-            # query row (4*d_pad); the fixed cost is the double-buffered
-            # train tile at its STORE width (bf16 stores half — "fast" keeps
-            # f32 tiles and gets a smaller query block). The budget assumes
-            # the kernel's raised 64 MB vmem_limit (r4: the norm hoist
-            # removed the in-kernel f32 train-tile materialization, and
+            # Solve _wide_vmem_bytes(block_q) <= budget for block_q (the
+            # shared wide-form cost model — _wide_tile_bytes fixed cost +
+            # per-row _wide_row_bytes; bf16 stores half-width tiles, so
+            # "fast" gets a smaller query block). The budget assumes the
+            # kernel's raised 64 MB vmem_limit (r4: the norm hoist removed
+            # the in-kernel f32 train-tile materialization, and
             # (1024, 2048) measured best on the mnist784 bf16 shape), with
             # a haircut at high k where scratch liveness grows.
-            store_bytes = 2 if precision == "bf16" else 4
-            tiles = 2 * block_n * d_pad * store_bytes
-            per_row = 4 * block_n + 8 * 128 * k + 4 * d_pad
-            budget = ((34 if k <= 8 else 28) << 20) - tiles
+            budget = (((34 if k <= 8 else 28) << 20)
+                      - _wide_tile_bytes(block_n, d_pad, precision))
+            per_row = _wide_row_bytes(block_n, d_pad, k)
             block_q = max(256, min(1024, budget // per_row // 256 * 256))
     else:
         block_n = ((max(128, block_n or 2048) + 127) // 128) * 128

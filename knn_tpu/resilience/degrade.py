@@ -42,6 +42,16 @@ from typing import Callable, Dict, Optional, Tuple
 from knn_tpu import obs
 from knn_tpu.resilience.errors import DataError, DeviceError, ResilienceError
 
+#: The SERVING ladder's canonical rung order (``serve/batcher.py``
+#: walks it fast → xla → oracle; "xla" is skipped when it IS the fast
+#: engine). Shared here so every layer that attributes work to a rung —
+#: the batcher's ``knn_serve_fallback_total`` labels, the shadow scorer's
+#: ``knn_quality_recall{rung}`` / ``knn_quality_divergence_total{rung,...}``
+#: (obs/quality.py), and ``/debug/quality``'s fast-to-degraded row order —
+#: agrees on one vocabulary; a rung label outside this tuple is an
+#: instrumentation bug.
+SERVING_RUNGS: Tuple[str, ...] = ("fast", "xla", "oracle")
+
 #: backend -> fallback rungs, most-capable first.
 LADDER: Dict[str, Tuple[str, ...]] = {
     "tpu-sharded": ("tpu", "tpu-pallas", "native", "oracle"),

@@ -45,7 +45,12 @@ from knn_tpu.models.knn import KNNClassifier, KNNRegressor
 from knn_tpu.resilience.errors import DataError
 
 #: Bumped on any incompatible change to the manifest or array layout.
-ARTIFACT_FORMAT = 1
+#: History: 1 = the original layout; 2 adds the ``drift_sketch`` manifest
+#: field (the training distribution's per-feature summary,
+#: obs/drift.py) — loaders accept BOTH, and a format-1 (sketch-less)
+#: artifact serves normally with drift scoring in its distinct
+#: "no baseline" state (never fabricated scores).
+ARTIFACT_FORMAT = 2
 MANIFEST_NAME = "manifest.json"
 ARRAYS_NAME = "arrays.npz"
 
@@ -126,8 +131,15 @@ def save_index(model, path) -> Path:
     if train.raw_targets is not None:
         arrays["raw_targets"] = train.raw_targets
     np.savez(out / ARRAYS_NAME, **arrays)
+    # The reference (training) distribution sketch for query-drift
+    # detection (obs/drift.py): one exact numpy pass at build time — the
+    # serving process can never afford to recompute it, and without it a
+    # drift monitor has nothing honest to compare against.
+    from knn_tpu.obs.drift import StreamSketch
+
     manifest.update(
         format=ARTIFACT_FORMAT,
+        drift_sketch=StreamSketch.from_data(train.features).to_dict(),
         created_unix=round(time.time(), 3),
         relation=train.relation,
         attributes=[
@@ -159,6 +171,14 @@ def read_manifest(path) -> dict:
     is about to swap in). Raises :class:`DataError` like
     :func:`load_index`."""
     return _read_manifest(Path(path))
+
+
+def reference_sketch(manifest: dict) -> Optional[dict]:
+    """The artifact's training-distribution sketch, or None for a
+    pre-sketch (format 1) artifact — the caller must treat None as the
+    distinct "no baseline" drift state, not as a zero-drift baseline."""
+    sketch = manifest.get("drift_sketch")
+    return sketch if isinstance(sketch, dict) else None
 
 
 def index_version(manifest: dict) -> str:

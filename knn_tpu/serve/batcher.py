@@ -196,12 +196,27 @@ class MicroBatcher:
                          the recorder at its terminal outcome. None (the
                          default) keeps the whole layer at one
                          ``is None`` predicate per call site.
+    ``quality``        — an optional
+                         :class:`~knn_tpu.obs.quality.ShadowScorer`: each
+                         successfully-served request is offered for
+                         shadow scoring (one seeded RNG draw + an O(1)
+                         bounded-queue append on this worker thread; a
+                         full queue sheds, NEVER blocks — the latency
+                         acceptance bench.py measures). The sample
+                         carries this batch's own (model, version)
+                         snapshot so scoring stays correct across hot
+                         reloads.
+    ``drift``          — an optional
+                         :class:`~knn_tpu.obs.drift.DriftMonitor`: served
+                         query rows are offered to the drift sketch under
+                         the same sampled, shed-on-overload contract.
     """
 
     def __init__(self, model, *, max_batch: int = 256,
                  max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
                  index_version: Optional[str] = None,
-                 recorder: "Optional[reqtrace.FlightRecorder]" = None):
+                 recorder: "Optional[reqtrace.FlightRecorder]" = None,
+                 quality=None, drift=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -215,6 +230,15 @@ class MicroBatcher:
         self._model = model
         self._index_version = index_version
         self.recorder = recorder
+        self.quality = quality
+        self.drift = drift
+        # TEST-ONLY corruption hook (scripts/quality_soak.py): when armed
+        # (the serve process installs a SIGUSR2 handler only under
+        # KNN_TPU_TEST_QUALITY_CORRUPT), served neighbor indices are
+        # rotated by one train row — a silently-wrong index whose
+        # responses still look healthy to every other SLI. The shadow
+        # scorer must catch it; nothing in production ever sets this.
+        self.corrupt_serving = False
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue_rows = int(max_queue_rows)
@@ -740,6 +764,10 @@ class MicroBatcher:
                 live, dists, idx, rung = self._retrieve(model, live)
                 if not live:
                     return
+                if self.corrupt_serving:
+                    # Test-only (see __init__): every served neighbor is
+                    # off by one train row while distances stay plausible.
+                    idx = (idx + 1) % model.train_.num_instances
                 off = 0
                 for req in live:
                     d = dists[off:off + req.rows]
@@ -750,11 +778,25 @@ class MicroBatcher:
                     if req.trace is not None:
                         req.trace.annotate(index_version=version, rung=rung)
                     if req.kind == "kneighbors":
-                        req.succeed((d, i))
+                        value = (d, i)
                     elif isinstance(model, KNNClassifier):
-                        req.succeed(model.predict_from_candidates(d, i))
+                        value = model.predict_from_candidates(d, i)
                     else:
-                        req.succeed(model._predict_from((d, i)))
+                        value = model._predict_from((d, i))
+                    req.succeed(value)
+                    # Quality tap, AFTER the future is signaled: one RNG
+                    # draw + an O(1) append per layer, shed when full —
+                    # the response is already on its way to the client.
+                    if self.quality is not None:
+                        self.quality.offer(
+                            features=req.features, kind=req.kind,
+                            dists=d, idx=i,
+                            preds=(value if req.kind == "predict"
+                                   else None),
+                            rung=rung, model=model, version=version,
+                        )
+                    if self.drift is not None:
+                        self.drift.offer(req.features)
             instrument.record_serve_batch(
                 len(live), sum(r.rows for r in live),
                 (time.monotonic() - t0) * 1e3,

@@ -27,6 +27,13 @@ Endpoint contract (docs/SERVING.md):
   recorder's last-N / slowest-K per-request timelines
   (``?id=<request_id>`` resolves one, ``?format=perfetto`` exports
   Chrome ``trace_event`` JSON — docs/OBSERVABILITY.md).
+- ``GET /debug/quality`` → the answer-quality join (docs/OBSERVABILITY.md
+  §Quality & drift): shadow-scored recall/accuracy and divergence counts
+  per answering rung (``obs/quality.py``), the query-drift summary vs the
+  artifact's training sketch (``obs/drift.py`` — a pre-sketch artifact
+  reports the distinct ``baseline: "absent"`` state), and the ``quality``
+  SLO burn rates, in one payload — the page an operator reads when a
+  recall regression is suspected (docs/SERVING.md runbook).
 - ``GET /debug/profile?ms=N`` → an on-demand ``jax.profiler`` capture
   (``obs/devprof.py``): the handler holds the window open for N ms
   (default 200, cap 10 s) while the other handler threads keep serving,
@@ -68,6 +75,7 @@ from __future__ import annotations
 import contextlib
 import json
 import math
+import os
 import sys
 import threading
 import time
@@ -140,7 +148,10 @@ class ServeApp:
                  index_version: Optional[str] = None,
                  flight_recorder_size: int = 256, slowest_k: int = 32,
                  access_log: Optional[str] = None,
-                 slo: Optional[SLOTracker] = None):
+                 slo: Optional[SLOTracker] = None,
+                 shadow_rate: float = 0.0, drift_rate: float = 0.0,
+                 quality_queue: int = 256, quality_seed: int = 0,
+                 reference_sketch: Optional[dict] = None):
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -158,10 +169,37 @@ class ServeApp:
         )
         self.slo = slo if slo is not None else SLOTracker()
         self.access_log = AccessLog(access_log) if access_log else None
+        # Answer-quality layers (obs/quality.py, obs/drift.py): rate 0
+        # (the default) constructs NOTHING — no worker thread, no queue,
+        # no instruments; the batcher then pays one `is None` predicate
+        # per served request (the zero-cost-when-disabled contract,
+        # scripts/check_disabled_overhead.py).
+        # Drift first: it is the layer that VALIDATES (a malformed or
+        # wrong-width manifest sketch raises here), and a construction
+        # abort must not leave an already-started scorer thread behind.
+        if drift_rate > 0:
+            from knn_tpu.obs.drift import DriftMonitor
+
+            self.drift = DriftMonitor(
+                reference_sketch, rate=drift_rate,
+                num_features=model.train_.num_features,
+                queue_cap=quality_queue, seed=quality_seed,
+            )
+        else:
+            self.drift = None
+        if shadow_rate > 0:
+            from knn_tpu.obs.quality import ShadowScorer
+
+            self.quality = ShadowScorer(
+                shadow_rate, queue_cap=quality_queue, seed=quality_seed,
+                slo=self.slo,
+            )
+        else:
+            self.quality = None
         self.batcher = MicroBatcher(
             model, max_batch=max_batch, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, index_version=index_version,
-            recorder=self.recorder,
+            recorder=self.recorder, quality=self.quality, drift=self.drift,
         )
         self.ready = False
         self.draining = False
@@ -236,6 +274,14 @@ class ServeApp:
                 model, batch_sizes=self._warm_sizes or (1, self.batcher.max_batch),
                 kinds=("predict",),
             )
+            if self.drift is not None:
+                # BEFORE the swap: the new artifact's sketch is the new
+                # drift baseline (it may also have none — a pre-sketch
+                # rollback returns drift to its distinct no-baseline
+                # state). A malformed/mismatched sketch raises here, so
+                # the rollback reply's "old index still serving" stays
+                # honest.
+                self.drift.set_reference(artifact.reference_sketch(manifest))
             previous = self.batcher.swap_model(model, version)
             self.model = model
             self.index_version = version
@@ -330,6 +376,10 @@ class ServeApp:
     def close(self) -> None:
         self.ready = False
         self.batcher.close()
+        if self.quality is not None:
+            self.quality.close()
+        if self.drift is not None:
+            self.drift.close()
         if self.access_log is not None:
             self.access_log.close()
 
@@ -352,10 +402,24 @@ class ServeApp:
             # poller keeps them current between /metrics scrapes.
             "slo": self.slo.export(),
             "device": self._device_block(),
+            "quality": self.quality_block(),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
         return h
+
+    def quality_block(self) -> dict:
+        """The answer-quality summary for ``/healthz`` (and the core of
+        ``/debug/quality``): shadow-scorer per-rung stats and the drift
+        summary, each ``None`` when its layer is off. ``export()`` also
+        refreshes the ``knn_quality_*``/``knn_drift_*`` gauges, so a
+        /healthz poller keeps them current between /metrics scrapes."""
+        return {
+            "shadow": (self.quality.export()
+                       if self.quality is not None else None),
+            "drift": (self.drift.export()
+                      if self.drift is not None else None),
+        }
 
     @staticmethod
     def _device_block() -> dict:
@@ -450,12 +514,17 @@ class _Handler(BaseHTTPRequestHandler):
             ok = h["ready"] and not h["draining"]
             self._send(200 if ok else 503, h)
         elif route == "/metrics":
-            # Refresh the scrape-time gauges (knn_slo_* and
-            # knn_device_memory_bytes) before rendering.
+            # Refresh the scrape-time gauges (knn_slo_*,
+            # knn_device_memory_bytes, knn_quality_*/knn_drift_*) before
+            # rendering.
             self.app.slo.export()
             from knn_tpu.obs import devprof
 
             devprof.record_device_memory()
+            if self.app.quality is not None:
+                self.app.quality.export()
+            if self.app.drift is not None:
+                self.app.drift.export()
             accept = self.headers.get("Accept", "")
             if "application/openmetrics-text" in accept:
                 self._send_text(
@@ -470,10 +539,38 @@ class _Handler(BaseHTTPRequestHandler):
                 )
         elif route in ("/debug/requests", "/debug/slowest"):
             self._do_debug(route)
+        elif route == "/debug/quality":
+            self._do_quality()
         elif route == "/debug/profile":
             self._do_profile()
         else:
             self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def _do_quality(self):
+        """The answer-quality join: shadow recall/accuracy + per-rung
+        divergence, the drift summary (with its distinct no-baseline
+        state), and the quality SLO burn rates in ONE payload — drift
+        tells you the QUERIES changed, recall tells you the ANSWERS
+        changed, the rung attribution tells you WHERE. Always 200: a
+        disabled layer reports ``null`` rather than 404, so dashboards
+        can hard-code the route."""
+        block = self.app.quality_block()
+        burns = self.app.slo.burn_rates()
+        payload = {
+            "enabled": {
+                "shadow": self.app.quality is not None,
+                "drift": self.app.drift is not None,
+            },
+            **block,
+            "slo_quality": {
+                "target": self.app.slo.targets["quality"],
+                "burn_rates": burns.get("quality", {}),
+            },
+            "index_version": self.app.index_version,
+        }
+        # Like /debug/requests: no request_id stamped into a payload that
+        # is about OTHER requests (the header still carries it).
+        self._send(200, payload, tag_request_id=False)
 
     def _do_profile(self):
         """On-demand device profile: ``?ms=N`` holds a ``jax.profiler``
@@ -830,10 +927,24 @@ def serve_forever(server: KNNServer, *, banner=None,
 
         threading.Thread(target=work, daemon=True).start()
 
+    def on_sigusr2(signum, frame):
+        # TEST-ONLY (armed below iff KNN_TPU_TEST_QUALITY_CORRUPT is set):
+        # flip the batcher's index-corruption hook so the quality-soak
+        # gate (scripts/quality_soak.py) can prove the shadow scorer
+        # detects a silently-wrong index mid-run. Production serves never
+        # install this handler.
+        server.app.batcher.corrupt_serving = True
+        print("warning: TEST HOOK engaged — serving corrupted neighbor "
+              "indices (KNN_TPU_TEST_QUALITY_CORRUPT + SIGUSR2)",
+              file=sys.stderr, flush=True)
+
     previous = {}
     handlers = {signal.SIGINT: on_sigint, signal.SIGTERM: on_sigterm}
     if hasattr(signal, "SIGHUP"):
         handlers[signal.SIGHUP] = on_sighup
+    if (hasattr(signal, "SIGUSR2")
+            and os.environ.get("KNN_TPU_TEST_QUALITY_CORRUPT")):
+        handlers[signal.SIGUSR2] = on_sigusr2
     for sig, handler in handlers.items():
         try:
             previous[sig] = signal.signal(sig, handler)

@@ -73,6 +73,40 @@ def main() -> int:
     print("disabled-overhead: functional ok (0 spans, 0 instruments, "
           "no request traces)")
 
+    # -- 1a. shadow scoring + drift sketching off-state --------------------
+    # Rate 0 (the serve default) must construct NOTHING: no scorer, no
+    # drift monitor, no worker threads, no queue, zero knn_quality_*/
+    # knn_drift_* instruments — the batcher then pays exactly one
+    # `is None` predicate per served request.
+    import threading
+
+    from knn_tpu.serve.server import ServeApp
+
+    app = ServeApp(model, max_batch=8, max_wait_ms=0.0)
+    try:
+        if app.quality is not None or app.drift is not None:
+            return fail("ServeApp built a shadow scorer / drift monitor "
+                        "at rate 0 — the quality layer must not exist "
+                        "while disabled")
+        if app.batcher.quality is not None or app.batcher.drift is not None:
+            return fail("the batcher holds a quality/drift tap at rate 0")
+        app.batcher.predict(test.features[0], timeout=60)
+    finally:
+        app.close()
+    bad_threads = [t.name for t in threading.enumerate()
+                   if t.name.startswith(("knn-quality", "knn-drift"))]
+    if bad_threads:
+        return fail(f"quality/drift worker thread(s) alive while disabled: "
+                    f"{bad_threads}")
+    leaked = [i.name for i in obs.registry().instruments()
+              if i.name.startswith(("knn_quality_", "knn_drift_"))]
+    if leaked:
+        return fail(f"quality/drift instrument(s) recorded while disabled: "
+                    f"{leaked}")
+    print("disabled-overhead: quality/drift off-state ok (no scorer, no "
+          "monitor, no worker threads, zero instruments, zero queue "
+          "activity)")
+
     # -- 1b. the device-side layer (obs/devprof.py) off-state --------------
     # Even with the compile listener having been registered by a PRIOR
     # enable (jax.monitoring offers no unregister), a disabled process

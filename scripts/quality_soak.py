@@ -1,0 +1,395 @@
+"""Quality-soak gate (`make quality-soak`): shadow scoring under fire — the
+answer-quality acceptance run (docs/OBSERVABILITY.md §Quality & drift).
+
+Two phases prove two halves of the contract:
+
+**Phase 1 — no false alarms.** Boot `knn_tpu serve` with shadow scoring at
+rate 1.0 and a seeded fault burst armed (``KNN_TPU_FAULTS=serve.dispatch=N``
+with tight breaker knobs — the chaos-soak recipe), hammer it with
+concurrent closed-loop clients through the burst and the breaker's
+open→re-close cycle. Every ladder rung is EXACT, so whatever rung answered
+— fast, degraded, or breaker-short-circuited — the recall SLI must hold
+exactly 1.0: zero divergence on every rung the soak exercised, quality
+burn rate pinned at 0. Any divergence here is a real bug, not noise.
+
+**Phase 2 — real corruption detected and localized.** Send SIGUSR2 (the
+test-only hook, armed by ``KNN_TPU_TEST_QUALITY_CORRUPT`` at boot): the
+batcher starts serving neighbor indices rotated by one train row — every
+response still 200, availability/latency/fast-rung all green, predictions
+silently wrong. The gate asserts the shadow scorer catches it: the
+``quality`` burn rate rises, ``knn_quality_divergence_total`` counts
+neighbors-kind divergence, and ``/debug/quality`` localizes it to the
+answering rung — the detection that will catch a bad approximate rung
+before ROADMAP item 4 ships one.
+
+Plus the latency half of the acceptance: per-request p50 measured by the
+phase-1 clients (shadow ON, rate 1.0) is recorded in the verdict JSON
+alongside a shadow-off reference run, and the gate asserts the shadow path
+never produced a non-200 of its own — the provably-never-blocks contract
+(the noise-bounded p50 comparison itself lives in bench.py's
+``c8_shadow_p50_ms`` row, where trials repeat enough to bound variance).
+
+Exit 0 when every invariant holds; 1 with a diagnosis. stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
+BOOT_TIMEOUT_S = 120
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--short", action="store_true",
+                   help="CI preset: ~6 s fault-burst window")
+    p.add_argument("--window-s", type=float, default=None)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--faults", type=int, default=None,
+                   help="KNN_TPU_FAULTS=serve.dispatch=<N> burst size")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--json-out", default=None, metavar="FILE")
+    args = p.parse_args()
+    if args.window_s is None:
+        args.window_s = 6.0 if args.short else 15.0
+    if args.faults is None:
+        args.faults = 12 if args.short else 25
+    return args
+
+
+def fail(msg: str, proc=None) -> int:
+    print(f"quality-soak: FAIL: {msg}", file=sys.stderr)
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+    return 1
+
+
+def http(base: str, path: str, payload=None, timeout=30):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"} if payload else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def boot(index: str, env: dict, extra_flags):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "knn_tpu.cli", "serve", index,
+         "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
+         *extra_flags],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO,
+    )
+    import queue
+
+    lines: "queue.Queue[str]" = queue.Queue()
+    threading.Thread(
+        target=lambda: [lines.put(ln) for ln in proc.stdout], daemon=True,
+    ).start()
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=min(1.0, max(
+                0.01, deadline - time.monotonic())))
+        except Exception:  # noqa: BLE001 — queue.Empty
+            if proc.poll() is not None:
+                return proc, None
+            continue
+        m = READY_RE.search(line)
+        if m:
+            print(f"quality-soak: server: {line.rstrip()}")
+            return proc, m.group(1)
+    return proc, None
+
+
+def run_clients(base, rows, n_clients, stop, lats, lock, violations):
+    def loop(cid):
+        q = len(rows)
+        i = cid
+        mine = []
+        while not stop.is_set():
+            lo = (3 * i) % (q - 2)
+            i += 1
+            t0 = time.monotonic()
+            try:
+                st, body = http(base, "/predict",
+                                {"instances": rows[lo:lo + 2].tolist()})
+            except Exception as e:  # noqa: BLE001 — recorded
+                with lock:
+                    violations.append(f"client {cid} transport error: {e}")
+                continue
+            mine.append((time.monotonic() - t0) * 1e3)
+            if st == 500:
+                with lock:
+                    violations.append(f"client {cid}: 500: {body[:200]}")
+        with lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=loop, args=(c,), daemon=True)
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def quality_doc(base):
+    st, body = http(base, "/debug/quality", timeout=30)
+    if st != 200:
+        raise RuntimeError(f"/debug/quality: status {st}: {body[:200]}")
+    return json.loads(body)
+
+
+def wait_queue_drained(base, timeout_s=30):
+    """Shadow scoring is asynchronous: assertions about scored totals must
+    wait for the background queue to empty."""
+    deadline = time.monotonic() + timeout_s
+    doc = None
+    while time.monotonic() < deadline:
+        doc = quality_doc(base)
+        sh = doc["shadow"]
+        if sh["queue_depth"] == 0 and sh["scored"] + sh["shed"] > 0:
+            return doc
+        time.sleep(0.2)
+    return doc
+
+
+def pct(vals, p):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(len(vals) * p / 100))], 2)
+
+
+def main() -> int:
+    args = parse_args()
+    from tests import fixtures  # noqa: E402 — repo-root import
+
+    d = fixtures.datasets_dir()
+    train_arff = str(d / "small-train.arff")
+    test_arff = str(d / "small-test.arff")
+
+    from knn_tpu.data.arff import load_arff
+
+    test = load_arff(test_arff)
+
+    fault_plan = f"serve.dispatch={args.faults}:device"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        KNN_TPU_RETRY_BASE_MS="0",
+        KNN_TPU_FAULTS=fault_plan,
+        KNN_TPU_FAULT_SEED=str(args.seed),
+        KNN_TPU_BREAKER_WINDOW="8",
+        KNN_TPU_BREAKER_THRESHOLD="3",
+        KNN_TPU_BREAKER_COOLDOWN_MS="400",
+        KNN_TPU_BREAKER_PROBES="1",
+        KNN_TPU_TEST_QUALITY_CORRUPT="1",  # arm the SIGUSR2 hook
+    )
+    quality_flags = [
+        "--shadow-rate", "1", "--drift-rate", "1",
+        "--quality-queue", "16384", "--quality-seed", str(args.seed),
+        "--slo-windows", "5,60",
+    ]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        index = os.path.join(tmp, "index")
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index, "--k", "3"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: {build.stderr}")
+        print(f"quality-soak: {build.stdout.strip()}")
+        print(f"quality-soak: fault plan {fault_plan} (seed {args.seed}), "
+              f"{args.clients} clients, {args.window_s:.0f} s burst window, "
+              f"shadow-rate 1.0")
+
+        proc, base = boot(index, env, quality_flags)
+        if base is None:
+            return fail(f"no ready banner (rc={proc.poll()})", proc)
+
+        # -- phase 1: fault burst + degraded rungs, recall must hold 1.0 ---
+        stop = threading.Event()
+        lock = threading.Lock()
+        lats_on: list = []
+        violations: list = []
+        clients = run_clients(base, test.features, args.clients, stop,
+                              lats_on, lock, violations)
+        breaker_opened = False
+        t_end = time.monotonic() + args.window_s
+        while time.monotonic() < t_end:
+            try:
+                _, body = http(base, "/healthz", timeout=5)
+                if json.loads(body).get("breaker") == "open":
+                    breaker_opened = True
+            except Exception:  # noqa: BLE001 — keep polling
+                pass
+            time.sleep(0.05)
+        # Keep load until the breaker re-closes so degraded AND recovered
+        # rungs both land in the shadow sample.
+        reclose_deadline = time.monotonic() + 30
+        state = None
+        while time.monotonic() < reclose_deadline:
+            try:
+                _, body = http(base, "/healthz", timeout=5)
+                state = json.loads(body).get("breaker")
+                if state == "closed":
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.1)
+        stop.set()
+        for t in clients:
+            t.join(timeout=35)
+            if t.is_alive():
+                return fail("a phase-1 client thread hung", proc)
+        if not breaker_opened:
+            return fail("the fault burst never tripped the breaker — the "
+                        "soak did not exercise degraded rungs", proc)
+        if state != "closed":
+            return fail(f"breaker did not re-close (state {state})", proc)
+        if violations:
+            for v in violations[:10]:
+                print(f"quality-soak: VIOLATION: {v}", file=sys.stderr)
+            return fail(f"{len(violations)} serving violation(s) in "
+                        f"phase 1", proc)
+
+        doc = wait_queue_drained(base)
+        sh = doc["shadow"]
+        if sh["scored"] < 20:
+            return fail(f"too few shadow-scored requests in phase 1 "
+                        f"({sh['scored']}) to trust the verdict", proc)
+        rungs_seen = sorted(sh["rungs"])
+        for rung, st in sh["rungs"].items():
+            if st["recall"] != 1.0:
+                return fail(f"recall SLI broke on EXACT rung {rung!r}: "
+                            f"{st['recall']} — a real serving bug, not "
+                            f"noise", proc)
+            if st["divergence"]:
+                return fail(f"divergence on exact rung {rung!r}: "
+                            f"{st['divergence']}", proc)
+        burns = doc["slo_quality"]["burn_rates"]
+        if any(b > 0 for b in burns.values()):
+            return fail(f"quality burn rate nonzero across an all-exact "
+                        f"fault burst: {burns}", proc)
+        drift = doc["drift"]
+        if drift["baseline"] != "present" or drift["scores"] is None:
+            return fail(f"drift baseline missing from a format-2 artifact: "
+                        f"{drift}", proc)
+        print(f"quality-soak: phase 1 ok — {sh['scored']} scored across "
+              f"rungs {rungs_seen}, recall 1.0 everywhere, quality burn 0, "
+              f"shed {sh['shed']}, drift baseline present "
+              f"(max score {drift['scores']['max']})")
+
+        # -- phase 2: corrupt the index; the scorer must catch it ----------
+        proc.send_signal(signal.SIGUSR2)
+        time.sleep(0.2)
+        stop2 = threading.Event()
+        lats2: list = []
+        violations2: list = []
+        clients2 = run_clients(base, test.features, args.clients, stop2,
+                               lats2, lock, violations2)
+        detected = None
+        burn_seen = 0.0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            doc = quality_doc(base)
+            burns = doc["slo_quality"]["burn_rates"]
+            burn_seen = max(burn_seen,
+                            max((b for b in burns.values()), default=0.0))
+            div_rungs = {
+                r: st["divergence"] for r, st in doc["shadow"]["rungs"].items()
+                if st["divergence"].get("neighbors")
+            }
+            if burn_seen > 1.0 and div_rungs:
+                detected = (doc, div_rungs)
+                break
+            time.sleep(0.2)
+        stop2.set()
+        for t in clients2:
+            t.join(timeout=35)
+            if t.is_alive():
+                return fail("a phase-2 client thread hung", proc)
+        if detected is None:
+            return fail(f"injected index corruption NOT detected within "
+                        f"30 s (peak quality burn {burn_seen})", proc)
+        doc, div_rungs = detected
+        rung, div = next(iter(div_rungs.items()))
+        recall_after = doc["shadow"]["rungs"][rung]["recall"]
+        if recall_after >= 1.0:
+            return fail(f"divergence counted but recall gauge still 1.0 "
+                        f"on rung {rung!r}", proc)
+        print(f"quality-soak: phase 2 ok — corruption detected and "
+              f"localized: rung {rung!r} recall {recall_after}, "
+              f"divergence {div}, quality burn peak "
+              f"{round(burn_seen, 2)}")
+
+        # -- shutdown ------------------------------------------------------
+        proc.send_signal(signal.SIGINT)
+        try:
+            rc = proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            return fail("server did not exit after SIGINT", proc)
+        if rc != 0:
+            return fail(f"server exited rc={rc} after SIGINT")
+
+        report = {
+            "quality_soak": {
+                "window_s": args.window_s,
+                "clients": args.clients,
+                "fault_plan": fault_plan,
+                "seed": args.seed,
+            },
+            "phase1": {
+                "scored": sh["scored"],
+                "shed": sh["shed"],
+                "rungs_seen": rungs_seen,
+                "recall_sli": 1.0,
+                "quality_burn": 0.0,
+                "p50_ms_shadow_on": pct(lats_on, 50),
+                "p99_ms_shadow_on": pct(lats_on, 99),
+                "requests": len(lats_on),
+            },
+            "phase2": {
+                "detected": True,
+                "rung": rung,
+                "recall_after": recall_after,
+                "divergence": div,
+                "quality_burn_peak": round(burn_seen, 3),
+            },
+            "drift": {"baseline": "present"},
+        }
+        out = json.dumps(report, indent=2)
+        print(out)
+        if args.json_out:
+            Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+            Path(args.json_out).write_text(out + "\n")
+        print("quality-soak: PASS")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,7 +87,11 @@ def main() -> int:
 
         proc = subprocess.Popen(
             [sys.executable, "-m", "knn_tpu.cli", "serve", index,
-             "--port", "0", "--max-batch", "16", "--max-wait-ms", "1"],
+             "--port", "0", "--max-batch", "16", "--max-wait-ms", "1",
+             # Quality observability on (PR 7): every request shadow-scored
+             # + drift-sketched so the /debug/quality probe sees real data.
+             "--shadow-rate", "1", "--drift-rate", "1",
+             "--quality-queue", "4096"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
@@ -228,6 +232,55 @@ def main() -> int:
             print(f"serve-smoke: /debug ok (timeline for {rid} resolved, "
                   f"phases {[p['phase'] for p in tl['phases']]}, perfetto "
                   f"{len(ev)} events)")
+
+            # Quality observability (PR 7): /debug/quality joins
+            # shadow-scored recall, drift vs the artifact's training
+            # sketch (a fresh save-index artifact is format 2 -> baseline
+            # present), and the quality SLO burn; /healthz carries the
+            # quality block; /metrics exposes knn_quality_*/knn_drift_*.
+            deadline_q = time.monotonic() + 30
+            qdoc = None
+            while time.monotonic() < deadline_q:
+                st, body, _ = request(base, "/debug/quality")
+                if st != 200:
+                    return fail(f"/debug/quality {st}: {body[:200]}", proc)
+                qdoc = json.loads(body)
+                sh = qdoc.get("shadow") or {}
+                if sh.get("scored", 0) >= 1 and sh.get("queue_depth") == 0:
+                    break
+                time.sleep(0.2)
+            sh = (qdoc or {}).get("shadow") or {}
+            if sh.get("scored", 0) < 1:
+                return fail(f"/debug/quality never showed a scored sample: "
+                            f"{json.dumps(qdoc)[:300]}", proc)
+            fast = (sh.get("rungs") or {}).get("fast") or {}
+            if fast.get("recall") != 1.0 or fast.get("divergence"):
+                return fail(f"shadow scorer reports divergence on a clean "
+                            f"serve: {fast}", proc)
+            drift = qdoc.get("drift") or {}
+            if drift.get("baseline") != "present":
+                return fail(f"drift baseline missing from a fresh format-2 "
+                            f"artifact: {drift}", proc)
+            if "burn_rates" not in (qdoc.get("slo_quality") or {}):
+                return fail(f"/debug/quality missing the quality SLO "
+                            f"block: {json.dumps(qdoc)[:300]}", proc)
+            h_quality = json.loads(request(base, "/healthz")[1]) \
+                .get("quality") or {}
+            if not (h_quality.get("shadow") or {}).get("scored"):
+                return fail(f"/healthz missing the quality block: "
+                            f"{h_quality}", proc)
+            st, metrics, _ = request(base, "/metrics")
+            q_missing = [n for n in ("knn_quality_recall",
+                                     "knn_quality_scored_total",
+                                     "knn_drift_baseline_present")
+                         if n not in metrics]
+            if q_missing:
+                return fail(f"/metrics missing quality rows: {q_missing}",
+                            proc)
+            print(f"serve-smoke: /debug/quality ok ({sh['scored']} scored, "
+                  f"recall 1.0, 0 divergence, drift baseline present, "
+                  f"quality burn "
+                  f"{qdoc['slo_quality']['burn_rates']})")
 
             # Device observability (PR 6): knn_device_memory_bytes gauges
             # in the scrape, and /debug/profile returning ONE

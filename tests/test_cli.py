@@ -300,7 +300,14 @@ class TestServeExitCodes:
                       ["--slo-fast-rung-target", "-0.1"],
                       ["--slo-latency-ms", "0"],
                       ["--slo-windows", "5,x"],
-                      ["--slo-windows", "0"]):
+                      ["--slo-windows", "0"],
+                      # The quality/drift knobs (PR 7) keep it too.
+                      ["--shadow-rate", "1.5"],
+                      ["--shadow-rate", "-0.1"],
+                      ["--drift-rate", "2"],
+                      ["--quality-queue", "0"],
+                      ["--slo-quality-target", "1"],
+                      ["--slo-quality-target", "0"]):
             assert run(["serve", "/irrelevant/index", *extra]) == 2, extra
             assert "error:" in self._err(capsys)
 

@@ -1,0 +1,568 @@
+"""Answer-quality observability contracts (docs/OBSERVABILITY.md §Quality
+& drift).
+
+The load-bearing claims:
+
+- the streaming estimators are HONEST: P² quantiles and Welford moments
+  track numpy on fixed seeds, recall@k scores hand-built neighbor lists
+  correctly under the shared (distance, index) contract (ties included);
+- the shadow path NEVER blocks serving: a full sample queue sheds
+  (counted) with the producer returning immediately, pinned with the
+  scoring worker held off;
+- detection works end to end: exact rungs score recall 1.0 / zero
+  divergence / zero quality burn, while a corrupted index is caught and
+  attributed to the answering rung — the proof the scorer would catch a
+  bad approximate rung before ROADMAP item 4 ships one;
+- the no-baseline drift state is DISTINCT from zero drift (the artifact
+  back-compat guard).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.obs.drift import (
+    DriftMonitor,
+    P2Quantile,
+    StreamSketch,
+    drift_scores,
+    sketch_summary,
+)
+from knn_tpu.obs.quality import (
+    ShadowScorer,
+    recall_at_k,
+    true_distances,
+)
+from knn_tpu.obs.slo import SLOTracker
+from knn_tpu.serve.batcher import MicroBatcher
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+def _problem(rng, n=200, d=5, c=3):
+    train_x = rng.integers(0, 4, (n, d)).astype(np.float32)  # grid -> ties
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    return Dataset(train_x, train_y)
+
+
+# ---------------------------------------------------------------------------
+# P² quantile estimator vs numpy
+
+
+class TestP2Quantile:
+    @pytest.mark.parametrize("p", [0.25, 0.5, 0.75, 0.9])
+    @pytest.mark.parametrize("dist", ["normal", "uniform", "exponential"])
+    def test_tracks_numpy_on_fixed_seeds(self, p, dist):
+        rng = np.random.default_rng(42)
+        xs = getattr(rng, dist)(size=5000)
+        est = P2Quantile(p)
+        for x in xs:
+            est.update(x)
+        want = float(np.quantile(xs, p))
+        spread = float(np.quantile(xs, 0.9) - np.quantile(xs, 0.1))
+        # P² is an approximation: within a few percent of the 10-90 spread
+        # at n=5000 (the classical accuracy claim, loose enough for CI).
+        assert est.value == pytest.approx(want, abs=0.05 * spread)
+
+    def test_small_n_is_exact(self):
+        est = P2Quantile(0.5)
+        for x in (5.0, 1.0, 3.0):
+            est.update(x)
+        assert est.value == pytest.approx(3.0)
+        assert P2Quantile(0.5).value is None
+
+    def test_five_values_exact_median(self):
+        est = P2Quantile(0.5)
+        for x in (9.0, 1.0, 7.0, 3.0, 5.0):
+            est.update(x)
+        assert est.value == pytest.approx(5.0)
+
+    def test_rejects_bad_p(self):
+        with pytest.raises(ValueError, match="quantile"):
+            P2Quantile(0.0)
+        with pytest.raises(ValueError, match="quantile"):
+            P2Quantile(1.0)
+
+
+# ---------------------------------------------------------------------------
+# StreamSketch: Welford moments + serialization
+
+
+class TestStreamSketch:
+    def test_welford_matches_numpy_in_chunks(self):
+        rng = np.random.default_rng(7)
+        data = rng.normal(3.0, 2.0, (1000, 4)) * np.array([1.0, 10.0, 0.1, 5])
+        s = StreamSketch(4)
+        for lo in range(0, 1000, 37):  # ragged chunk sizes
+            s.update(data[lo:lo + 37])
+        assert s.count == 1000
+        np.testing.assert_allclose(s.mean(), data.mean(axis=0), rtol=1e-10)
+        np.testing.assert_allclose(
+            s.variance(), data.var(axis=0, ddof=1), rtol=1e-9)
+
+    def test_p2_quartiles_track_numpy(self):
+        rng = np.random.default_rng(3)
+        data = rng.normal(0.0, 1.0, (4000, 2))
+        s = StreamSketch(2)
+        for lo in range(0, 4000, 256):
+            s.update(data[lo:lo + 256])
+        for p in (0.25, 0.5, 0.75):
+            got = np.asarray(s.quantile(p), np.float64)
+            want = np.quantile(data, p, axis=0)
+            np.testing.assert_allclose(got, want, atol=0.1)
+
+    def test_from_data_is_exact(self):
+        rng = np.random.default_rng(1)
+        data = rng.normal(size=(321, 3))
+        s = StreamSketch.from_data(data)
+        assert s.count == 321
+        np.testing.assert_allclose(s.mean(), data.mean(axis=0), rtol=1e-12)
+        np.testing.assert_allclose(
+            s.variance(), data.var(axis=0, ddof=1), rtol=1e-12)
+        for p in (0.25, 0.5, 0.75):
+            np.testing.assert_allclose(
+                np.asarray(s.quantile(p)), np.quantile(data, p, axis=0),
+                rtol=1e-12)
+
+    def test_serialization_round_trip(self):
+        rng = np.random.default_rng(2)
+        data = rng.normal(size=(100, 3))
+        doc = StreamSketch.from_data(data).to_dict()
+        norm = sketch_summary(doc)
+        assert norm["count"] == 100 and norm["num_features"] == 3
+        np.testing.assert_allclose(norm["mean"], data.mean(axis=0),
+                                   atol=1e-7)
+        assert set(norm["quantiles"]) == {0.25, 0.5, 0.75}
+
+    def test_malformed_sketch_rejected(self):
+        with pytest.raises((ValueError, KeyError, TypeError)):
+            sketch_summary({"num_features": 3, "count": 1,
+                            "mean": [1.0], "var": [1.0, 1.0, 1.0]})
+        with pytest.raises(ValueError):
+            sketch_summary("not a sketch")
+
+    def test_feature_width_enforced(self):
+        s = StreamSketch(3)
+        with pytest.raises(ValueError, match="features"):
+            s.update(np.zeros((2, 4)))
+
+
+# ---------------------------------------------------------------------------
+# recall@k on hand-built neighbor lists (the shared (distance, index)
+# contract, ties included)
+
+
+class TestRecallAtK:
+    def test_exact_match_is_one(self):
+        oracle_i = np.array([[0, 1], [2, 3]])
+        oracle_d = np.array([[0.0, 1.0], [2.0, 3.0]])
+        r = recall_at_k(oracle_i, oracle_i, oracle_d, oracle_d)
+        np.testing.assert_allclose(r, [1.0, 1.0])
+
+    def test_tie_broken_the_other_way_is_not_a_loss(self):
+        # Train rows 1 and 2 are equidistant (d=1). The oracle's
+        # (distance, index) order picks index 1; a served list that chose
+        # index 2 — true distance 1, tying the oracle's k-th — is still
+        # recall 1.0: an equally-near neighbor is not a miss.
+        oracle_i = np.array([[0, 1]])
+        oracle_d = np.array([[0.0, 1.0]])
+        served_i = np.array([[0, 2]])
+        true_d = np.array([[0.0, 1.0]])  # recomputed: index 2 IS at d=1
+        np.testing.assert_allclose(
+            recall_at_k(served_i, oracle_i, oracle_d, true_d), [1.0])
+
+    def test_wrong_neighbor_counts_against(self):
+        oracle_i = np.array([[0, 1]])
+        oracle_d = np.array([[0.0, 1.0]])
+        served_i = np.array([[0, 7]])
+        true_d = np.array([[0.0, 9.0]])  # index 7 is genuinely far
+        np.testing.assert_allclose(
+            recall_at_k(served_i, oracle_i, oracle_d, true_d), [0.5])
+
+    def test_claimed_distance_cannot_fake_a_tie(self):
+        # The tie clause uses the RECOMPUTED distance: a served index that
+        # claims d=1.0 but actually sits at d=9.0 is a miss.
+        oracle_i = np.array([[0, 1]])
+        oracle_d = np.array([[0.0, 1.0]])
+        served_i = np.array([[0, 7]])
+        true_d = np.array([[0.0, 9.0]])
+        r = recall_at_k(served_i, oracle_i, oracle_d, true_d)
+        np.testing.assert_allclose(r, [0.5])
+
+    def test_duplicate_served_indices_count_once(self):
+        # A degenerate list repeating the true nearest neighbor k times
+        # recalled ONE neighbor, not k — each distinct train index counts
+        # at most once (the failure mode a buggy approximate rung would
+        # otherwise hide behind).
+        oracle_i = np.array([[0, 1, 2]])
+        oracle_d = np.array([[0.0, 1.0, 2.0]])
+        served_i = np.array([[0, 0, 0]])
+        true_d = np.array([[0.0, 0.0, 0.0]])
+        np.testing.assert_allclose(
+            recall_at_k(served_i, oracle_i, oracle_d, true_d), [1 / 3])
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="shapes differ"):
+            recall_at_k(np.zeros((1, 2)), np.zeros((1, 3)),
+                        np.zeros((1, 3)), np.zeros((1, 2)))
+
+    def test_true_distances_match_oracle_on_own_candidates(self, rng):
+        from knn_tpu.backends.oracle import oracle_kneighbors
+
+        train = rng.normal(size=(50, 4)).astype(np.float32)
+        queries = rng.normal(size=(6, 4)).astype(np.float32)
+        d, i = oracle_kneighbors(train, queries, 3)
+        td = true_distances(train, queries, i, "euclidean")
+        np.testing.assert_allclose(td, d, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Drift scoring
+
+
+class TestDriftScores:
+    def test_identical_distributions_score_zero(self):
+        rng = np.random.default_rng(5)
+        data = rng.normal(size=(500, 3))
+        ref = sketch_summary(StreamSketch.from_data(data).to_dict())
+        live = sketch_summary(StreamSketch.from_data(data).to_dict())
+        s = drift_scores(ref, live)
+        np.testing.assert_allclose(s, 0.0, atol=1e-6)
+
+    def test_mean_shift_scores_in_sigma_units(self):
+        rng = np.random.default_rng(6)
+        data = rng.normal(0.0, 1.0, (2000, 2))
+        shifted = data + np.array([3.0, 0.0])
+        ref = sketch_summary(StreamSketch.from_data(data).to_dict())
+        live = sketch_summary(StreamSketch.from_data(shifted).to_dict())
+        s = drift_scores(ref, live)
+        assert s[0] == pytest.approx(3.0, rel=0.3)
+        assert s[1] < 0.3
+
+    def test_constant_reference_feature_does_not_blow_up(self):
+        data = np.ones((100, 1))
+        live_data = np.full((100, 1), 2.0)
+        ref = sketch_summary(StreamSketch.from_data(data).to_dict())
+        live = sketch_summary(StreamSketch.from_data(live_data).to_dict())
+        s = drift_scores(ref, live)
+        assert np.all(np.isfinite(s)) and s[0] > 0
+
+
+class TestDriftMonitor:
+    def test_no_baseline_state_is_distinct(self, obs_on):
+        m = DriftMonitor(None, rate=1.0, num_features=3, autostart=False)
+        m.offer(np.zeros((2, 3), np.float32))
+        summary = m.export()
+        assert summary["baseline"] == "absent"
+        assert summary["scores"] is None  # never fabricated
+        present = [i for i in obs_on.instruments()
+                   if i.name == "knn_drift_baseline_present"]
+        assert len(present) == 1 and present[0].value == 0
+        assert not any(i.name == "knn_drift_score"
+                       for i in obs_on.instruments())
+        m.close()
+
+    def test_live_vs_training_distribution(self, obs_on, rng):
+        train = rng.normal(0.0, 1.0, (2000, 3)).astype(np.float32)
+        ref = StreamSketch.from_data(train).to_dict()
+        m = DriftMonitor(ref, rate=1.0, num_features=3)
+        try:
+            # Same distribution: low score.
+            for lo in range(0, 1000, 50):
+                m.offer(train[lo:lo + 50])
+            assert m.drain(20)
+            same = m.export()["scores"]["max"]
+            assert same < 0.5
+            # Shifted queries: the score must rise well above.
+            m2 = DriftMonitor(ref, rate=1.0, num_features=3)
+            try:
+                shifted = train[:1000] + 5.0
+                for lo in range(0, 1000, 50):
+                    m2.offer(shifted[lo:lo + 50])
+                assert m2.drain(20)
+                far = m2.export()["scores"]["max"]
+                assert far > 2.0 > same
+            finally:
+                m2.close()
+        finally:
+            m.close()
+
+    def test_shed_on_overload_never_blocks(self, obs_on):
+        m = DriftMonitor(None, rate=1.0, num_features=2, queue_cap=2,
+                         autostart=False)  # no worker: queue can only fill
+        rows = np.zeros((1, 2), np.float32)
+        assert m.offer(rows) and m.offer(rows)
+        t0 = time.monotonic()
+        assert not m.offer(rows)  # full -> shed, immediately
+        assert time.monotonic() - t0 < 0.1
+        assert m.shed == 1
+        shed = [i for i in obs_on.instruments()
+                if i.name == "knn_drift_shed_total"]
+        assert len(shed) == 1 and shed[0].value == 1
+        m.close()
+
+    def test_set_reference_swaps_baseline(self, rng):
+        train = rng.normal(size=(100, 2)).astype(np.float32)
+        m = DriftMonitor(None, rate=1.0, num_features=2, autostart=False)
+        assert not m.baseline_present
+        m.set_reference(StreamSketch.from_data(train).to_dict())
+        assert m.baseline_present
+        m.set_reference(None)  # a pre-sketch rollback
+        assert not m.baseline_present
+        m.close()
+
+    def test_rate_validation(self):
+        with pytest.raises(ValueError, match="rate"):
+            DriftMonitor(None, rate=1.5, num_features=1, autostart=False)
+        with pytest.raises(ValueError, match="queue_cap"):
+            DriftMonitor(None, rate=0.5, num_features=1, queue_cap=0,
+                         autostart=False)
+
+    def test_wrong_width_reference_fails_at_boot_not_scrape(self, rng):
+        """A manifest sketch whose width disagrees with the index must
+        raise at construction/reload time (ValueError -> CLI exit 2 /
+        reload rolled back), never as a numpy broadcast error inside the
+        first /metrics scrape."""
+        ref = StreamSketch.from_data(
+            rng.normal(size=(50, 4)).astype(np.float32)).to_dict()
+        with pytest.raises(ValueError, match="4 features"):
+            DriftMonitor(ref, rate=1.0, num_features=3, autostart=False)
+        m = DriftMonitor(None, rate=1.0, num_features=3, autostart=False)
+        with pytest.raises(ValueError, match="4 features"):
+            m.set_reference(ref)
+        assert not m.baseline_present  # the failed swap changed nothing
+        m.close()
+
+    def test_malformed_sketch_is_a_value_error(self):
+        with pytest.raises(ValueError, match="malformed drift sketch"):
+            DriftMonitor({"count": 3}, rate=1.0, num_features=2,
+                         autostart=False)
+
+    def test_baseline_removal_zeroes_exported_scores(self, obs_on, rng):
+        """A hot reload to a pre-sketch artifact must not leave the
+        previous index's drift scores frozen in the registry."""
+        train = rng.normal(size=(200, 2)).astype(np.float32)
+        ref = StreamSketch.from_data(train).to_dict()
+        m = DriftMonitor(ref, rate=1.0, num_features=2, autostart=False)
+        with m._sketch_lock:
+            m.live.update(train[:50] + 10.0)  # worker held off: fold direct
+        assert m.export()["scores"]["max"] > 0
+        gauges = {dict(i.labels)["stat"]: i for i in obs_on.instruments()
+                  if i.name == "knn_drift_score"}
+        assert gauges["max"].value > 0
+        m.set_reference(None)  # the pre-sketch rollback
+        summary = m.export()
+        assert summary["baseline"] == "absent" and summary["scores"] is None
+        assert gauges["max"].value == 0.0 and gauges["mean"].value == 0.0
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# ShadowScorer
+
+
+class TestShadowScorer:
+    def test_shed_on_overload_never_blocks_the_producer(self, obs_on, rng):
+        train = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        s = ShadowScorer(1.0, queue_cap=2, autostart=False)  # worker off
+        feats = train.features[:1]
+        kw = dict(features=feats, kind="kneighbors",
+                  dists=np.zeros((1, 3)), idx=np.zeros((1, 3), np.int64),
+                  preds=None, rung="fast", model=model, version="v1")
+        assert s.offer(**kw) and s.offer(**kw)
+        t0 = time.monotonic()
+        assert not s.offer(**kw)  # full -> shed, immediately, never blocks
+        assert time.monotonic() - t0 < 0.1
+        assert s.shed == 1
+        shed = [i for i in obs_on.instruments()
+                if i.name == "knn_quality_shed_total"]
+        assert len(shed) == 1 and shed[0].value == 1
+        s.close()
+
+    def test_producer_not_blocked_while_worker_scores(self, obs_on, rng):
+        """The two-lock contract: offers complete fast even while the
+        background worker is mid-score on a queue of samples."""
+        train = _problem(rng, n=400)
+        model = KNNClassifier(k=5, engine="xla").fit(train)
+        s = ShadowScorer(1.0, queue_cap=512)
+        d, i = model.kneighbors(Dataset(
+            train.features[:50], np.zeros(50, np.int32)))
+        kw = dict(features=train.features[:50], kind="kneighbors",
+                  dists=d, idx=i, preds=None, rung="fast", model=model,
+                  version=None)
+        walls = []
+        for _ in range(40):
+            t0 = time.monotonic()
+            s.offer(**kw)
+            walls.append(time.monotonic() - t0)
+        assert max(walls) < 0.1  # every offer O(1), scoring notwithstanding
+        assert s.drain(30)
+        s.close()
+        assert s.export()["rungs"]["fast"]["recall"] == 1.0
+
+    def test_exact_serving_scores_recall_one(self, obs_on, rng):
+        train = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        slo = SLOTracker(windows_s=(60,))
+        scorer = ShadowScorer(1.0, seed=0, slo=slo)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=0.5,
+                          quality=scorer) as b:
+            rows = rng.integers(0, 4, (12, 5)).astype(np.float32)
+            for r in rows:
+                b.predict(r, timeout=30)
+            assert scorer.drain(30)
+        summary = scorer.export()
+        scorer.close()
+        fast = summary["rungs"]["fast"]
+        assert fast["recall"] == 1.0
+        assert fast["vote_accuracy"] == 1.0
+        assert fast["divergence"] == {}
+        assert slo.burn_rates()["quality"]["1m"] == 0.0
+        recall_g = [i for i in obs_on.instruments()
+                    if i.name == "knn_quality_recall"]
+        assert recall_g and all(g.value == 1.0 for g in recall_g)
+
+    def test_corrupted_index_detected_and_attributed(self, obs_on, rng):
+        """THE detection contract: a silently-wrong index (every response
+        still 200, availability green) must burn the quality SLI and
+        localize to the answering rung."""
+        train = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        slo = SLOTracker(windows_s=(60,))
+        scorer = ShadowScorer(1.0, seed=0, slo=slo)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=0.5,
+                          quality=scorer) as b:
+            b.corrupt_serving = True  # the quality-soak test hook
+            rows = rng.integers(0, 4, (12, 5)).astype(np.float32)
+            for r in rows:
+                b.predict(r, timeout=30)  # still answers "successfully"
+            assert scorer.drain(30)
+        summary = scorer.export()
+        scorer.close()
+        fast = summary["rungs"]["fast"]
+        assert fast["recall"] < 1.0
+        assert fast["divergence"].get("neighbors", 0) > 0
+        assert slo.burn_rates()["quality"]["1m"] > 1.0
+        div = {tuple(sorted(dict(i.labels).items())): i.value
+               for i in obs_on.instruments()
+               if i.name == "knn_quality_divergence_total"}
+        assert any(dict(k)["rung"] == "fast" for k in div)
+
+    def test_kneighbors_requests_scored_without_vote(self, obs_on, rng):
+        train = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        scorer = ShadowScorer(1.0, seed=0)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=0.5,
+                          quality=scorer) as b:
+            b.kneighbors(train.features[0], timeout=30)
+            assert scorer.drain(30)
+        summary = scorer.export()
+        scorer.close()
+        fast = summary["rungs"]["fast"]
+        assert fast["recall"] == 1.0 and fast["vote_accuracy"] is None
+
+    def test_sampling_is_seeded_and_deterministic(self, rng):
+        draws = []
+        for _ in range(2):
+            s = ShadowScorer(0.5, seed=123, autostart=False)
+            picked = []
+            for j in range(50):
+                picked.append(s.offer(
+                    features=np.zeros((1, 2), np.float32),
+                    kind="kneighbors", dists=np.zeros((1, 1)),
+                    idx=np.zeros((1, 1), np.int64), preds=None,
+                    rung="fast", model=None, version=None))
+            s.close()
+            draws.append(picked)
+        assert draws[0] == draws[1]
+        assert 5 < sum(draws[0]) < 45  # actually sampling, not all/none
+
+    def test_score_across_model_snapshot(self, obs_on, rng):
+        """A sample carries ITS batch's model: answers served by the old
+        index are scored against the old index even after a swap (the
+        hot-reload correctness rule)."""
+        train_a = _problem(rng)
+        train_b = Dataset(train_a.features + 100.0, train_a.labels)
+        model_a = KNNClassifier(k=3, engine="xla").fit(train_a)
+        model_b = KNNClassifier(k=3, engine="xla").fit(train_b)
+        scorer = ShadowScorer(1.0, seed=0, autostart=False)
+        d, i = model_a.kneighbors(Dataset(
+            train_a.features[:2], np.zeros(2, np.int32)))
+        assert scorer.offer(features=train_a.features[:2],
+                            kind="kneighbors", dists=d, idx=i, preds=None,
+                            rung="fast", model=model_a, version="a")
+        # Swap happens before scoring: worker starts late, sample must
+        # still score 1.0 because it references model_a, not "the current
+        # model".
+        scorer._sq.start()
+        assert scorer.drain(30)
+        scorer.close()
+        assert scorer.export()["rungs"]["fast"]["recall"] == 1.0
+        del model_b
+
+    def test_rate_validation(self):
+        with pytest.raises(ValueError, match="shadow rate"):
+            ShadowScorer(0.0, autostart=False)
+        with pytest.raises(ValueError, match="shadow rate"):
+            ShadowScorer(1.5, autostart=False)
+        with pytest.raises(ValueError, match="queue_cap"):
+            ShadowScorer(0.5, queue_cap=0, autostart=False)
+
+    def test_scoring_errors_counted_not_raised(self, obs_on):
+        scorer = ShadowScorer(1.0, seed=0)
+        # model=None makes _score raise; the worker must absorb it.
+        assert scorer.offer(features=np.zeros((1, 2), np.float32),
+                            kind="kneighbors", dists=np.zeros((1, 1)),
+                            idx=np.zeros((1, 1), np.int64), preds=None,
+                            rung="fast", model=None, version=None)
+        deadline = time.monotonic() + 10
+        while scorer.score_errors == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        scorer.close()
+        assert scorer.score_errors == 1
+        errs = [i for i in obs_on.instruments()
+                if i.name == "knn_quality_errors_total"]
+        assert len(errs) == 1 and errs[0].value == 1
+
+
+# ---------------------------------------------------------------------------
+# The quality SLI in the SLO tracker
+
+
+class TestQualitySLO:
+    def test_quality_burn_from_shadow_events(self):
+        s = SLOTracker(quality_target=0.9, windows_s=(60,))
+        for good in (True, True, False, False):
+            s.record_quality(good)
+        burns = s.burn_rates()
+        # 50% bad / 10% budget = burn 5.
+        assert burns["quality"]["1m"] == pytest.approx(5.0)
+        # HTTP-outcome SLIs are untouched by quality events.
+        assert burns["availability"]["1m"] == 0.0
+
+    def test_http_outcomes_do_not_move_quality(self):
+        s = SLOTracker(windows_s=(60,))
+        for _ in range(10):
+            s.record(ok=True, latency_ms=1.0)
+        assert s.burn_rates()["quality"]["1m"] == 0.0  # no scored events
+
+    def test_quality_target_validated_and_exported(self):
+        with pytest.raises(ValueError, match="quality_target"):
+            SLOTracker(quality_target=1.0)
+        doc = SLOTracker(windows_s=(60,)).export()
+        assert "quality" in doc["burn_rates"]
+        assert doc["targets"]["quality"] == 0.999

@@ -682,7 +682,8 @@ class TestServerSLOAndAccessLog:
         h = json.loads(raw)
         assert st == 200
         burns = h["slo"]["burn_rates"]
-        assert set(burns) == {"availability", "latency", "fast_rung"}
+        assert set(burns) == {"availability", "latency", "fast_rung",
+                              "quality"}
         assert burns["availability"]["5s"] == 0.0  # all-200 traffic
         assert h["flight_recorder"]["completed"] >= 1
 

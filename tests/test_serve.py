@@ -344,7 +344,7 @@ class TestArtifact:
         train, _ = _problem(rng)
         out = save_index(KNNClassifier(k=5).fit(train), tmp_path / "m")
         manifest = json.loads((out / "manifest.json").read_text())
-        assert manifest["format"] == 1
+        assert manifest["format"] == 2
         assert manifest["family"] == "classifier"
         assert manifest["k"] == 5
         assert manifest["metric"] == "euclidean"
@@ -353,6 +353,47 @@ class TestArtifact:
         assert manifest["num_features"] == train.num_features
         assert manifest["num_classes"] == train.num_classes
         assert manifest["schema_hash"] == schema_hash(train)
+        # Format 2: the training-distribution sketch for drift detection
+        # (obs/drift.py) rides the manifest.
+        sketch = manifest["drift_sketch"]
+        assert sketch["count"] == train.num_instances
+        assert sketch["num_features"] == train.num_features
+        assert len(sketch["mean"]) == train.num_features
+        np.testing.assert_allclose(
+            np.asarray(sketch["mean"]),
+            train.features.astype(np.float64).mean(axis=0), atol=1e-6)
+
+    def test_pre_sketch_artifact_loads_and_reports_no_baseline(
+            self, rng, tmp_path):
+        """The format-bump back-compat guard: a format-1 (sketch-less)
+        artifact round-trips cleanly — identical predictions — and drift
+        reports the DISTINCT no-baseline state, never fabricated
+        scores."""
+        from knn_tpu.obs.drift import DriftMonitor
+        from knn_tpu.serve.artifact import read_manifest, reference_sketch
+
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+        out = save_index(model, tmp_path / "v1")
+        # Rewrite the manifest as a format-1 artifact (what a pre-PR-7
+        # save-index produced): no drift_sketch, format 1.
+        mf = out / "manifest.json"
+        doc = json.loads(mf.read_text())
+        doc["format"] = 1
+        del doc["drift_sketch"]
+        mf.write_text(json.dumps(doc))
+        loaded = load_index(out)  # loads cleanly, no error
+        np.testing.assert_array_equal(loaded.predict(test), want)
+        manifest = read_manifest(out)
+        assert reference_sketch(manifest) is None
+        m = DriftMonitor(reference_sketch(manifest), rate=1.0,
+                         num_features=train.num_features, autostart=False)
+        m.offer(test.features[:4])
+        summary = m.export()
+        m.close()
+        assert summary["baseline"] == "absent"
+        assert summary["scores"] is None
 
     def test_missing_artifact_typed(self, tmp_path):
         with pytest.raises(DataError, match="not found"):
@@ -610,3 +651,92 @@ class TestServer:
         assert _get(base, "/explain")[0] == 404
         st, _ = _post(base, "/train", {"instances": []})
         assert st == 404
+
+
+class TestServerQuality:
+    """The quality surfaces (docs/OBSERVABILITY.md §Quality & drift):
+    /debug/quality, the /healthz quality block, and the knn_quality_*/
+    knn_drift_* scrape rows — plus the disabled shape (rate 0 builds
+    NOTHING)."""
+
+    @pytest.fixture
+    def served_quality(self, rng, obs_on, tmp_path):
+        """A warmed server with shadow scoring + drift on at rate 1 and a
+        real training-sketch baseline."""
+        from knn_tpu.obs.drift import StreamSketch
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        app = ServeApp(
+            model, max_batch=16, max_wait_ms=1.0,
+            shadow_rate=1.0, drift_rate=1.0, quality_queue=1024,
+            reference_sketch=StreamSketch.from_data(
+                train.features).to_dict(),
+        )
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        app.warm((1, 4))
+        try:
+            yield f"http://{host}:{port}", model, test, app
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+            thread.join(timeout=10)
+
+    def test_debug_quality_joins_recall_drift_and_burn(self,
+                                                       served_quality):
+        base, model, test, app = served_quality
+        st, _ = _post(base, "/predict",
+                      {"instances": test.features[:8].tolist()})
+        assert st == 200
+        assert app.quality.drain(30) and app.drift.drain(30)
+        st, body = _get(base, "/debug/quality")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["enabled"] == {"shadow": True, "drift": True}
+        fast = doc["shadow"]["rungs"]["fast"]
+        assert fast["recall"] == 1.0 and fast["divergence"] == {}
+        assert doc["drift"]["baseline"] == "present"
+        assert doc["drift"]["scores"] is not None
+        assert "burn_rates" in doc["slo_quality"]
+
+    def test_healthz_quality_block_and_metrics(self, served_quality):
+        base, _, test, app = served_quality
+        _post(base, "/predict", {"instances": test.features[:4].tolist()})
+        assert app.quality.drain(30)
+        st, body = _get(base, "/healthz")
+        h = json.loads(body)
+        assert st == 200
+        assert h["quality"]["shadow"]["scored"] >= 1
+        assert h["quality"]["drift"]["baseline"] == "present"
+        st, text = _get(base, "/metrics")
+        assert st == 200
+        for name in ("knn_quality_recall", "knn_quality_scored_total",
+                     "knn_drift_baseline_present",
+                     'knn_slo_burn_rate{objective="quality"'):
+            assert name in text, name
+
+    def test_disabled_layers_report_null_not_404(self, served):
+        """Rate 0 (the default) constructs nothing; /debug/quality stays
+        routable for dashboards and says so."""
+        base, _, _, app = served
+        assert app.quality is None and app.drift is None
+        st, body = _get(base, "/debug/quality")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["enabled"] == {"shadow": False, "drift": False}
+        assert doc["shadow"] is None and doc["drift"] is None
+        st, body = _get(base, "/healthz")
+        q = json.loads(body)["quality"]
+        assert q == {"shadow": None, "drift": None}
+
+    def test_shadow_on_keeps_responses_bit_identical(self, served_quality):
+        base, model, test, _ = served_quality
+        want = model.predict(test).tolist()
+        st, body = _post(base, "/predict",
+                         {"instances": test.features.tolist()})
+        assert st == 200 and body["predictions"] == want
