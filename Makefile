@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak capacity-probe bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -106,6 +106,19 @@ chaos-soak:
 quality-soak:
 	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/quality_soak.py \
 		--short --json-out build/quality-soak-verdict.json
+
+# The cost & capacity gate (docs/OBSERVABILITY.md §Cost & capacity): boot
+# serve with cost accounting on and assert (1) every 200's timeline
+# carries an attributed cost block, (2) attribution CONSERVES — summed
+# per-class knn_cost_device_ms_total equals the measured dispatch walls
+# to float tolerance, from both /debug/capacity and the Prometheus text —
+# and (3) an open-loop ramp finds the real load knee within the
+# documented tolerance band of the headroom model's low-load
+# sustainable-QPS estimate. The verdict JSON lands in build/ (CI uploads
+# it as a workflow artifact).
+capacity-probe:
+	JAX_PLATFORMS=cpu python3 scripts/capacity_probe.py --short \
+		--json-out build/capacity-probe-verdict.json
 
 bench:
 	python3 bench.py

@@ -1126,6 +1126,44 @@ def bench_serving():
         f"{record['shadow_scored']} scored / {record['shadow_shed']} shed, "
         f"recall {record['shadow_recall']}) vs shadow-off "
         f"{c8['batched_p50_ms']} ms")
+    # Cost & capacity telemetry, measured (the PR 8 acceptance): the same
+    # c=8 batched run with the accounting + capacity layers attached —
+    # occupancy says how full the compiled batch shape ran, waste ratio
+    # what the shape quantum padded on top, duty cycle how busy the worker
+    # was at this load. The p50 delta vs the bare batched run is the
+    # layers' per-request price (expected: inside closed-loop noise).
+    from knn_tpu.obs.accounting import CostAccountant
+    from knn_tpu.obs.capacity import CapacityTracker
+
+    accountant = CostAccountant()
+    capacity = CapacityTracker(MAX_BATCH, window_s=300)
+    costed = MicroBatcher(model, max_batch=MAX_BATCH,
+                          max_wait_ms=MAX_WAIT_MS, accounting=accountant,
+                          capacity=capacity)
+    try:
+        cc_lats, cc_wall, cc_err = closed_loop(
+            8, lambda row: costed.predict(row, timeout=120))
+    finally:
+        costed.close()
+    failed += cc_err
+    cap_doc = capacity.export()
+    cost_totals = accountant.export()["totals"]
+    record["c8_cost_p50_ms"] = pct(cc_lats, 50)
+    record["c8_occupancy_mean"] = cap_doc["occupancy_mean"]
+    record["c8_padded_row_waste_ratio"] = cap_doc["padded_row_waste_ratio"]
+    record["c8_duty_cycle"] = cap_doc["duty_cycle"]
+    record["cost_conservation_ok"] = bool(
+        abs(cost_totals["attributed_ms"] - cost_totals["dispatch_wall_ms"])
+        <= 1e-6 * max(1.0, cost_totals["dispatch_wall_ms"])
+    )
+    log(f"serving c=8 with cost accounting: p50 "
+        f"{record['c8_cost_p50_ms']} ms vs bare {c8['batched_p50_ms']} ms; "
+        f"occupancy {record['c8_occupancy_mean']}, padded-row waste "
+        f"{record['c8_padded_row_waste_ratio']}, duty cycle "
+        f"{record['c8_duty_cycle']}, conservation "
+        f"{record['cost_conservation_ok']} "
+        f"({cost_totals['attributed_ms']:.3f} of "
+        f"{cost_totals['dispatch_wall_ms']:.3f} ms attributed)")
     # Self-diagnosis: shed load must be visible in the artifact.
     reg = obs.registry()
     record["dropped_requests"] = sum(
@@ -1189,12 +1227,22 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
     # Obs stays in whatever state the caller left it: the gate compares
     # gate-to-gate records, so baseline and fresh measure the same
     # (default: uninstrumented) path.
+    from knn_tpu.obs.capacity import CapacityTracker
+
     serve_trials = []
+    occ_trials, duty_trials, waste_trials = [], [], []
     reqs, conc = 15, 8
     for _ in range(serving_trials):
         lats = []
         lock = threading.Lock()
-        batcher = MicroBatcher(model, max_batch=64, max_wait_ms=2.0)
+        # Batching-efficiency telemetry rides the gate record as
+        # REPORT-ONLY metrics (absent from the committed baseline ->
+        # regress.compare_records lists them under new_metrics, never
+        # gates): occupancy/duty/waste at this fixed load are visibility,
+        # not a pass/fail surface yet.
+        capacity = CapacityTracker(64, window_s=120)
+        batcher = MicroBatcher(model, max_batch=64, max_wait_ms=2.0,
+                               capacity=capacity)
         try:
             batcher.predict(test.features[0], timeout=120)  # warm the path
 
@@ -1221,7 +1269,12 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
             batcher.close()
         if lats:
             serve_trials.append(round(float(np.percentile(lats, 50)), 3))
-    log(f"gate serving c8 p50: {serve_trials} ms")
+        cap_doc = capacity.export()
+        occ_trials.append(cap_doc["occupancy_mean"])
+        duty_trials.append(cap_doc["duty_cycle"])
+        waste_trials.append(cap_doc["padded_row_waste_ratio"])
+    log(f"gate serving c8 p50: {serve_trials} ms (occupancy {occ_trials}, "
+        f"duty {duty_trials}, padded-row waste {waste_trials})")
 
     d = Path(__file__).parent / "build" / "fixtures"
     ref = Path("/root/reference/datasets")
@@ -1263,6 +1316,17 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
                                    "direction": "lower", "unit": "ms"},
             "serve_c8_p50_ms": {"trials": serve_trials,
                                 "direction": "lower", "unit": "ms"},
+            # PR 8 batching-efficiency telemetry: report-only until a
+            # baseline entry carries them (new metrics never gate —
+            # obs/regress.py).
+            "serve_c8_occupancy_mean": {"trials": occ_trials,
+                                        "direction": "higher",
+                                        "unit": "ratio"},
+            "serve_c8_duty_cycle": {"trials": duty_trials,
+                                    "direction": "lower", "unit": "ratio"},
+            "serve_c8_padded_row_waste_ratio": {"trials": waste_trials,
+                                                "direction": "lower",
+                                                "unit": "ratio"},
             "ingest_ms": {"trials": ingest_trials, "direction": "lower",
                           "unit": "ms", "parser": parser},
         },
@@ -1303,7 +1367,9 @@ _SUMMARY_EXTRA = {
     "serving": ("c8_batched_p50_ms", "c8_seq_p50_ms", "c8_batched_qps",
                 "batched_beats_seq_c8", "c8_traced_p50_ms",
                 "c8_shadow_p50_ms", "shadow_scored", "shadow_shed",
-                "shadow_recall", "dropped_requests", "deadline_expired"),
+                "shadow_recall", "dropped_requests", "deadline_expired",
+                "c8_occupancy_mean", "c8_padded_row_waste_ratio",
+                "c8_duty_cycle"),
 }
 
 

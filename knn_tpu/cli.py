@@ -171,6 +171,16 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--slo-quality-target", type=float, default=0.999,
                    help="quality SLO: target fraction of shadow-scored "
                    "requests whose answers match the oracle rung exactly")
+    p.add_argument("--cost-accounting", choices=["on", "off"], default="on",
+                   help="per-request device-cost attribution + the "
+                   "capacity/headroom model (knn_cost_*/knn_capacity_* "
+                   "metrics, GET /debug/capacity, the x-knn-class request "
+                   "class tag — docs/OBSERVABILITY.md §Cost & capacity); "
+                   "'off' constructs nothing and skips class-header "
+                   "parsing entirely")
+    p.add_argument("--capacity-window-s", type=int, default=60,
+                   help="trailing observation window for the capacity "
+                   "rate rings / duty cycle / headroom model")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -551,6 +561,9 @@ def _run_serve(args, stdout) -> int:
         (not 0 < args.slo_quality_target < 1,
          f"--slo-quality-target must be in (0, 1), got "
          f"{args.slo_quality_target}"),
+        (args.capacity_window_s < 5,
+         f"--capacity-window-s must be >= 5 (shorter windows make every "
+         f"rate gauge noise), got {args.capacity_window_s}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -617,6 +630,8 @@ def _run_serve(args, stdout) -> int:
             shadow_rate=args.shadow_rate, drift_rate=args.drift_rate,
             quality_queue=args.quality_queue, quality_seed=args.quality_seed,
             reference_sketch=artifact.reference_sketch(manifest),
+            cost_accounting=(args.cost_accounting == "on"),
+            capacity_window_s=args.capacity_window_s,
         )
     except OSError as e:  # an unwritable --access-log path
         print(f"error: --access-log {args.access_log}: {e}", file=sys.stderr)

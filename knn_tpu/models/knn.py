@@ -16,6 +16,13 @@ from knn_tpu.backends import get_backend
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.utils.evaluate import confusion_matrix, accuracy
 
+#: Query rows pad to this quantum on the XLA retrieval path (one warm
+#: executable then serves every batch size up to it). The ONE definition:
+#: the executable-cache key below and the cost layer's padded-row
+#: accounting (obs/accounting.py) both resolve from here, so they can
+#: never silently diverge from the pad that really happens.
+QUERY_PAD_QUANTUM = 128
+
 
 def _kneighbors_arrays(
     train_x: np.ndarray,
@@ -81,7 +88,8 @@ def _kneighbors_arrays(
                 engine,
                 -(-train_x.shape[0] // n_tile) * n_tile, train_x.shape[1],
                 train_x.dtype.str,
-                -(-test_x.shape[0] // 128) * 128,
+                -(-test_x.shape[0] // QUERY_PAD_QUANTUM)
+                * QUERY_PAD_QUANTUM,
                 k, form,
             )
         devprof.record_executable_lookup("retrieval", sig)
@@ -91,7 +99,25 @@ def _kneighbors_arrays(
         from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
         from knn_tpu.resilience.retry import guarded_call
 
-        with obs.span("distance", engine="stripe", note="fused distance+top-k"):
+        span_attrs = {}
+        if obs.enabled():
+            # Compiled-shape rows alongside the actual rows: the stripe
+            # kernel pads queries to its resolved block_q grid, and that
+            # padding is dispatch cost the span should own up to — the
+            # same helper the serving cost layer attributes with, so the
+            # two can never silently diverge
+            # (docs/OBSERVABILITY.md §Cost & capacity).
+            from knn_tpu.obs.accounting import padded_query_rows
+
+            span_attrs = dict(
+                rows=test_x.shape[0],
+                padded_rows=padded_query_rows(
+                    "stripe", test_x.shape[0],
+                    num_features=train_x.shape[1], k=k,
+                ),
+            )
+        with obs.span("distance", engine="stripe", note="fused distance+top-k",
+                      **span_attrs):
             out = guarded_call("device.put", lambda: guarded_call(
                 "backend.compile", lambda: stripe_candidates_arrays(
                     train_x, test_x, k, precision="exact", cache=cache,
@@ -121,12 +147,15 @@ def _kneighbors_arrays(
         txj, tyj = guarded_call("device.put", lambda: memo_device(
             cache, ("xla_candidates_train", train_tile), make
         ))
-        qx, _ = pad_axis_to_multiple(test_x, 128, axis=0)
+        qx, _ = pad_axis_to_multiple(test_x, QUERY_PAD_QUANTUM, axis=0)
     import jax
 
     # The fused distance + running-top-k dispatch (one executable; the two
     # logical phases are inseparable on the XLA path — docs/OBSERVABILITY.md).
-    with obs.span("distance", engine="xla", note="fused distance+top-k"):
+    # rows vs padded_rows: the 128-row query pad is dispatch cost this span
+    # owns up to (docs/OBSERVABILITY.md §Cost & capacity).
+    with obs.span("distance", engine="xla", note="fused distance+top-k",
+                  rows=q, padded_rows=qx.shape[0]):
         d, i, _ = guarded_call("backend.compile", lambda: knn_forward_candidates(
             txj, tyj, jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),

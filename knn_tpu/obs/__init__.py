@@ -41,7 +41,18 @@ pair around the KNN loop printed as a single milliseconds number
   straggler gauges over the sharded dispatch walls;
 - :mod:`knn_tpu.obs.regress`  — the noise-aware perf-regression
   comparison (best-of-mins with MAD tolerance) behind
-  ``scripts/bench_gate.py`` / ``make bench-gate``.
+  ``scripts/bench_gate.py`` / ``make bench-gate``;
+- :mod:`knn_tpu.obs.accounting` — per-request device-cost attribution:
+  each serving dispatch's measured wall/bytes split across its coalesced
+  requests proportional to rows (conservation-exact), tagged by request
+  class and answering rung (``knn_cost_*``), padded compiled-shape rows
+  counted as waste;
+- :mod:`knn_tpu.obs.capacity` — saturation & headroom: worker duty
+  cycle, batch occupancy, arrival/served rate rings (on
+  :class:`~knn_tpu.obs.slo.SecondRing`), a Little's-law concurrency
+  estimate, and the affine dispatch-cost headroom model behind
+  ``GET /debug/capacity`` and ``make capacity-probe``
+  (``knn_capacity_*``).
 
 Everything is OFF by default and zero-cost when off: ``span()`` returns a
 shared no-op context manager and the metric helpers return immediately, so

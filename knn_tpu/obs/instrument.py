@@ -146,11 +146,15 @@ def record_serve_queue_wait(ms: float, kind: str) -> None:
     )
 
 
-def record_serve_batch(requests: int, rows: int, dispatch_ms: float) -> None:
+def record_serve_batch(requests: int, rows: int, dispatch_ms: float,
+                       padded_rows: "int | None" = None) -> None:
     """Record one dispatched micro-batch. ``knn_serve_batch_size`` counts
     REQUESTS coalesced per dispatch — the number whose histogram exceeding
     1 is the measured proof that dynamic batching engages (pinned by
-    tests/test_serve.py); ``knn_serve_batch_rows`` counts query rows."""
+    tests/test_serve.py); ``knn_serve_batch_rows`` counts actual query
+    rows, ``knn_serve_batch_padded_rows`` the compiled-shape rows the
+    engine really swept (XLA pads queries to 128, stripe to its block
+    grid) — the gap between the two histograms IS the padding waste."""
     obs.histogram_observe(
         "knn_serve_batch_size", requests, buckets=SERVE_BATCH_BUCKETS,
         help="requests coalesced per dispatched micro-batch",
@@ -159,6 +163,14 @@ def record_serve_batch(requests: int, rows: int, dispatch_ms: float) -> None:
         "knn_serve_batch_rows", rows, buckets=SERVE_BATCH_BUCKETS,
         help="query rows per dispatched micro-batch",
     )
+    if padded_rows is not None:
+        obs.histogram_observe(
+            "knn_serve_batch_padded_rows", padded_rows,
+            buckets=SERVE_BATCH_BUCKETS,
+            help="compiled-shape query rows per dispatched micro-batch "
+                 "(actual rows + the padding the engine's shape quantum "
+                 "forced)",
+        )
     obs.histogram_observe(
         "knn_serve_dispatch_ms", dispatch_ms, buckets=SERVE_MS_BUCKETS,
         help="engine dispatch wall ms per micro-batch (kneighbors + "

@@ -289,6 +289,11 @@ class FlightRecorder:
                 "rows": tl.get("rows"), "outcome": tl.get("outcome"),
                 "rung": tl.get("rung"),
             }
+            # Cost attribution (obs/accounting.py), when the layer is on:
+            # what this request paid rides its Perfetto track too.
+            for extra in ("request_class", "cost"):
+                if extra in tl:
+                    args[extra] = tl[extra]
             events.append(dict(common, ph="B", name=f"request:{tl.get('outcome')}",
                                ts=base_us, args=args))
             for p in tl.get("phases", ()):

@@ -61,6 +61,65 @@ def window_label(seconds: int) -> str:
     return f"{seconds}s"
 
 
+class SecondRing:
+    """A trailing-window ring of per-slot counter sums — THE per-second
+    machinery under the SLO SLIs, shared with :mod:`knn_tpu.obs.capacity`'s
+    arrival/served/dispatch rate rings.
+
+    Each slot holds ``[slot_stamp, field_0, ..., field_{n-1}]``; ``add``
+    is O(1) (stale slots are lazily reset on reuse), ``window_sums`` is
+    O(ring) and only runs at scrape/export time. Slot width widens past an
+    hour so the ring stays bounded at ~3600 slots whatever the longest
+    window is (the PR 5 bounding rule). Field values may be ints or floats
+    (they are sums, e.g. busy milliseconds), all under one lock.
+    """
+
+    def __init__(self, fields: int, max_window_s: int):
+        if fields < 1:
+            raise ValueError(f"fields must be >= 1, got {fields}")
+        if max_window_s < 1:
+            raise ValueError(
+                f"max_window_s must be >= 1, got {max_window_s}")
+        self.fields = int(fields)
+        self.slot_s = max(1, -(-int(max_window_s) // 3600))
+        size = -(-int(max_window_s) // self.slot_s)
+        self._lock = threading.Lock()
+        self._slots = [[0] * (self.fields + 1) for _ in range(size)]
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def _now_slot(self) -> int:
+        return int(time.monotonic() // self.slot_s)
+
+    def add(self, *deltas) -> None:
+        """Fold one event's field deltas into the current slot (O(1))."""
+        if len(deltas) != self.fields:
+            raise ValueError(
+                f"expected {self.fields} field deltas, got {len(deltas)}")
+        now = self._now_slot()
+        slot = self._slots[now % len(self._slots)]
+        with self._lock:
+            if slot[0] != now:
+                slot[0] = now
+                for i in range(1, len(slot)):
+                    slot[i] = 0
+            for i, d in enumerate(deltas, 1):
+                slot[i] += d
+
+    def window_sums(self, window_s: int) -> Tuple:
+        """Per-field totals over the trailing ``window_s`` seconds."""
+        now = self._now_slot()
+        lo = now - max(1, int(window_s) // self.slot_s)
+        totals = [0] * self.fields
+        with self._lock:
+            for slot in self._slots:
+                if lo < slot[0] <= now:
+                    for i in range(self.fields):
+                        totals[i] += slot[i + 1]
+        return tuple(totals)
+
+
 class SLOTracker:
     """Multi-window burn-rate tracker over per-second outcome buckets.
 
@@ -96,21 +155,16 @@ class SLOTracker:
         }
         self.latency_target_ms = float(latency_target_ms)
         self.windows_s = ws
-        # Bound the ring at ~3600 slots whatever the longest window is:
-        # second-wide slots up to an hour, coarser beyond (a 30-day window
-        # gets 12-minute slots — burn rates at that horizon don't need
-        # per-second resolution, and an unbounded ring would be a
-        # several-hundred-MB allocation plus an O(window) scrape scan
-        # under the same lock record() takes).
-        self.slot_s = max(1, -(-ws[-1] // 3600))
-        size = -(-ws[-1] // self.slot_s)
-        self._lock = threading.Lock()
-        # Ring slot: [slot_stamp, total, ok, latency_ok, fast_ok]
-        self._ring = [[0, 0, 0, 0, 0] for _ in range(size)]
+        # Ring fields: [total, ok, latency_ok, fast_ok]; the ~3600-slot
+        # bounding (coarser slots past an hour — a 30-day window gets
+        # 12-minute slots) lives in SecondRing, shared with
+        # obs/capacity.py's rate rings.
+        self._ring = SecondRing(4, ws[-1])
+        self.slot_s = self._ring.slot_s
         # Quality rides its own ring at shadow-scoring cadence: a sampled
         # request scored seconds after it was served must not perturb the
-        # per-HTTP-outcome counters above. Slot: [slot_stamp, total, good].
-        self._qring = [[0, 0, 0] for _ in range(size)]
+        # per-HTTP-outcome counters above. Fields: [total, good].
+        self._qring = SecondRing(2, ws[-1])
 
     # -- recording (O(1)) --------------------------------------------------
 
@@ -119,60 +173,29 @@ class SLOTracker:
         """One terminal outcome: ``ok`` = answered 200, ``latency_ms`` =
         the request's wall, ``degraded`` = served by a fallback rung (or
         unknown — failures count degraded)."""
-        now = int(time.monotonic() // self.slot_s)
-        slot = self._ring[now % len(self._ring)]
-        with self._lock:
-            if slot[0] != now:
-                slot[0], slot[1], slot[2], slot[3], slot[4] = now, 0, 0, 0, 0
-            slot[1] += 1
-            if ok:
-                slot[2] += 1
-                if latency_ms <= self.latency_target_ms:
-                    slot[3] += 1
-                if not degraded:
-                    slot[4] += 1
+        self._ring.add(
+            1,
+            1 if ok else 0,
+            1 if ok and latency_ms <= self.latency_target_ms else 0,
+            1 if ok and not degraded else 0,
+        )
 
     def record_quality(self, good: bool) -> None:
         """One shadow-scored request (``obs/quality.py``): ``good`` = the
         served answer matched the oracle rung (recall 1.0 and vote
         agreement). Only sampled requests move this SLI."""
-        now = int(time.monotonic() // self.slot_s)
-        slot = self._qring[now % len(self._qring)]
-        with self._lock:
-            if slot[0] != now:
-                slot[0], slot[1], slot[2] = now, 0, 0
-            slot[1] += 1
-            if good:
-                slot[2] += 1
+        self._qring.add(1, 1 if good else 0)
 
     # -- aggregation (O(window), scrape-time only) -------------------------
 
     def window_counts(self, window_s: int) -> Tuple[int, int, int, int]:
         """``(total, ok, latency_ok, fast_ok)`` over the trailing window."""
-        now = int(time.monotonic() // self.slot_s)
-        lo = now - max(1, int(window_s) // self.slot_s)
-        total = ok = lat = fast = 0
-        with self._lock:
-            for slot in self._ring:
-                if lo < slot[0] <= now:
-                    total += slot[1]
-                    ok += slot[2]
-                    lat += slot[3]
-                    fast += slot[4]
-        return total, ok, lat, fast
+        return self._ring.window_sums(window_s)
 
     def quality_window_counts(self, window_s: int) -> Tuple[int, int]:
         """``(scored, good)`` shadow-scored events over the trailing
         window."""
-        now = int(time.monotonic() // self.slot_s)
-        lo = now - max(1, int(window_s) // self.slot_s)
-        total = good = 0
-        with self._lock:
-            for slot in self._qring:
-                if lo < slot[0] <= now:
-                    total += slot[1]
-                    good += slot[2]
-        return total, good
+        return self._qring.window_sums(window_s)
 
     def burn_rates(self) -> Dict[str, Dict[str, float]]:
         """``{objective: {window_label: burn}}``; burn 1.0 = spending the

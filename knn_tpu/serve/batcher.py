@@ -84,6 +84,7 @@ import numpy as np
 from knn_tpu import obs
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.models.knn import AsyncResult, KNNClassifier, _kneighbors_arrays
+from knn_tpu.obs import accounting as acct
 from knn_tpu.obs import instrument, reqtrace
 from knn_tpu.resilience import faults
 from knn_tpu.resilience.breaker import CircuitBreaker
@@ -105,12 +106,14 @@ class _Request:
 
     __slots__ = (
         "features", "kind", "rows", "enqueued_ns", "deadline_ns", "event",
-        "value", "error", "meta", "trace",
+        "value", "error", "meta", "trace", "request_class", "accounting",
     )
 
     def __init__(self, features: np.ndarray, kind: str,
                  deadline_ns: Optional[int],
-                 trace: "Optional[reqtrace.RequestTrace]" = None):
+                 trace: "Optional[reqtrace.RequestTrace]" = None,
+                 request_class: Optional[str] = None,
+                 accounting: "Optional[acct.CostAccountant]" = None):
         self.features = features
         self.kind = kind
         self.rows = features.shape[0]
@@ -121,6 +124,8 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.meta: dict = {}
         self.trace = trace
+        self.request_class = request_class
+        self.accounting = accounting
 
     # -- completion (worker side) -----------------------------------------
 
@@ -132,6 +137,11 @@ class _Request:
                 trace_id=(self.trace.request_id
                           if self.trace is not None else None),
             )
+            if self.accounting is not None:
+                # Class labels survive every terminal path (ok, expired,
+                # error): the per-class outcome counter is what makes a
+                # class's 504s visible next to its device spend.
+                self.accounting.note_outcome(self.request_class, outcome)
             if self.trace is not None:
                 if self.error is not None:
                     self.trace.annotate(
@@ -210,13 +220,29 @@ class MicroBatcher:
                          :class:`~knn_tpu.obs.drift.DriftMonitor`: served
                          query rows are offered to the drift sketch under
                          the same sampled, shed-on-overload contract.
+    ``accounting``     — an optional
+                         :class:`~knn_tpu.obs.accounting.CostAccountant`:
+                         every ladder-rung attempt's measured wall (and
+                         the answering attempt's transferred bytes) is
+                         attributed across the batch's live requests
+                         proportional to rows, tagged by request class and
+                         rung, with padded (compiled-shape) rows counted
+                         as waste — the ``knn_cost_*`` instrument set and
+                         the per-request ``cost`` block in futures' meta
+                         and flight-recorder timelines.
+    ``capacity``       — an optional
+                         :class:`~knn_tpu.obs.capacity.CapacityTracker`:
+                         arrivals, served requests, and dispatch
+                         busy-time/occupancy feed its rate rings and the
+                         headroom model (``knn_capacity_*``,
+                         ``GET /debug/capacity``).
     """
 
     def __init__(self, model, *, max_batch: int = 256,
                  max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
                  index_version: Optional[str] = None,
                  recorder: "Optional[reqtrace.FlightRecorder]" = None,
-                 quality=None, drift=None):
+                 quality=None, drift=None, accounting=None, capacity=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -232,6 +258,8 @@ class MicroBatcher:
         self.recorder = recorder
         self.quality = quality
         self.drift = drift
+        self.accounting = accounting
+        self.capacity = capacity
         # TEST-ONLY corruption hook (scripts/quality_soak.py): when armed
         # (the serve process installs a SIGUSR2 handler only under
         # KNN_TPU_TEST_QUALITY_CORRUPT), served neighbor indices are
@@ -261,7 +289,8 @@ class MicroBatcher:
 
     def submit(self, features, kind: str = "predict",
                deadline_ms: Optional[float] = None,
-               trace: "Optional[reqtrace.RequestTrace]" = None) -> AsyncResult:
+               trace: "Optional[reqtrace.RequestTrace]" = None,
+               request_class: Optional[str] = None) -> AsyncResult:
         """Enqueue one request; returns the future immediately.
 
         ``features``: one query row ``[D]`` or a row batch ``[q, D]``
@@ -271,6 +300,9 @@ class MicroBatcher:
         ``trace`` attaches a caller-built request context (the HTTP layer
         passes one carrying the ``x-request-id``); with a ``recorder``
         configured and no ``trace``, one is created here at admission.
+        ``request_class`` tags the request for cost attribution (the HTTP
+        layer parses ``x-knn-class``; default ``interactive``) — ignored
+        unless an ``accounting`` layer is wired in.
         Raises :class:`OverloadError` when the queue is full, the batcher
         is draining, or it is closed (the trace, if any, is finished
         ``rejected`` first); :class:`ValueError` for shape mismatches.
@@ -293,13 +325,37 @@ class MicroBatcher:
             time.monotonic_ns() + int(deadline_ms * 1e6)
             if deadline_ms is not None else None
         )
+        if self.accounting is not None:
+            # Validate BEFORE the trace is minted: this raise is a plain
+            # bad-argument rejection like the shape checks above, and a
+            # trace created first would be left forever unresolved
+            # (every minted trace must reach finish() — the chaos-soak
+            # invariant). The HTTP front door 400s these before submit;
+            # embedded callers get the same contract here — class
+            # strings become Prometheus label values, so an unvalidated
+            # one could corrupt the exposition text.
+            request_class = request_class or acct.DEFAULT_CLASS
+            if not acct.valid_request_class(request_class):
+                raise ValueError(
+                    f"invalid request_class {request_class!r}: want 1-"
+                    f"{acct.MAX_CLASS_LEN} chars of [a-z0-9_.-]"
+                )
+            # Cap label cardinality: past MAX_CLASSES distinct values the
+            # request folds into the overflow class — a client minting
+            # c1, c2, c3, ... must not grow /metrics and the per-class
+            # table without bound.
+            request_class = self.accounting.admit_class(request_class)
         if trace is None and self.recorder is not None:
             trace = self.recorder.new_trace(kind, x.shape[0])
-        req = _Request(x, kind, deadline_ns, trace)
+        req = _Request(x, kind, deadline_ns, trace,
+                       request_class=request_class,
+                       accounting=self.accounting)
         if trace is not None:
             # Embedded callers learn their id from the future's meta (the
             # HTTP layer already knows it — it minted the trace).
             req.meta["request_id"] = trace.request_id
+            if self.accounting is not None:
+                trace.annotate(request_class=request_class)
         try:
             with self._cond:
                 if self._closed:
@@ -326,12 +382,22 @@ class MicroBatcher:
         except OverloadError as e:
             # A refused admission is still a terminal outcome the flight
             # recorder must resolve (every response's request_id maps to a
-            # timeline — the chaos-soak invariant).
+            # timeline — the chaos-soak invariant). The class label
+            # survives the 429 path the same way, and the arrival still
+            # counts: the capacity rings track OFFERED load, so the
+            # headroom ratio keeps falling past the knee instead of
+            # saturating at the admitted (≈ service) rate.
+            if self.accounting is not None:
+                self.accounting.note_outcome(request_class, "rejected")
+            if self.capacity is not None:
+                self.capacity.note_arrival(req.rows)
             if trace is not None:
                 trace.annotate(error=f"OverloadError: {e}")
                 trace.finish("rejected")
             raise
         instrument.record_serve_request(kind, req.rows)
+        if self.capacity is not None:
+            self.capacity.note_arrival(req.rows)
         return req.handle()
 
     def predict(self, features, timeout: Optional[float] = None):
@@ -607,11 +673,60 @@ class MicroBatcher:
     def _warn(self, msg: str) -> None:
         print(f"warning: {msg}", file=sys.stderr)
 
+    def _padded_rows(self, model, rung: str, rows: int) -> "Optional[int]":
+        """Compiled-shape rows for one rung dispatch — what the device
+        really sweeps after the engine's shape quantization. None when no
+        consumer (accounting/capacity/obs) wants it, so the disabled path
+        pays one predicate."""
+        if (self.accounting is None and self.capacity is None
+                and not obs.enabled()):
+            return None
+        try:
+            return acct.dispatch_padded_rows(model, rung, rows,
+                                             self.max_batch)
+        except Exception:  # noqa: BLE001 — observability must never fail
+            return None    # a dispatch (e.g. an exotic engine opt)
+
+    def _account_attempt(self, model, live, traced, rung: str,
+                         t_rung: float, feats, *, error=None, out=None):
+        """Shared per-attempt bookkeeping for :meth:`_retrieve`: the
+        traced ``attempt`` records and (when accounting is on) the cost
+        attribution of this attempt's measured wall across the requests
+        live for it. ``out`` (the result arrays) marks the answering
+        attempt — bytes count there only. Returns the attempt's
+        padded-rows (None when nothing consumes it)."""
+        attempt_ms = (time.monotonic() - t_rung) * 1e3
+        ok = error is None
+        for t in traced:
+            if ok:
+                t.attempt(rung, True, attempt_ms)
+            else:
+                t.attempt(rung, False, attempt_ms,
+                          error=type(error).__name__)
+        pad = self._padded_rows(model, rung, feats.shape[0])
+        if self.accounting is not None:
+            self.accounting.attribute(
+                live, attempt_ms, rung=rung, rows=feats.shape[0],
+                padded_rows=pad or feats.shape[0],
+                nbytes=(feats.nbytes + out[0].nbytes + out[1].nbytes
+                        if ok else 0),
+                ok=ok,
+            )
+        return pad
+
     def _retrieve(self, model, live: "list[_Request]"):
         """Candidate retrieval for the coalesced batch, through the
-        breaker + ladder. Returns ``(live, dists, idx, rung)`` — ``live``
-        may have shrunk (mid-fallback deadline expiries, already failed
-        typed). Raises the last typed error when every rung fails."""
+        breaker + ladder. Returns ``(live, dists, idx, rung,
+        padded_rows)`` — ``live`` may have shrunk (mid-fallback deadline
+        expiries, already failed typed); ``padded_rows`` is the answering
+        dispatch's compiled-shape row count (None when nothing consumes
+        it). Raises the last typed error when every rung fails.
+
+        Cost attribution happens HERE, per rung attempt: each attempt's
+        measured wall is split across the requests live for it (a failed
+        fast dispatch is device time the surviving requests paid; a
+        request that expired mid-fallback is attributed only the attempts
+        it rode — tests/test_accounting.py)."""
         rungs = self._rungs(model)
         decision = self.breaker.decide()
         start = 0
@@ -642,7 +757,7 @@ class MicroBatcher:
                                      if r.trace is not None]
                     live = kept
                     if not live:
-                        return live, None, None, None
+                        return live, None, None, None, None
                 name, fn = rungs[pos]
                 if feats is None:
                     feats = (
@@ -665,15 +780,12 @@ class MicroBatcher:
                         out = self._call_rung(fn, feats)
                         self._degraded_rung = pos
                     self._last_rung = name
-                    for t in traced:
-                        t.attempt(name, True,
-                                  (time.monotonic() - t_rung) * 1e3)
-                    return live, out[0], out[1], name
+                    pad = self._account_attempt(model, live, traced, name,
+                                                t_rung, feats, out=out)
+                    return live, out[0], out[1], name, pad
                 except DeviceError as e:
-                    for t in traced:
-                        t.attempt(name, False,
-                                  (time.monotonic() - t_rung) * 1e3,
-                                  error=type(e).__name__)
+                    self._account_attempt(model, live, traced, name,
+                                          t_rung, feats, error=e)
                     if e.oom and self.max_batch > 1:
                         prev, self.max_batch = self.max_batch, max(
                             1, self.max_batch // 2)
@@ -695,10 +807,8 @@ class MicroBatcher:
                         continue  # same rung, smaller chunks
                     last_err = e
                 except (CompileError, CollectiveError, OSError) as e:
-                    for t in traced:
-                        t.attempt(name, False,
-                                  (time.monotonic() - t_rung) * 1e3,
-                                  error=type(e).__name__)
+                    self._account_attempt(model, live, traced, name,
+                                          t_rung, feats, error=e)
                     last_err = e
                 if pos == 0:
                     self.breaker.record_failure()
@@ -760,10 +870,27 @@ class MicroBatcher:
                 req.trace.annotate(batch_requests=len(live), batch_rows=rows)
         t0 = time.monotonic()
         try:
-            with obs.span("serve.dispatch", requests=len(live), rows=rows):
-                live, dists, idx, rung = self._retrieve(model, live)
+            with obs.span("serve.dispatch", requests=len(live),
+                          rows=rows) as dispatch_span:
+                live, dists, idx, rung, padded = self._retrieve(model, live)
                 if not live:
+                    # Every request expired mid-fallback — but the failed
+                    # rung attempts were real worker busy time the duty
+                    # cycle must still see (`rows` is the batch as
+                    # dispatched; an all-expiring fault storm at duty ~1.0
+                    # is the saturated-and-broken picture).
+                    if self.capacity is not None:
+                        self.capacity.note_dispatch(
+                            (time.monotonic() - t0) * 1e3, rows, rows,
+                            self.max_batch,
+                        )
                     return
+                if padded is not None and hasattr(dispatch_span, "attrs"):
+                    # The compiled-shape rows the device really swept —
+                    # padding waste visible in the Perfetto timeline, not
+                    # just the knn_cost_* counters (a _NullSpan while obs
+                    # is off has no attrs and records nothing).
+                    dispatch_span.attrs["padded_rows"] = padded
                 if self.corrupt_serving:
                     # Test-only (see __init__): every served neighbor is
                     # off by one train row while distances stay plausible.
@@ -784,6 +911,11 @@ class MicroBatcher:
                     else:
                         value = model._predict_from((d, i))
                     req.succeed(value)
+                    if self.capacity is not None:
+                        self.capacity.note_served(
+                            req.rows,
+                            (time.monotonic_ns() - req.enqueued_ns) / 1e6,
+                        )
                     # Quality tap, AFTER the future is signaled: one RNG
                     # draw + an O(1) append per layer, shed when full —
                     # the response is already on its way to the client.
@@ -797,10 +929,16 @@ class MicroBatcher:
                         )
                     if self.drift is not None:
                         self.drift.offer(req.features)
+            batch_ms = (time.monotonic() - t0) * 1e3
+            served_rows = sum(r.rows for r in live)
             instrument.record_serve_batch(
-                len(live), sum(r.rows for r in live),
-                (time.monotonic() - t0) * 1e3,
+                len(live), served_rows, batch_ms, padded_rows=padded,
             )
+            if self.capacity is not None:
+                self.capacity.note_dispatch(
+                    batch_ms, served_rows, padded or served_rows,
+                    self.max_batch,
+                )
         except Exception as e:  # noqa: BLE001 — delivered per-future
             obs.counter_add(
                 "knn_serve_errors_total",
@@ -808,6 +946,15 @@ class MicroBatcher:
                      "delivered to every coalesced request)",
                 type=type(e).__name__,
             )
+            # A failed dispatch is still worker busy time the duty cycle
+            # must see — an all-failing replica at 100% duty is exactly
+            # the saturated-and-broken picture the operator needs.
+            if self.capacity is not None:
+                self.capacity.note_dispatch(
+                    (time.monotonic() - t0) * 1e3,
+                    sum(r.rows for r in live),
+                    sum(r.rows for r in live), self.max_batch,
+                )
             for req in live:
                 if not req.event.is_set():
                     req.fail(e)

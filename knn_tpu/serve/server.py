@@ -34,6 +34,14 @@ Endpoint contract (docs/SERVING.md):
   reports the distinct ``baseline: "absent"`` state), and the ``quality``
   SLO burn rates, in one payload — the page an operator reads when a
   recall regression is suspected (docs/SERVING.md runbook).
+- ``GET /debug/capacity`` → the cost & capacity join (docs/OBSERVABILITY.md
+  §Cost & capacity): per-class device-cost totals and attribution
+  conservation (``obs/accounting.py``), the duty-cycle / occupancy /
+  rate-ring capacity summary and the headroom model's sustainable-QPS
+  estimate (``obs/capacity.py``), plus the live batching policy — the page
+  an operator reads to size ``max_batch`` and replica counts
+  (docs/SERVING.md §Capacity-planning a replica). Always 200; the layers
+  report ``null`` while ``--cost-accounting off``.
 - ``GET /debug/profile?ms=N`` → an on-demand ``jax.profiler`` capture
   (``obs/devprof.py``): the handler holds the window open for N ms
   (default 200, cap 10 s) while the other handler threads keep serving,
@@ -151,7 +159,9 @@ class ServeApp:
                  slo: Optional[SLOTracker] = None,
                  shadow_rate: float = 0.0, drift_rate: float = 0.0,
                  quality_queue: int = 256, quality_seed: int = 0,
-                 reference_sketch: Optional[dict] = None):
+                 reference_sketch: Optional[dict] = None,
+                 cost_accounting: bool = False,
+                 capacity_window_s: int = 60):
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -196,10 +206,26 @@ class ServeApp:
             )
         else:
             self.quality = None
+        # Cost & capacity (obs/accounting.py, obs/capacity.py): off (the
+        # embedded default) constructs NOTHING — no accountant, no
+        # tracker, no knn_cost_*/knn_capacity_* instruments, no x-knn-class
+        # header parsing; the batcher then pays one `is None` predicate
+        # per call site (scripts/check_disabled_overhead.py pins it).
+        if cost_accounting:
+            from knn_tpu.obs.accounting import CostAccountant
+            from knn_tpu.obs.capacity import CapacityTracker
+
+            self.accounting = CostAccountant()
+            self.capacity = CapacityTracker(
+                max_batch, window_s=capacity_window_s)
+        else:
+            self.accounting = None
+            self.capacity = None
         self.batcher = MicroBatcher(
             model, max_batch=max_batch, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, index_version=index_version,
             recorder=self.recorder, quality=self.quality, drift=self.drift,
+            accounting=self.accounting, capacity=self.capacity,
         )
         self.ready = False
         self.draining = False
@@ -224,8 +250,38 @@ class ServeApp:
         self.warmup_ms = artifact.warmup(
             self.model, batch_sizes=batch_sizes, kinds=("predict",)
         )
+        if self.capacity is not None:
+            self._seed_capacity_model()
         self.ready = True
         return self.warmup_ms
+
+    def _seed_capacity_model(self) -> None:
+        """Seed the headroom model's affine dispatch-cost fit
+        (``obs/capacity.py``) with post-compile timed retrievals at 1 row
+        and ``max_batch`` rows — the executables are warm (``warm`` just
+        compiled them), so these walls measure dispatch, not compilation,
+        and the model exists before the first real request arrives.
+        Re-run after a hot reload: a new index has a new cost curve."""
+        from knn_tpu.data.dataset import Dataset
+
+        train = self.model.train_
+        self.capacity.reset_seeds()
+        for rows in sorted({1, self.batcher.max_batch}):
+            if rows <= train.num_instances:
+                feats = train.features[:rows]  # a view, no copy: this
+                # runs at boot AND on the reload thread, where tiling a
+                # large train matrix would be a pointless memory spike
+            else:
+                reps = -(-rows // train.num_instances)  # ceil
+                feats = np.tile(train.features, (reps, 1))[:rows]
+            ds = Dataset(feats, np.zeros(rows, np.int32))
+            best = None
+            for _ in range(2):  # best-of-2: stalls only ever add time
+                t0 = time.monotonic()
+                self.model.kneighbors(ds)
+                wall = (time.monotonic() - t0) * 1e3
+                best = wall if best is None else min(best, wall)
+            self.capacity.seed_dispatch_model(rows, best)
 
     # -- hot reload --------------------------------------------------------
 
@@ -286,6 +342,10 @@ class ServeApp:
             self.model = model
             self.index_version = version
             self.reloads += 1
+            if self.capacity is not None:
+                # The new index's dispatch-cost curve replaces the old
+                # seeds (runs on the reload thread, off the serving path).
+                self._seed_capacity_model()
             obs.counter_add(
                 "knn_serve_reloads_total",
                 help="hot index reloads, by outcome", outcome="ok",
@@ -403,6 +463,10 @@ class ServeApp:
             "slo": self.slo.export(),
             "device": self._device_block(),
             "quality": self.quality_block(),
+            # The capacity summary (export() also refreshes the
+            # knn_capacity_* gauges); None while --cost-accounting off.
+            "capacity": (self.capacity.export()
+                         if self.capacity is not None else None),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
@@ -525,6 +589,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.app.quality.export()
             if self.app.drift is not None:
                 self.app.drift.export()
+            if self.app.capacity is not None:
+                self.app.capacity.export()
             accept = self.headers.get("Accept", "")
             if "application/openmetrics-text" in accept:
                 self._send_text(
@@ -541,6 +607,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_debug(route)
         elif route == "/debug/quality":
             self._do_quality()
+        elif route == "/debug/capacity":
+            self._do_capacity()
         elif route == "/debug/profile":
             self._do_profile()
         else:
@@ -570,6 +638,32 @@ class _Handler(BaseHTTPRequestHandler):
         }
         # Like /debug/requests: no request_id stamped into a payload that
         # is about OTHER requests (the header still carries it).
+        self._send(200, payload, tag_request_id=False)
+
+    def _do_capacity(self):
+        """The cost & capacity join: per-class device spend + attribution
+        conservation (``obs/accounting.py``), the duty-cycle / occupancy /
+        headroom summary (``obs/capacity.py``), and the live batching
+        policy in ONE payload — cost tells you who is paying, capacity
+        tells you how close to the knee the replica runs, policy tells you
+        what to turn. Always 200: disabled layers report ``null``, so
+        dashboards can hard-code the route (the ``/debug/quality`` rule)."""
+        b = self.app.batcher
+        payload = {
+            "enabled": self.app.accounting is not None,
+            "capacity": (self.app.capacity.export()
+                         if self.app.capacity is not None else None),
+            "cost": (self.app.accounting.export()
+                     if self.app.accounting is not None else None),
+            "policy": {
+                "max_batch": b.max_batch,
+                "max_wait_ms": b.max_wait_ms,
+                "max_queue_rows": b.max_queue_rows,
+            },
+            "index_version": self.app.index_version,
+        }
+        # No request_id stamped into a payload about OTHER requests (the
+        # /debug/requests rule; the response header still carries it).
         self._send(200, payload, tag_request_id=False)
 
     def _do_profile(self):
@@ -728,7 +822,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _account(self, kind: str, status: int, outcome: str, t0: float,
                  trace=None, rung: Optional[str] = None,
                  rows: Optional[int] = None,
-                 index_version: Optional[str] = None) -> None:
+                 index_version: Optional[str] = None,
+                 req_class: Optional[str] = None) -> None:
         """Terminal-outcome bookkeeping, on the HANDLER thread after the
         response went out: the SLO record (400s excluded — a malformed
         body is the caller's defect, not service unavailability), the
@@ -754,6 +849,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "rung": rung,
                 "index_version": index_version,
             }
+            if req_class is not None:
+                entry["class"] = req_class
             if trace is not None:
                 tl = trace.to_dict()
                 phases: dict = {}
@@ -795,6 +892,36 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": f"bad request body: {e}"})
             self._account(kind, 400, "invalid", t_recv)
             return
+        # Request class for cost attribution — parsed ONLY while the
+        # accounting layer exists (the default-off contract: no header
+        # lookup, no validation, nothing constructed while off). The JSON
+        # body's "class" field wins over the x-knn-class header (clients
+        # behind header-stripping proxies still get to tag).
+        req_class = None
+        if self.app.accounting is not None:
+            from knn_tpu.obs import accounting as acct_mod
+
+            raw_cls = body.get("class")
+            if raw_cls is None:
+                # Absent OR an explicit JSON null both fall back to the
+                # header: serializers that emit null for unset fields
+                # must not silently discard a caller's x-knn-class tag.
+                raw_cls = self.headers.get("x-knn-class")
+            if raw_cls is not None:
+                raw_cls = str(raw_cls).strip()
+                if not acct_mod.valid_request_class(raw_cls):
+                    self._send(400, {
+                        "error": f"invalid request class: want 1-"
+                                 f"{acct_mod.MAX_CLASS_LEN} chars of "
+                                 f"[a-z0-9_.-] (x-knn-class header or "
+                                 f"\"class\" body field), got "
+                                 f"{raw_cls[:64]!r}",
+                    })
+                    self._account(kind, 400, "invalid", t_recv)
+                    return
+                req_class = raw_cls
+            else:
+                req_class = acct_mod.DEFAULT_CLASS
         rows = int(x.shape[0]) if x.ndim > 1 else 1
         t0 = time.monotonic()
         trace = None
@@ -808,17 +935,20 @@ class _Handler(BaseHTTPRequestHandler):
                 trace.annotate(deadline_ms=deadline_ms)
         try:
             handle = self.app.batcher.submit(x, kind, deadline_ms=deadline_ms,
-                                             trace=trace)
+                                             trace=trace,
+                                             request_class=req_class)
         except OverloadError as e:
             # While draining, 503 (not 429): the load balancer should take
             # this replica out of rotation, not have the client retry here.
             st = 503 if self.app.draining else 429
             self._send(st, {"error": str(e)})
-            self._account(kind, st, "rejected", t0, trace=trace, rows=rows)
+            self._account(kind, st, "rejected", t0, trace=trace, rows=rows,
+                          req_class=req_class)
             return
         except ValueError as e:  # shape/kind rejection
             self._send(400, {"error": str(e)})
-            self._account(kind, 400, "invalid", t0, trace=trace, rows=rows)
+            self._account(kind, 400, "invalid", t0, trace=trace, rows=rows,
+                          req_class=req_class)
             return
         timeout = deadline_ms / 1e3 if deadline_ms is not None else None
         try:
@@ -826,7 +956,8 @@ class _Handler(BaseHTTPRequestHandler):
         except DeadlineExceededError as e:
             self._send(504, {"error": str(e)})
             self._account(kind, 504, "expired", t0, trace=trace, rows=rows,
-                          rung=(handle.meta or {}).get("rung"))
+                          rung=(handle.meta or {}).get("rung"),
+                          req_class=req_class)
             return
         except Exception as e:  # noqa: BLE001 — the batcher delivers ANY
             # failure to the future (that is its worker-survival contract);
@@ -834,7 +965,8 @@ class _Handler(BaseHTTPRequestHandler):
             # handler traceback + dropped connection.
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
             self._account(kind, 500, "error", t0, trace=trace, rows=rows,
-                          rung=(handle.meta or {}).get("rung"))
+                          rung=(handle.meta or {}).get("rung"),
+                          req_class=req_class)
             return
         ms = round((time.monotonic() - t0) * 1e3, 3)
         meta = handle.meta or {}
@@ -852,7 +984,8 @@ class _Handler(BaseHTTPRequestHandler):
             })
         self._account(kind, 200, "ok", t0, trace=trace,
                       rung=meta.get("rung"), rows=rows,
-                      index_version=meta.get("index_version"))
+                      index_version=meta.get("index_version"),
+                      req_class=req_class)
 
 
 class KNNServer(ThreadingHTTPServer):

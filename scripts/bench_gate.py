@@ -131,6 +131,15 @@ def main(argv=None) -> int:
     verdict["env"] = key
     write_json(Path(args.out), verdict)
     print(regress.summarize(verdict))
+    if verdict.get("new_metrics"):
+        # Metrics the fresh record has that the committed baseline lacks
+        # (e.g. PR 8's serve_c8_occupancy_mean/duty_cycle/waste_ratio):
+        # reported for visibility, never gated, until --write-baseline
+        # records an entry that carries them.
+        for name in verdict["new_metrics"]:
+            m = fresh.get("metrics", {}).get(name, {})
+            print(f" reported {name}: {m.get('trials')} {m.get('unit', '')} "
+                  f"(new metric — not gated)")
     if not verdict["pass"]:
         print(f"bench-gate: FAIL — regression past tolerance "
               f"(verdict: {args.out})", file=sys.stderr)

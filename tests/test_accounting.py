@@ -1,0 +1,717 @@
+"""Cost & capacity observability contracts (docs/OBSERVABILITY.md §Cost &
+capacity).
+
+The load-bearing claim is **attribution conservation**: the per-request
+device-ms shares of every dispatch sum to the measured dispatch wall —
+summed over any mix of classes, rungs, coalesced batches, and concurrent
+clients, ``knn_cost_device_ms_total`` equals
+``knn_cost_dispatch_wall_ms_total`` to float precision. Plus: shares are
+proportional to rows, a deadline-expired-mid-fallback request is
+attributed only the attempts it rode, class labels survive the 4xx/5xx
+paths, padded (compiled-shape) rows are measured wherever the engine pads,
+and the capacity math (duty cycle / occupancy / rates / Little's law /
+headroom) is pinned against a fake clock like ``slo.py``'s tests.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.obs import accounting as acct
+from knn_tpu.obs.accounting import (
+    CostAccountant,
+    dispatch_padded_rows,
+    padded_query_rows,
+    valid_request_class,
+)
+from knn_tpu.obs.capacity import CapacityTracker
+from knn_tpu.obs.slo import SecondRing
+from knn_tpu.resilience.errors import (
+    DeadlineExceededError,
+    DeviceError,
+    OverloadError,
+)
+from knn_tpu.serve.batcher import MicroBatcher
+
+
+def _problem(rng, n=300, q=40, d=5, c=5):
+    train_x = rng.normal(size=(n, d)).astype(np.float32)
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = rng.normal(size=(q, d)).astype(np.float32)
+    return (Dataset(train_x, train_y),
+            Dataset(test_x, np.zeros(q, np.int32)))
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+def _counter_sum(registry, name):
+    return sum(i.value for i in registry.instruments() if i.name == name)
+
+
+def _assert_conservation(registry):
+    dev = _counter_sum(registry, "knn_cost_device_ms_total")
+    wall = _counter_sum(registry, "knn_cost_dispatch_wall_ms_total")
+    assert wall > 0
+    assert dev == pytest.approx(wall, rel=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Request-class validation + padded-rows math
+
+
+class TestRequestClass:
+    def test_valid(self):
+        for cls in ("interactive", "bulk", "a", "x" * 32, "t-1_2.3"):
+            assert valid_request_class(cls), cls
+
+    def test_invalid(self):
+        for cls in ("", "x" * 33, "UPPER", "has space", "emoji☃",
+                    'quo"te', "new\nline"):
+            assert not valid_request_class(cls), cls
+
+
+class TestPaddedRows:
+    def test_xla_pads_to_128(self):
+        assert padded_query_rows("xla", 1) == 128
+        assert padded_query_rows("xla", 128) == 128
+        assert padded_query_rows("xla", 129) == 256
+        assert padded_query_rows("xla", 0) == 0
+
+    def test_host_engines_pad_nothing(self):
+        assert padded_query_rows("oracle", 7) == 7
+
+    def test_stripe_quantizes_to_block_q(self):
+        from knn_tpu.ops.pallas_knn import stripe_block_sizes
+
+        bq, _ = stripe_block_sizes(None, None, 5, 3, d_pad=8)
+        pad = padded_query_rows("stripe", 5, num_features=5, k=3)
+        assert pad == -(-5 // bq) * bq
+        assert pad >= 5
+
+    def test_dispatch_chunking_sums_per_chunk(self, rng):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        # 10 rows at cap 4 -> chunks of 4, 4, 2 -> 3 x 128 padded.
+        assert dispatch_padded_rows(model, "xla", 10, 4) == 3 * 128
+        assert dispatch_padded_rows(model, "oracle", 10, 4) == 10
+
+    def test_record_serve_batch_padded_histogram(self, obs_on):
+        from knn_tpu.obs import instrument
+
+        instrument.record_serve_batch(2, 5, 1.0, padded_rows=128)
+        names = {i.name for i in obs_on.instruments()}
+        assert "knn_serve_batch_padded_rows" in names
+        assert "knn_serve_batch_rows" in names
+
+    def test_engine_span_carries_padded_rows(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.kneighbors(test)
+        spans = [s for s in obs.tracer().spans() if s.name == "distance"]
+        assert spans, "no distance span recorded"
+        assert spans[-1].attrs["rows"] == test.num_instances
+        assert spans[-1].attrs["padded_rows"] == \
+            -(-test.num_instances // 128) * 128
+
+
+# ---------------------------------------------------------------------------
+# Attribution invariants through the batcher
+
+
+class TestAttribution:
+    def test_proportional_shares_in_one_coalesced_batch(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.predict(test)  # warm
+        obs.reset()
+        accountant = CostAccountant()
+        # max_batch 4 closes the batch exactly when 1+3 rows are queued;
+        # the huge wait window makes the coalescing deterministic.
+        with MicroBatcher(model, max_batch=4, max_wait_ms=5000.0,
+                          accounting=accountant) as b:
+            ha = b.submit(test.features[:1], "predict",
+                          request_class="interactive")
+            hb = b.submit(test.features[1:4], "kneighbors",
+                          request_class="bulk")
+            ha.result(timeout=60)
+            hb.result(timeout=60)
+        ca, cb = ha.meta["cost"], hb.meta["cost"]
+        assert ca["class"] == "interactive" and cb["class"] == "bulk"
+        assert ca["rows"] == 1 and cb["rows"] == 3
+        # Proportional to rows: the 3-row request paid 3x the 1-row one.
+        assert cb["device_ms"] == pytest.approx(3 * ca["device_ms"],
+                                                rel=1e-6)
+        assert cb["bytes"] >= ca["bytes"]
+        _assert_conservation(obs_on)
+        # The padded-rows waste counter measured the 128-row XLA quantum.
+        assert _counter_sum(obs_on, "knn_cost_padded_rows_total") == 128 - 4
+
+    def test_conservation_under_concurrent_mixed_load(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.predict(test)
+        obs.reset()
+        accountant = CostAccountant()
+        costs, lock = [], threading.Lock()
+
+        def client(cid):
+            mine = []
+            for i in range(10):
+                kind = "predict" if (cid + i) % 2 == 0 else "kneighbors"
+                lo = (cid * 10 + i) % (test.num_instances - 3)
+                h = batcher.submit(test.features[lo:lo + 1 + (i % 3)], kind,
+                                   request_class=("bulk" if i % 3 == 0
+                                                  else None))
+                h.result(timeout=60)
+                mine.append(h.meta["cost"])
+            with lock:
+                costs.extend(mine)
+
+        with MicroBatcher(model, max_batch=8, max_wait_ms=1.0,
+                          accounting=accountant) as batcher:
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        assert len(costs) == 60
+        assert all(c["device_ms"] > 0 for c in costs)
+        _assert_conservation(obs_on)
+        # The per-request blocks conserve too: their sum is the same total.
+        total = sum(c["device_ms"] for c in costs)
+        wall = _counter_sum(obs_on, "knn_cost_dispatch_wall_ms_total")
+        assert total == pytest.approx(wall, rel=1e-9)
+        exp = accountant.export()
+        assert exp["totals"]["attributed_ms"] == pytest.approx(
+            exp["totals"]["dispatch_wall_ms"], rel=1e-9)
+        assert set(exp["classes"]) == {"interactive", "bulk"}
+
+    def test_expired_mid_fallback_attributed_only_ridden_attempts(
+            self, rng, obs_on, monkeypatch):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+
+        def slow_boom(ds):
+            time.sleep(0.4)
+            raise DeviceError("slowly dying device")
+
+        accountant = CostAccountant()
+        b = MicroBatcher(model, max_batch=64, max_wait_ms=50.0,
+                         accounting=accountant)
+        try:
+            monkeypatch.setattr(model, "kneighbors", slow_boom)
+            ha = b.submit(test.features[0], deadline_ms=200,
+                          request_class="interactive")
+            hb = b.submit(test.features[1], request_class="bulk")
+            with pytest.raises(DeadlineExceededError):
+                ha.result(timeout=60)
+            hb.result(timeout=60)
+        finally:
+            monkeypatch.undo()
+            b.close()
+        expired, survivor = ha.meta["cost"], hb.meta["cost"]
+        # The expired request rode ONLY the failed fast attempt.
+        assert set(expired["rungs"]) == {"fast"}
+        # The survivor paid for the failed fast attempt AND the oracle
+        # rung that answered it.
+        assert set(survivor["rungs"]) == {"fast", "oracle"}
+        # The fast attempt was split across both while both were live.
+        assert expired["rungs"]["fast"] == pytest.approx(
+            survivor["rungs"]["fast"], rel=1e-3)
+        _assert_conservation(obs_on)
+        exp = accountant.export()
+        assert exp["classes"]["interactive"]["outcomes"] == {"expired": 1}
+        assert exp["classes"]["bulk"]["outcomes"] == {"ok": 1}
+        # rows are counted on the ANSWERING attempt only: the expired
+        # request's row was never served.
+        assert exp["classes"]["interactive"]["rows"] == 0
+        assert exp["classes"]["bulk"]["rows"] == 1
+
+    def test_class_survives_rejection_429_path(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        accountant = CostAccountant()
+        with MicroBatcher(model, max_batch=2, max_queue_rows=2,
+                          max_wait_ms=2000.0,
+                          accounting=accountant) as batcher:
+            parked = batcher.submit(test.features[:1], "predict",
+                                    request_class="bulk")
+            with pytest.raises(OverloadError):
+                batcher.submit(test.features[1:3], "predict",
+                               request_class="bulk")
+            parked.result(timeout=30)
+        exp = accountant.export()
+        assert exp["classes"]["bulk"]["outcomes"]["rejected"] == 1
+        assert exp["classes"]["bulk"]["outcomes"]["ok"] == 1
+        rejected = [
+            i.value for i in obs_on.instruments()
+            if i.name == "knn_cost_requests_total"
+            and dict(i.labels).get("class") == "bulk"
+            and dict(i.labels).get("outcome") == "rejected"
+        ]
+        assert rejected == [1]
+
+    def test_embedded_submit_rejects_invalid_class(self, rng, obs_on):
+        # The HTTP front door 400s bad classes before submit; embedded
+        # callers must hit the same wall — class strings become
+        # Prometheus label values, so an unvalidated one could corrupt
+        # the exposition text or explode cardinality.
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        with MicroBatcher(model, max_batch=2,
+                          accounting=CostAccountant()) as batcher:
+            for bad in ("UPPER", "new\nline", "x" * 33, "sp ace"):
+                with pytest.raises(ValueError, match="request_class"):
+                    batcher.submit(test.features[:1], "predict",
+                                   request_class=bad)
+            # Without an accountant, the tag is inert and unvalidated.
+        with MicroBatcher(model, max_batch=2) as untagged:
+            untagged.submit(test.features[:1], "predict",
+                            request_class="UPPER").result(timeout=30)
+
+    def test_class_cardinality_capped_at_overflow(self, obs_on):
+        # Classes mint Prometheus series and per-class table slots, so a
+        # client inventing a fresh class per request must hit a ceiling:
+        # past MAX_CLASSES distinct values, admit_class folds into the
+        # overflow class. Known classes keep resolving to themselves.
+        from knn_tpu.obs import accounting as acct
+
+        a = CostAccountant()
+        admitted = {a.admit_class(f"c{i}") for i in range(200)}
+        assert acct.OVERFLOW_CLASS in admitted
+        distinct = admitted - {acct.OVERFLOW_CLASS}
+        # interactive + other are pre-reserved, the rest first-come.
+        assert len(distinct) == acct.MAX_CLASSES - 2
+        for cls in distinct:
+            assert a.admit_class(cls) == cls  # known stays itself
+        assert a.admit_class("one-too-many") == acct.OVERFLOW_CLASS
+        assert a.admit_class(acct.DEFAULT_CLASS) == acct.DEFAULT_CLASS
+
+    def test_class_survives_queue_expiry_504_path(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        accountant = CostAccountant()
+        with MicroBatcher(model, max_batch=64, max_wait_ms=2000.0,
+                          accounting=accountant) as b:
+            h = b.submit(test.features[:1], "predict", deadline_ms=20,
+                         request_class="bulk")
+            with pytest.raises(DeadlineExceededError):
+                h.result(timeout=30)
+        exp = accountant.export()
+        assert exp["classes"]["bulk"]["outcomes"] == {"expired": 1}
+        # Never dispatched -> no cost block, no attributed device time.
+        assert "cost" not in h.meta
+        assert exp["totals"]["dispatch_wall_ms"] == 0.0
+
+    def test_default_class_is_interactive(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        accountant = CostAccountant()
+        with MicroBatcher(model, max_batch=4, max_wait_ms=0.0,
+                          accounting=accountant) as b:
+            h = b.submit(test.features[:1], "predict")
+            h.result(timeout=60)
+        assert h.meta["cost"]["class"] == "interactive"
+
+    def test_no_accounting_means_no_cost_instruments(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        with MicroBatcher(model, max_batch=4, max_wait_ms=0.0) as b:
+            h = b.submit(test.features[:1], "predict",
+                         request_class="bulk")
+            h.result(timeout=60)
+        assert "cost" not in h.meta
+        leaked = [i.name for i in obs_on.instruments()
+                  if i.name.startswith(("knn_cost_", "knn_capacity_"))]
+        assert leaked == []
+
+
+# ---------------------------------------------------------------------------
+# Capacity math, pinned against a fake clock (the slo.py test recipe)
+
+
+@pytest.fixture
+def fake_clock(monkeypatch):
+    import knn_tpu.obs.capacity as cap_mod
+    import knn_tpu.obs.slo as slo_mod
+
+    clock = [10_000.0]
+    monkeypatch.setattr(slo_mod.time, "monotonic", lambda: clock[0])
+    assert cap_mod.time is slo_mod.time  # both modules share stdlib time
+    return clock
+
+
+class TestCapacityMath:
+    def test_duty_cycle_and_rates(self, fake_clock, obs_on):
+        t = CapacityTracker(8, window_s=60)
+        fake_clock[0] += 10.0  # 10 s of uptime, window still 60
+        for _ in range(5):
+            t.note_arrival(2)
+        for _ in range(4):
+            t.note_served(2, 50.0)
+        # 4 dispatches x 500 ms busy over 10 s of wall -> duty 0.2.
+        for _ in range(4):
+            t.note_dispatch(500.0, 2, 128, 8)
+        out = t.export()
+        assert out["duty_cycle"] == pytest.approx(0.2)
+        assert out["arrival_qps"] == pytest.approx(0.5)
+        assert out["arrival_rows_per_s"] == pytest.approx(1.0)
+        assert out["served_qps"] == pytest.approx(0.4)
+        assert out["occupancy_mean"] == pytest.approx(2 / 8)
+        assert out["padded_row_waste_ratio"] == pytest.approx(
+            (4 * 128 - 8) / (4 * 128), abs=1e-4)
+        assert out["dispatch_rows_per_s"] == pytest.approx(8 / 2.0)
+        assert out["mean_request_ms"] == pytest.approx(50.0)
+        # Little's law over the ADMITTED rate (a rejected request never
+        # enters the system): 0.4 served req/s x 0.05 s = 0.02 in flight —
+        # NOT the 0.5 offered rate, which would inflate the estimate
+        # exactly when the replica sheds.
+        assert out["littles_law_concurrency"] == pytest.approx(0.02)
+
+    def test_seed_two_point_headroom_model(self, fake_clock, obs_on):
+        t = CapacityTracker(8, window_s=60)
+        fake_clock[0] += 5.0
+        # w(r) = 1 + 1*r exactly: w(1)=2, w(8)=9.
+        t.seed_dispatch_model(1, 2.0)
+        t.seed_dispatch_model(8, 9.0)
+        out = t.export()
+        m = out["dispatch_model"]
+        assert m["source"] == "seed"
+        assert m["a_ms"] == pytest.approx(1.0)
+        assert m["b_ms_per_row"] == pytest.approx(1.0)
+        # Saturated: full 8-row batches back to back at 9 ms each.
+        assert out["sustainable_rows_per_s"] == pytest.approx(8 / 0.009,
+                                                              rel=1e-3)
+        # No traffic yet -> rows_per_request defaults to 1.
+        assert out["sustainable_qps"] == pytest.approx(8 / 0.009, rel=1e-3)
+
+    def test_observed_fit_overrides_seeds(self, fake_clock, obs_on):
+        t = CapacityTracker(16, window_s=60)
+        t.seed_dispatch_model(1, 100.0)  # a wildly wrong seed
+        t.seed_dispatch_model(16, 200.0)
+        fake_clock[0] += 5.0
+        # Observed truth: w(r) = 2 + 0.5 r, across varied rows.
+        for rows in (1, 4, 8, 16, 2, 12):
+            t.note_dispatch(2.0 + 0.5 * rows, rows, rows, 16)
+        out = t.export()
+        m = out["dispatch_model"]
+        assert m["source"] == "observed"
+        assert m["a_ms"] == pytest.approx(2.0, abs=1e-6)
+        assert m["b_ms_per_row"] == pytest.approx(0.5, abs=1e-6)
+        # w(16) = 10 ms -> 1600 rows/s sustainable.
+        assert out["sustainable_rows_per_s"] == pytest.approx(1600, rel=1e-3)
+
+    def test_chunked_redispatch_clamps_occupancy_and_skips_fit(
+            self, fake_clock, obs_on):
+        # After an OOM halves max_batch mid-batch, the re-dispatch lands
+        # here as ONE record of rows > max_batch covering several chunked
+        # device calls: each chunk ran full (occupancy 1.0, never >1) and
+        # the point is excluded from the w(r) = a + b*r fit — its wall
+        # paid the intercept once per chunk, which the model can't
+        # express.
+        t = CapacityTracker(16, window_s=60)
+        fake_clock[0] += 5.0
+        for rows in (1, 4, 8, 16, 2, 12):  # truth: w(r) = 2 + 0.5 r
+            t.note_dispatch(2.0 + 0.5 * rows, rows, rows, 16)
+        # A 32-row chunked re-dispatch at the halved cap of 16: two
+        # chunks, two intercepts — a wildly off-model wall.
+        t.note_dispatch(2 * 2.0 + 0.5 * 32 + 100.0, 32, 32, 16)
+        out = t.export()
+        assert out["occupancy_mean"] <= 1.0  # clamped, not 32/16
+        m = out["dispatch_model"]
+        assert m["source"] == "observed"
+        assert m["a_ms"] == pytest.approx(2.0, abs=1e-6)
+        assert m["b_ms_per_row"] == pytest.approx(0.5, abs=1e-6)
+        # The chunked dispatch still counts for duty/throughput/waste.
+        assert out["dispatch_rows_per_s"] > 0
+
+    def test_headroom_ratio_vs_arrival(self, fake_clock, obs_on):
+        t = CapacityTracker(4, window_s=60)
+        fake_clock[0] += 10.0
+        t.seed_dispatch_model(1, 5.0)
+        t.seed_dispatch_model(4, 8.0)  # w(4)=8ms -> 500 rows/s
+        for _ in range(100):  # 10 req/s of 1-row arrivals
+            t.note_arrival(1)
+            t.note_served(1, 10.0)
+        out = t.export()
+        assert out["rows_per_request"] == pytest.approx(1.0)
+        assert out["sustainable_qps"] == pytest.approx(500.0, rel=1e-3)
+        assert out["headroom_ratio"] == pytest.approx(50.0, rel=1e-3)
+        assert out["utilization"] == pytest.approx(10 / 500, rel=1e-3)
+
+    def test_window_expires_old_traffic(self, fake_clock, obs_on):
+        t = CapacityTracker(8, window_s=10)
+        fake_clock[0] += 5.0
+        t.note_arrival(1)
+        assert t.export()["arrival_qps"] > 0
+        fake_clock[0] += 30.0  # far past the 10 s window
+        assert t.export()["arrival_qps"] == 0.0
+
+    def test_gauges_exported(self, fake_clock, obs_on):
+        t = CapacityTracker(8, window_s=60)
+        fake_clock[0] += 2.0
+        t.note_dispatch(10.0, 4, 128, 8)
+        t.export()
+        prom = obs_on.to_prometheus()
+        for needle in ("knn_capacity_duty_cycle",
+                       "knn_capacity_occupancy_mean",
+                       "knn_capacity_batch_occupancy_bucket",
+                       "knn_capacity_dispatch_rows_per_s"):
+            assert needle in prom, needle
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_batch"):
+            CapacityTracker(0)
+        with pytest.raises(ValueError, match="window_s"):
+            CapacityTracker(8, window_s=0)
+
+
+class TestSecondRing:
+    def test_field_count_enforced(self):
+        r = SecondRing(2, 60)
+        with pytest.raises(ValueError, match="field deltas"):
+            r.add(1)
+
+    def test_window_sums_float_fields(self, monkeypatch):
+        import knn_tpu.obs.slo as slo_mod
+
+        clock = [100.0]
+        monkeypatch.setattr(slo_mod.time, "monotonic", lambda: clock[0])
+        r = SecondRing(2, 30)
+        r.add(1, 2.5)
+        clock[0] += 3
+        r.add(1, 1.5)
+        assert r.window_sums(30) == (2, 4.0)
+        assert r.window_sums(2) == (1, 1.5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="fields"):
+            SecondRing(0, 60)
+        with pytest.raises(ValueError, match="max_window_s"):
+            SecondRing(1, 0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration: class header, /debug/capacity, cost block in timelines
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _post(base, path, payload, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture
+def served_with_cost(rng, obs_on):
+    """A warmed in-process server with cost accounting ON."""
+    from knn_tpu.serve.server import ServeApp, make_server
+
+    train, test = _problem(rng)
+    model = KNNClassifier(k=3, engine="xla").fit(train)
+    app = ServeApp(model, max_batch=16, max_wait_ms=1.0,
+                   cost_accounting=True)
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    app.warm((1, 4))
+    try:
+        yield f"http://{host}:{port}", model, test, app
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close()
+        thread.join(timeout=10)
+
+
+class TestServerCostCapacity:
+    def test_debug_capacity_joins_cost_and_headroom(self, served_with_cost):
+        base, _, test, app = served_with_cost
+        st, _, _ = _post(base, "/predict",
+                         {"instances": test.features[:2].tolist()})
+        assert st == 200
+        st, body = _get(base, "/debug/capacity")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert doc["policy"]["max_batch"] == 16
+        assert doc["cost"]["totals"]["dispatches"] >= 1
+        assert doc["cost"]["totals"]["attributed_ms"] == pytest.approx(
+            doc["cost"]["totals"]["dispatch_wall_ms"], rel=1e-9)
+        assert "interactive" in doc["cost"]["classes"]
+        cap = doc["capacity"]
+        # Warmup seeded the dispatch model before any traffic arrived.
+        assert cap["dispatch_model"]["source"] in ("seed", "observed")
+        assert cap["sustainable_qps"] is not None and \
+            cap["sustainable_qps"] > 0
+
+    def test_healthz_carries_capacity_block(self, served_with_cost):
+        base, _, _, _ = served_with_cost
+        st, body = _get(base, "/healthz")
+        assert st == 200
+        h = json.loads(body)
+        assert h["capacity"] is not None
+        assert "duty_cycle" in h["capacity"]
+
+    def test_200_timeline_carries_cost_block(self, served_with_cost):
+        base, _, test, _ = served_with_cost
+        st, _, hdrs = _post(
+            base, "/predict", {"instances": test.features[:1].tolist()},
+            headers={"x-request-id": "cost-probe-1",
+                     "x-knn-class": "bulk"},
+        )
+        assert st == 200
+        st, body = _get(base, "/debug/requests?id=cost-probe-1")
+        assert st == 200
+        tl = json.loads(body)["requests"][0]
+        assert tl["request_class"] == "bulk"
+        assert tl["cost"]["class"] == "bulk"
+        assert tl["cost"]["device_ms"] > 0
+        assert tl["cost"]["rungs"]
+
+    def test_body_class_field_wins_over_header(self, served_with_cost):
+        base, _, test, app = served_with_cost
+        st, _, _ = _post(
+            base, "/predict",
+            {"instances": test.features[:1].tolist(), "class": "batchjob"},
+            headers={"x-knn-class": "bulk"},
+        )
+        assert st == 200
+        classes = app.accounting.export()["classes"]
+        assert "batchjob" in classes and "bulk" not in classes
+
+    def test_body_class_null_falls_back_to_header(self, served_with_cost):
+        # Serializers that emit null for unset fields must not silently
+        # discard the caller's x-knn-class tag: an explicit JSON null
+        # reads like an absent field, not like "no class".
+        base, _, test, app = served_with_cost
+        st, _, _ = _post(
+            base, "/predict",
+            {"instances": test.features[:1].tolist(), "class": None},
+            headers={"x-knn-class": "nullfallback"},
+        )
+        assert st == 200
+        assert "nullfallback" in app.accounting.export()["classes"]
+
+    def test_invalid_class_is_400(self, served_with_cost):
+        base, _, test, _ = served_with_cost
+        st, body, _ = _post(
+            base, "/predict", {"instances": test.features[:1].tolist()},
+            headers={"x-knn-class": "NOT VALID"},
+        )
+        assert st == 400
+        assert "class" in body["error"]
+
+    def test_metrics_expose_cost_and_capacity(self, served_with_cost):
+        base, _, test, _ = served_with_cost
+        _post(base, "/predict", {"instances": test.features[:1].tolist()})
+        st, text = _get(base, "/metrics")
+        assert st == 200
+        for needle in ("knn_cost_device_ms_total",
+                       "knn_cost_dispatch_wall_ms_total",
+                       "knn_cost_requests_total",
+                       "knn_capacity_duty_cycle"):
+            assert needle in text, needle
+
+    def test_off_reports_null_and_skips_class_parsing(self, rng, obs_on):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, test = _problem(rng)
+        app = ServeApp(KNNClassifier(k=3, engine="xla").fit(train),
+                       max_batch=8, max_wait_ms=1.0)
+        assert app.accounting is None and app.capacity is None
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+        try:
+            app.warm((1,))
+            # An invalid class header is NOT parsed (and so not rejected)
+            # while the layer is off.
+            st, _, _ = _post(
+                base, "/predict", {"instances": test.features[:1].tolist()},
+                headers={"x-knn-class": "NOT VALID"},
+            )
+            assert st == 200
+            st, body = _get(base, "/debug/capacity")
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["enabled"] is False
+            assert doc["capacity"] is None and doc["cost"] is None
+            st, body = _get(base, "/healthz")
+            assert json.loads(body)["capacity"] is None
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+
+
+class TestAccountantUnits:
+    def test_attribute_nothing_on_empty_batch(self, obs_on):
+        a = CostAccountant()
+        a.attribute([], 5.0, rung="fast", rows=0, padded_rows=0)
+        assert a.export()["totals"]["dispatches"] == 0
+
+    def test_export_rung_breakdown(self, obs_on):
+        a = CostAccountant()
+
+        class R:
+            def __init__(self, rows, cls):
+                self.rows, self.request_class = rows, cls
+                self.meta, self.trace = {}, None
+
+        r1, r2 = R(1, "interactive"), R(3, "bulk")
+        a.attribute([r1, r2], 8.0, rung="fast", rows=4, padded_rows=128,
+                    nbytes=400, ok=False)
+        a.attribute([r1, r2], 4.0, rung="oracle", rows=4, padded_rows=4,
+                    nbytes=400, ok=True)
+        exp = a.export()
+        assert exp["totals"]["dispatch_wall_ms"] == pytest.approx(12.0)
+        assert exp["totals"]["padded_rows"] == 132
+        inter = exp["classes"]["interactive"]
+        assert inter["rungs"]["fast"] == pytest.approx(2.0)
+        assert inter["rungs"]["oracle"] == pytest.approx(1.0)
+        # rows/bytes count on the answering (ok) attempt only.
+        assert inter["rows"] == 1
+        bulk = exp["classes"]["bulk"]
+        assert bulk["rungs"]["fast"] == pytest.approx(6.0)
+        assert bulk["rows"] == 3
+        assert inter["bytes"] + bulk["bytes"] == 400
+        assert r1.meta["cost"]["padded_rows_share"] == pytest.approx(
+            (128 - 4) * 0.25)
+        _assert_conservation(obs_on)
+
+    def test_default_class_constant(self):
+        assert acct.DEFAULT_CLASS == "interactive"

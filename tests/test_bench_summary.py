@@ -129,6 +129,9 @@ def _fat_record():
                 "c8_batched_qps": 1234.5, "c8_seq_qps": 98.7,
                 "batched_beats_seq_c8": True, "dropped_requests": 0,
                 "deadline_expired": 0, "failed_requests": 0,
+                "c8_occupancy_mean": 0.1234,
+                "c8_padded_row_waste_ratio": 0.9876,
+                "c8_duty_cycle": 0.9876,
             },
         },
     }

@@ -307,9 +307,18 @@ class TestServeExitCodes:
                       ["--drift-rate", "2"],
                       ["--quality-queue", "0"],
                       ["--slo-quality-target", "1"],
-                      ["--slo-quality-target", "0"]):
+                      ["--slo-quality-target", "0"],
+                      # The cost & capacity knobs (PR 8) keep it too.
+                      ["--capacity-window-s", "0"],
+                      ["--capacity-window-s", "4"]):
             assert run(["serve", "/irrelevant/index", *extra]) == 2, extra
             assert "error:" in self._err(capsys)
+
+    def test_serve_bad_cost_accounting_choice_exits_2(self, capsys):
+        # argparse choice validation: anything but on/off is usage error.
+        assert run(["serve", "/irrelevant/index",
+                    "--cost-accounting", "maybe"]) == 2
+        assert "Traceback" not in capsys.readouterr().err
 
     def test_serve_missing_positional_exits_2(self, capsys):
         assert run(["serve"]) == 2
