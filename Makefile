@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak capacity-probe bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak capacity-probe bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -106,6 +106,19 @@ chaos-soak:
 quality-soak:
 	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/quality_soak.py \
 		--short --json-out build/quality-soak-verdict.json
+
+# The approximate-serving gate (docs/INDEXES.md): build a format-3 IVF
+# artifact over the large fixture and assert both enforced promises —
+# (1) speed x recall: under identical closed-loop load with shadow
+# scoring at rate 1.0, the ivf rung sustains >= 3x the exact fast rung's
+# row throughput while the shadow-scored recall SLI on the ivf rung
+# holds >= the recall floor; (2) the quality loop closes: with nprobe
+# starved to 1 the quality burn rises above 1, the probe policy widens
+# toward exact, and the short-window burn recovers. The verdict JSON
+# lands in build/ (CI uploads it as a workflow artifact).
+ivf-soak:
+	JAX_PLATFORMS=cpu python3 scripts/ivf_soak.py --short \
+		--json-out build/ivf-soak-verdict.json
 
 # The cost & capacity gate (docs/OBSERVABILITY.md §Cost & capacity): boot
 # serve with cost accounting on and assert (1) every 200's timeline

@@ -58,13 +58,17 @@ def oracle_kneighbors(
 ):
     """Host-only candidate retrieval: ``(dists [Q,k], indices [Q,k])``
     under the framework's (distance, train-index) tie order. This is THE
-    reference retrieval contract in one place — :func:`knn_oracle` votes
-    from it, and it is the terminal rung of the SERVING degradation
-    ladder (``knn_tpu/serve/batcher.py``), which cannot fail for device
-    reasons because no device is involved (predictions voted from these
+    reference retrieval contract realized over a full scan — selection
+    goes through :func:`~knn_tpu.models.ordering.lexicographic_topk`, the
+    one shared tie-order helper every host rung (including the IVF
+    candidate scorer) selects with. :func:`knn_oracle` votes from it, and
+    it is the terminal rung of the SERVING degradation ladder
+    (``knn_tpu/serve/batcher.py``), which cannot fail for device reasons
+    because no device is involved (predictions voted from these
     candidates are bit-identical to every other rung — SURVEY.md §3.5).
     """
     from knn_tpu import obs
+    from knn_tpu.models.ordering import lexicographic_topk
 
     train_x = np.asarray(train_x, np.float32)
     test_x = np.asarray(test_x, np.float32)
@@ -86,12 +90,8 @@ def oracle_kneighbors(
             # are admitted in (distance, index) order.
             np.nan_to_num(dists, copy=False, nan=np.inf)
         with obs.span("top-k", backend="oracle"):
-            for row in range(e - s):
-                # Stable (distance, index) ordering == first-seen-wins
-                # insertion.
-                order = np.lexsort((arange_n, dists[row]))[:k]
-                idx_out[s + row] = order
-                dists_out[s + row] = dists[row][order]
+            dists_out[s:e], idx_out[s:e] = lexicographic_topk(
+                dists, arange_n, k)
     return dists_out, idx_out
 
 

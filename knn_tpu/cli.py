@@ -181,6 +181,20 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--capacity-window-s", type=int, default=60,
                    help="trailing observation window for the capacity "
                    "rate rings / duty cycle / headroom model")
+    p.add_argument("--ivf-probes", type=int, default=None, metavar="P",
+                   help="serve the approximate ivf rung over the "
+                   "artifact's IVF partition, probing the nearest P "
+                   "cells per query (needs a format-3 artifact built "
+                   "with `save-index --ivf-cells`; docs/INDEXES.md). "
+                   "With shadow scoring on, the burn-aware probe policy "
+                   "widens P toward exact while the quality SLI burns "
+                   "and narrows back when the budget is healthy. Omitted "
+                   "(default): exact-only serving, zero IVF machinery")
+    p.add_argument("--ivf-recall-floor", type=float, default=0.95,
+                   help="recall@k floor the ivf rung is held to: a "
+                   "shadow-scored ivf answer under this mean recall "
+                   "burns the quality SLO (the signal the probe policy "
+                   "acts on)")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -200,6 +214,17 @@ def _add_save_index_args(p: argparse.ArgumentParser) -> None:
                    default="auto",
                    help="candidate engine (regressor; for the classifier "
                    "it is recorded as a backend option when not auto)")
+    p.add_argument("--ivf-cells", type=int, default=None, metavar="N",
+                   help="also build an IVF partition: k-means the train "
+                   "rows into N cells and persist centroids + the "
+                   "cell-sorted row layout in the artifact (format 3) — "
+                   "what `serve --ivf-probes` answers from "
+                   "(docs/INDEXES.md). Euclidean metric only")
+    p.add_argument("--ivf-seed", type=int, default=0,
+                   help="k-means seed (deterministic partitions; recorded "
+                   "in the manifest)")
+    p.add_argument("--ivf-iters", type=int, default=25,
+                   help="max Lloyd iterations for the partition build")
 
 
 def _add_classify_args(p: argparse.ArgumentParser) -> None:
@@ -486,6 +511,23 @@ def _run_save_index(args, stdout) -> int:
     if args.family == "classifier" and not degrade.known_backend(args.backend):
         print(f"error: backend '{args.backend}' unavailable", file=sys.stderr)
         return EXIT_USAGE
+    if args.ivf_cells is not None:
+        # Partition-build validation BEFORE the (possibly huge) parse:
+        # flag contradictions are usage errors, not compute failures.
+        if args.ivf_cells < 1:
+            print(f"error: --ivf-cells must be >= 1, got {args.ivf_cells}",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        if args.metric != "euclidean":
+            print(f"error: --ivf-cells partitions by squared-euclidean "
+                  f"k-means; --metric {args.metric} would probe cells "
+                  f"under the wrong geometry (docs/INDEXES.md)",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        if args.ivf_iters < 1:
+            print(f"error: --ivf-iters must be >= 1, got {args.ivf_iters}",
+                  file=sys.stderr)
+            return EXIT_USAGE
     try:
         train = load_arff(args.train)
         if args.family == "classifier":
@@ -503,17 +545,35 @@ def _run_save_index(args, stdout) -> int:
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
+    ivf = None
+    if args.ivf_cells is not None:
+        if args.ivf_cells > train.num_instances:
+            print(f"error: --ivf-cells {args.ivf_cells} exceeds the train "
+                  f"rows ({train.num_instances})", file=sys.stderr)
+            return EXIT_USAGE
+        from knn_tpu.index.ivf import IVFIndex
+
+        ivf = IVFIndex.build(
+            train.features, args.ivf_cells, seed=args.ivf_seed,
+            iters=args.ivf_iters,
+        )
     try:
-        out = save_index(model, args.out)
+        out = save_index(model, args.out, ivf=ivf)
     except ValueError as e:  # clobber refusal / non-directory target
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
     except OSError as e:  # the write itself failed
         print(f"error: {e}", file=sys.stderr)
         return EXIT_RUNTIME
+    ivf_note = ""
+    if ivf is not None:
+        ivf_note = (f", ivf_cells={ivf.num_cells} "
+                    f"(imbalance {ivf.imbalance()}, "
+                    f"{ivf.meta['iterations']} iters)")
     print(
         f"wrote index {out}: {train.num_instances} rows x "
-        f"{train.num_features} features, family={args.family}, k={args.k}",
+        f"{train.num_features} features, family={args.family}, "
+        f"k={args.k}{ivf_note}",
         file=stdout,
     )
     return 0
@@ -564,6 +624,11 @@ def _run_serve(args, stdout) -> int:
         (args.capacity_window_s < 5,
          f"--capacity-window-s must be >= 5 (shorter windows make every "
          f"rate gauge noise), got {args.capacity_window_s}"),
+        (args.ivf_probes is not None and args.ivf_probes < 1,
+         f"--ivf-probes must be >= 1, got {args.ivf_probes}"),
+        (not 0 < args.ivf_recall_floor <= 1,
+         f"--ivf-recall-floor must be in (0, 1], got "
+         f"{args.ivf_recall_floor}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -632,9 +697,14 @@ def _run_serve(args, stdout) -> int:
             reference_sketch=artifact.reference_sketch(manifest),
             cost_accounting=(args.cost_accounting == "on"),
             capacity_window_s=args.capacity_window_s,
+            ivf_probes=args.ivf_probes,
+            ivf_recall_floor=args.ivf_recall_floor,
         )
     except OSError as e:  # an unwritable --access-log path
         print(f"error: --access-log {args.access_log}: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    except DataError as e:  # --ivf-probes against an exact-only artifact
+        print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
     except ValueError as e:  # a malformed/mismatched manifest drift sketch
         print(f"error: {args.index}: {e}", file=sys.stderr)
@@ -655,11 +725,15 @@ def _run_serve(args, stdout) -> int:
         server.server_close()
         app.close()
         return EXIT_RUNTIME
+    ivf_note = ""
+    if app.ivf is not None:
+        ivf_note = (f", ivf_probes={args.ivf_probes}/"
+                    f"{model.ivf_.num_cells}")
     print(
         f"knn-tpu serve: ready on http://{host}:{port} "
         f"(family={app.family}, k={model.k}, "
         f"train_rows={model.train_.num_instances}, "
-        f"index_version={version}, warmed={sorted(warmed)})",
+        f"index_version={version}{ivf_note}, warmed={sorted(warmed)})",
         file=stdout, flush=True,
     )
     return serve_forever(server, drain_timeout_s=args.drain_timeout_s)

@@ -1,0 +1,22 @@
+"""Approximate index families (docs/INDEXES.md).
+
+Everything before this package scans every training row per query — even
+the hardware approx-top-k rung is linear in index size. ``knn_tpu/index/``
+is the sub-linear answer: partition the train set at build time
+(``save-index --ivf-cells N``), probe only the nearest cells at query
+time, and hold the quality line with the shadow-scored recall SLI
+(``obs/quality.py``) plus a burn-aware probe policy.
+
+- :mod:`knn_tpu.index.kmeans`       — batched Lloyd's with k-means++
+  seeding (JAX assignment step, seeded, runs on any backend);
+- :mod:`knn_tpu.index.ivf`          — the inverted-file index: centroids
+  + a cell-sorted row permutation persisted in the artifact (format 3),
+  query-time probe of the nearest ``nprobe`` cells with exact distances
+  and the shared (distance, index) tie order over the candidates;
+- :mod:`knn_tpu.index.probe_policy` — the quality-burn-driven ``nprobe``
+  controller (hysteresis templated on ``resilience/breaker.py``).
+"""
+
+from knn_tpu.index.ivf import IVFIndex, IVFServing  # noqa: F401
+from knn_tpu.index.kmeans import kmeans  # noqa: F401
+from knn_tpu.index.probe_policy import ProbePolicy  # noqa: F401

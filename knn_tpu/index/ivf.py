@@ -1,0 +1,382 @@
+"""The IVF (inverted-file) partitioned index — sub-linear retrieval.
+
+Jégou et al.'s IVF family (TPAMI 2011), adapted to this framework's
+contracts: the train set is partitioned into ``num_cells`` k-means cells
+at ``save-index --ivf-cells N`` time; at query time the centroids are
+ranked, the nearest ``nprobe`` cells' rows are gathered through a
+cell-sorted row permutation, and EXACT distances + the shared
+(distance, index) tie order (:mod:`knn_tpu.models.ordering`) select top-k
+over the candidates only. Cost per query is ~``nprobe/num_cells`` of a
+full scan; what approximation costs is *recall*, never wrong distances —
+every returned candidate carries its true exact distance.
+
+Correctness anchors (pinned by tests/test_ivf.py):
+
+- **nprobe == num_cells is bit-identical to exact retrieval**: the
+  candidate set is then every train row, distances are computed with the
+  oracle's own einsum form, and selection goes through the same
+  ``lexicographic_topk`` — so the full-probe IVF path reproduces
+  :func:`~knn_tpu.backends.oracle.oracle_kneighbors` bit-for-bit.
+- **Never returns short**: when the probed cells hold fewer than ``k``
+  candidates for any query (tiny cells, empty cells, k close to N), the
+  probe set WIDENS (doubling) until coverage — counted in
+  ``knn_ivf_forced_widenings_total``, never silently truncated.
+- **Degenerate partitions serve**: empty cells contribute nothing and
+  cost nothing; a single-cell index is exact retrieval with one extra
+  centroid compare.
+
+Persistence rides the artifact store (``serve/artifact.py``, format 3):
+three arrays (``ivf_centroids``, ``ivf_row_perm``, ``ivf_cell_offsets``)
+in ``arrays.npz`` plus an ``ivf`` manifest block; a format-2 artifact
+simply has neither and serves exact-only. :class:`IVFServing` is the
+serving-side wrapper: the micro-batcher's ``ivf`` rung dispatches through
+it, the :class:`~knn_tpu.index.probe_policy.ProbePolicy` supplies the
+live ``nprobe``, and the ``knn_ivf_*`` instruments record probes,
+candidate rows scanned, and cell imbalance (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from knn_tpu import obs
+from knn_tpu.models.ordering import lexicographic_topk
+from knn_tpu.resilience.errors import DataError
+
+#: The attribute a fitted model carries its IVF partition on
+#: (``artifact.load_index`` attaches it; everything else reads it with
+#: ``getattr(model, IVF_ATTR, None)`` so exact-only models stay untouched).
+IVF_ATTR = "ivf_"
+
+#: Candidate-scoring chunk bound (elements in the [chunk, M, D] gather +
+#: diff blocks) — the oracle's 4e7 halved because this path materializes
+#: both the gathered rows and the diff tensor.
+_CHUNK_ELEMS = int(2e7)
+
+
+class IVFSearchStats(NamedTuple):
+    """What one :meth:`IVFIndex.search` call actually did."""
+
+    nprobe: int            # probes used (>= requested when widened)
+    requested: int         # probes the caller asked for
+    forced_widenings: int  # doubling rounds forced by k-coverage
+    candidate_rows: int    # total train rows scored across the batch
+    cells_probed: int      # queries x nprobe
+
+
+class IVFIndex:
+    """Centroids + cell-sorted row permutation + cell offsets.
+
+    ``row_perm`` lists every train row index grouped by cell (cells in id
+    order, rows ascending inside a cell — the build sorts with a stable
+    key so artifacts are deterministic); ``cell_offsets [C+1]`` delimits
+    each cell's slice. The train rows themselves stay in the dataset —
+    the index never copies them.
+    """
+
+    __slots__ = ("centroids", "row_perm", "cell_offsets", "meta")
+
+    def __init__(self, centroids: np.ndarray, row_perm: np.ndarray,
+                 cell_offsets: np.ndarray, meta: Optional[dict] = None):
+        self.centroids = np.ascontiguousarray(centroids, np.float32)
+        self.row_perm = np.ascontiguousarray(row_perm, np.int64)
+        self.cell_offsets = np.ascontiguousarray(cell_offsets, np.int64)
+        self.meta = dict(meta or {})
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, features: np.ndarray, num_cells: int, *, seed: int = 0,
+              iters: int = 25) -> "IVFIndex":
+        """Partition ``features`` and build the inverted file. Euclidean
+        only — the cells are Voronoi regions of the squared-euclidean
+        k-means, so probing them under any other metric would rank cells
+        by the wrong geometry (the caller validates; docs/INDEXES.md)."""
+        from knn_tpu.index.kmeans import kmeans
+
+        features = np.asarray(features, np.float32)
+        n = features.shape[0]
+        with obs.span("ivf.build", rows=n, cells=num_cells):
+            centroids, assign, info = kmeans(
+                features, num_cells, seed=seed, iters=iters)
+            # Stable sort by cell: rows ascending inside each cell, so
+            # the permutation (and the artifact bytes) are deterministic.
+            row_perm = np.argsort(assign, kind="stable").astype(np.int64)
+            counts = np.bincount(assign, minlength=num_cells)
+            cell_offsets = np.zeros(num_cells + 1, np.int64)
+            np.cumsum(counts, out=cell_offsets[1:])
+        return cls(centroids, row_perm, cell_offsets, meta={
+            "num_cells": int(num_cells),
+            "seed": int(seed),
+            "iterations": int(info["iterations"]),
+            "inertia": info["inertia"],
+            "empty_cells": int(info["empty_cells"]),
+            "metric": "euclidean",
+        })
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def num_cells(self) -> int:
+        return self.centroids.shape[0]
+
+    @property
+    def num_rows(self) -> int:
+        return self.row_perm.shape[0]
+
+    @property
+    def cell_sizes(self) -> np.ndarray:
+        return np.diff(self.cell_offsets)
+
+    def imbalance(self) -> float:
+        """Largest cell vs the perfectly-balanced size (1.0 = uniform;
+        10.0 = the worst cell does 10x its share of probe work) — the
+        ``knn_ivf_cell_imbalance`` gauge."""
+        if self.num_rows == 0:
+            return 1.0
+        mean = self.num_rows / self.num_cells
+        return round(float(self.cell_sizes.max()) / mean, 3) if mean else 1.0
+
+    # -- persistence (serve/artifact.py) -----------------------------------
+
+    def to_arrays(self) -> dict:
+        """The ``arrays.npz`` entries (key prefix ``ivf_``)."""
+        return {
+            "ivf_centroids": self.centroids,
+            "ivf_row_perm": self.row_perm,
+            "ivf_cell_offsets": self.cell_offsets,
+        }
+
+    def manifest_entry(self) -> dict:
+        return dict(self.meta)
+
+    @classmethod
+    def from_arrays(cls, arrays: dict, manifest_entry: dict,
+                    train_rows: int, num_features: int,
+                    where: str = "artifact") -> "IVFIndex":
+        """Rebuild + validate from a loaded artifact. Every structural
+        invariant is checked here so a hand-edited or mismatched artifact
+        fails typed (:class:`DataError`) at load, never as wrong answers
+        or numpy errors mid-request."""
+        try:
+            centroids = np.asarray(arrays["ivf_centroids"], np.float32)
+            row_perm = np.asarray(arrays["ivf_row_perm"], np.int64)
+            cell_offsets = np.asarray(arrays["ivf_cell_offsets"], np.int64)
+        except KeyError as e:
+            raise DataError(
+                f"{where}: manifest declares an ivf partition but "
+                f"arrays.npz lacks {e} — the artifact is not from one "
+                f"save; rebuild the index") from e
+        c = centroids.shape[0]
+        if centroids.ndim != 2 or c < 1:
+            raise DataError(f"{where}: ivf_centroids must be [C>=1, D], "
+                            f"got shape {centroids.shape}")
+        if centroids.shape[1] != num_features:
+            raise DataError(
+                f"{where}: ivf centroid width {centroids.shape[1]} does "
+                f"not match the train feature width {num_features}")
+        if cell_offsets.shape != (c + 1,):
+            raise DataError(
+                f"{where}: ivf_cell_offsets must be [C+1={c + 1}], got "
+                f"shape {cell_offsets.shape}")
+        if (cell_offsets[0] != 0 or cell_offsets[-1] != train_rows
+                or (np.diff(cell_offsets) < 0).any()):
+            raise DataError(
+                f"{where}: ivf_cell_offsets must rise monotonically from "
+                f"0 to train_rows={train_rows}")
+        if row_perm.shape != (train_rows,) or (
+                train_rows and not (
+                    np.bincount(row_perm, minlength=train_rows) == 1
+                ).all()):
+            raise DataError(
+                f"{where}: ivf_row_perm must be a permutation of "
+                f"[0, {train_rows}) — the cell-sorted row order is "
+                f"corrupt; rebuild the index")
+        declared = manifest_entry.get("num_cells")
+        if declared is not None and int(declared) != c:
+            raise DataError(
+                f"{where}: manifest ivf.num_cells={declared} but the "
+                f"arrays hold {c} centroids")
+        return cls(centroids, row_perm, cell_offsets, meta=manifest_entry)
+
+    # -- query -------------------------------------------------------------
+
+    def _gather_candidates(self, sel: np.ndarray, sizes: np.ndarray,
+                           counts: np.ndarray) -> np.ndarray:
+        """Per-query candidate train indices ``[B, M]`` for the probed
+        cells ``sel [B, P]``, padded with ``num_rows`` (the sentinel the
+        scorer masks to +inf). Fully vectorized: one searchsorted over
+        the flattened (query, cell) segment lengths replaces a Python
+        slice loop per probe — the gather was the host hot path."""
+        n = self.num_rows
+        b, _p = sel.shape
+        m = int(counts.max()) if b else 0
+        cand = np.full((b, m), n, np.int64)
+        starts = self.cell_offsets[:-1][sel]
+        lens = sizes[sel]
+        total = int(lens.sum())
+        if total == 0:
+            return cand
+        flat_lens = lens.ravel()
+        ends = np.cumsum(flat_lens)
+        pos = np.arange(total)
+        seg = np.searchsorted(ends, pos, side="right")
+        src = starts.ravel()[seg] + pos - (ends[seg] - flat_lens[seg])
+        qof = seg // sel.shape[1]
+        qstart = np.concatenate(([0], np.cumsum(counts)))
+        cand[qof, pos - qstart[qof]] = self.row_perm[src]
+        return cand
+
+    def search(self, train_x: np.ndarray, queries: np.ndarray, k: int,
+               nprobe: int):
+        """Probed retrieval: ``(dists [Q,k] f32, indices [Q,k] int64,
+        stats)`` under the shared (distance, index) tie order.
+
+        Distances of the probed candidates are EXACT — computed with the
+        oracle backend's einsum form on the same float32 operands, which
+        is what makes the full-probe path bit-identical to
+        ``oracle_kneighbors`` and keeps the shadow scorer's
+        distance-divergence check silent on this rung. Queries with NaN
+        features follow the framework NaN → +inf policy.
+        """
+        train_x = np.asarray(train_x, np.float32)
+        queries = np.asarray(queries, np.float32)
+        n, q = train_x.shape[0], queries.shape[0]
+        if n != self.num_rows:
+            raise DataError(
+                f"ivf index spans {self.num_rows} rows but the train set "
+                f"has {n} — index and data are out of sync")
+        c = self.num_cells
+        k = min(int(k), n)
+        requested = min(max(1, int(nprobe)), c)
+        nprobe = requested
+        with obs.span("ivf.search", rows=q, nprobe=requested, k=k):
+            # Rank cells per query (fast matmul form would do — ranking
+            # only — but C is small, so keep the oracle's diff form and
+            # one less code path).
+            diff = queries[:, None, :] - self.centroids[None, :, :]
+            cd = np.einsum("qcd,qcd->qc", diff, diff, dtype=np.float32)
+            np.nan_to_num(cd, copy=False, nan=np.inf)
+            # Stable argsort: equal centroid distances probe the lower
+            # cell id first — deterministic probe order.
+            order = np.argsort(cd, axis=1, kind="stable")
+            sizes = self.cell_sizes
+            # k-coverage widening: never return short.
+            forced = 0
+            while True:
+                counts = sizes[order[:, :nprobe]].sum(axis=1)
+                if int(counts.min()) >= k or nprobe >= c:
+                    break
+                nprobe = min(c, nprobe * 2)
+                forced += 1
+            sel = order[:, :nprobe]
+            dists_out = np.empty((q, k), np.float32)
+            idx_out = np.empty((q, k), np.int64)
+            d_feat = max(train_x.shape[1], 1)
+            m_global = int(counts.max()) if q else 0
+            chunk = max(1, min(q or 1,
+                               _CHUNK_ELEMS // max(m_global * d_feat, 1)))
+            for s in range(0, q, chunk):
+                e = min(q, s + chunk)
+                # Pad slots carry candidate index n (sorts after every
+                # real index, so a real +inf-distance candidate still
+                # wins the tie) and distance +inf.
+                cand = self._gather_candidates(sel[s:e], sizes,
+                                               counts[s:e])
+                gathered = train_x[np.minimum(cand, n - 1)]
+                gdiff = queries[s:e][:, None, :] - gathered
+                d = np.einsum("qmd,qmd->qm", gdiff, gdiff,
+                              dtype=np.float32)
+                np.nan_to_num(d, copy=False, nan=np.inf)
+                d[cand == n] = np.inf
+                dists_out[s:e], idx_out[s:e] = lexicographic_topk(
+                    d, cand, k)
+        return dists_out, idx_out, IVFSearchStats(
+            nprobe=nprobe, requested=requested, forced_widenings=forced,
+            candidate_rows=int(counts.sum()) if q else 0,
+            cells_probed=q * nprobe,
+        )
+
+
+class IVFServing:
+    """The serving-side IVF rung: probe policy + instruments.
+
+    Holds NO index — it reads the batch's own model snapshot
+    (``model.ivf_``), so hot reloads swap the partition with the model
+    atomically and a response can never mix one index's rows with
+    another's centroids. Constructed only when ``serve --ivf-probes`` is
+    given (the zero-cost-when-disabled contract:
+    ``scripts/check_disabled_overhead.py`` pins that an exact-only boot
+    builds none of this).
+    """
+
+    def __init__(self, base_probes: int, num_cells: int, *, slo=None,
+                 recall_floor: float = 0.95, policy=None):
+        if not 0.0 < recall_floor <= 1.0:
+            raise ValueError(
+                f"recall_floor must be in (0, 1], got {recall_floor}")
+        from knn_tpu.index.probe_policy import ProbePolicy
+
+        self.recall_floor = float(recall_floor)
+        self.policy = policy if policy is not None else ProbePolicy(
+            base_probes, num_cells, slo=slo)
+
+    def set_num_cells(self, num_cells: int) -> None:
+        """Re-bound the policy after a hot reload swapped in an index
+        with a different cell count."""
+        self.policy.set_num_cells(num_cells)
+
+    def kneighbors(self, model, feats: np.ndarray):
+        """One ivf-rung dispatch for the micro-batcher: policy-chosen
+        ``nprobe``, probed search, instruments. Returns ``(dists, idx)``
+        like every other rung closure."""
+        index = getattr(model, IVF_ATTR, None)
+        if index is None:  # reload validation forbids this; stay typed
+            raise DataError("serving model has no ivf partition")
+        train = model.train_
+        dists, idx, stats = index.search(
+            train.features, feats, model.k, self.policy.current())
+        obs.gauge_set(
+            "knn_ivf_probes", stats.nprobe,
+            help="cells probed per query by the last ivf-rung dispatch "
+                 "(the probe policy's live operating point)",
+        )
+        obs.gauge_set(
+            "knn_ivf_cell_imbalance", index.imbalance(),
+            help="largest cell size over the balanced size (1.0 = "
+                 "uniform partition)",
+        )
+        obs.counter_add(
+            "knn_ivf_queries_total", feats.shape[0],
+            help="query rows answered by the ivf rung",
+        )
+        obs.counter_add(
+            "knn_ivf_candidate_rows_total", stats.candidate_rows,
+            help="train rows gathered and exactly scored by ivf probes "
+                 "(the sub-linear win: compare with train_rows x queries)",
+        )
+        if stats.forced_widenings:
+            obs.counter_add(
+                "knn_ivf_forced_widenings_total", stats.forced_widenings,
+                help="probe doublings forced because the probed cells "
+                     "held fewer than k candidates (the never-return-"
+                     "short guarantee)",
+            )
+        return dists, idx
+
+    def export(self, model=None) -> dict:
+        """The ``/healthz`` ivf block."""
+        index = getattr(model, IVF_ATTR, None) if model is not None else None
+        doc = {
+            "recall_floor": self.recall_floor,
+            **self.policy.export(),
+        }
+        if index is not None:
+            doc.update(
+                num_cells=index.num_cells,
+                empty_cells=int((index.cell_sizes == 0).sum()),
+                cell_imbalance=index.imbalance(),
+            )
+        return doc

@@ -114,8 +114,11 @@ def dispatch_padded_rows(model, rung: str, rows: int, cap: int) -> int:
     rows, summed over the ``max_batch`` chunking the batcher applies
     (``MicroBatcher._call_rung``): each chunk pads to its engine's quantum
     independently."""
-    if rung == "oracle":
-        engine = "oracle"
+    if rung in ("oracle", "ivf"):
+        # Host rungs pad nothing: the oracle scans numpy directly, and
+        # the ivf rung gathers exact candidate sets on host
+        # (knn_tpu/index/ivf.py) — rows in == rows swept.
+        engine = rung
     elif rung == "xla":
         engine = "xla"
     else:  # the model's own fast rung

@@ -168,12 +168,24 @@ class ShadowScorer:
     ``slo``       — optional :class:`~knn_tpu.obs.slo.SLOTracker`; each
                     scored request records one ``quality`` SLI event
                     (good = recall 1.0 and vote agreement);
+    ``approx_floors`` — ``{rung: recall_floor}`` for APPROXIMATE rungs
+                    (the ivf rung's ``--ivf-recall-floor``): a request
+                    answered by such a rung is quality-good when its mean
+                    recall@k meets the floor and every served distance is
+                    honest — rather than the exact rungs' bit-exact bar,
+                    which an approximate rung would burn constantly at
+                    its designed operating point. Divergence COUNTING is
+                    unchanged (any row under recall 1.0 still counts
+                    ``neighbors`` divergence for attribution); only the
+                    SLI verdict applies the floor. Empty/None = every
+                    rung held to the exact bar.
     ``autostart`` — tests pin shed/queue mechanics with the worker held
                     off; serving always autostarts.
     """
 
     def __init__(self, rate: float, *, queue_cap: int = 256, seed: int = 0,
-                 slo=None, autostart: bool = True):
+                 slo=None, approx_floors: "Dict[str, float] | None" = None,
+                 autostart: bool = True):
         if not 0.0 < rate <= 1.0:
             raise ValueError(
                 f"shadow rate must be in (0, 1], got {rate} (omit the "
@@ -181,6 +193,12 @@ class ShadowScorer:
             )
         self.rate = float(rate)
         self.slo = slo
+        for rung, floor in (approx_floors or {}).items():
+            if not 0.0 < floor <= 1.0:
+                raise ValueError(
+                    f"approx recall floor for rung {rung!r} must be in "
+                    f"(0, 1], got {floor}")
+        self.approx_floors = dict(approx_floors or {})
         # `offered` is mutated only on the batcher worker thread (the one
         # tap site); everything the scoring thread and readers share lives
         # under `_lock`.
@@ -272,8 +290,18 @@ class ShadowScorer:
                 vote_ok = int(np.count_nonzero(got == want_preds))
         rows = int(recalls.shape[0])
         neighbor_rows = int(np.count_nonzero(recalls < 1.0))
-        good = (neighbor_rows == 0 and dist_rows == 0
-                and vote_ok == vote_rows)
+        floor = self.approx_floors.get(s.rung)
+        if floor is not None:
+            # An approximate rung is held to its recall FLOOR, not the
+            # exact bar: good = honest distances + mean recall at/over
+            # the floor (vote flips below-floor recall causes are what
+            # the floor already prices in; a dishonest distance is
+            # always a defect).
+            good = (dist_rows == 0
+                    and float(recalls.mean()) >= floor)
+        else:
+            good = (neighbor_rows == 0 and dist_rows == 0
+                    and vote_ok == vote_rows)
         with self._lock:
             self.scored += 1
             st = self._rungs.setdefault(s.rung, _RungStats())
@@ -341,6 +369,7 @@ class ShadowScorer:
             }
             summary = {
                 "rate": self.rate,
+                "approx_floors": dict(self.approx_floors) or None,
                 "offered": self.offered,
                 "scored": self.scored,
                 "shed": self.shed,

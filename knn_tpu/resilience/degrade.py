@@ -43,14 +43,19 @@ from knn_tpu import obs
 from knn_tpu.resilience.errors import DataError, DeviceError, ResilienceError
 
 #: The SERVING ladder's canonical rung order (``serve/batcher.py``
-#: walks it fast → xla → oracle; "xla" is skipped when it IS the fast
-#: engine). Shared here so every layer that attributes work to a rung —
-#: the batcher's ``knn_serve_fallback_total`` labels, the shadow scorer's
+#: walks it ivf → fast → xla → oracle; "ivf" exists only when the served
+#: artifact carries an IVF partition AND ``serve --ivf-probes`` enabled
+#: approximate serving, and "xla" is skipped when it IS the fast engine).
+#: The exact rungs below ivf are the truth anchor: a typed failure on the
+#: ivf rung degrades to bit-exact retrieval, so approximation can only
+#: ever be traded away, never silently substituted. Shared here so every
+#: layer that attributes work to a rung — the batcher's
+#: ``knn_serve_fallback_total`` labels, the shadow scorer's
 #: ``knn_quality_recall{rung}`` / ``knn_quality_divergence_total{rung,...}``
 #: (obs/quality.py), and ``/debug/quality``'s fast-to-degraded row order —
 #: agrees on one vocabulary; a rung label outside this tuple is an
 #: instrumentation bug.
-SERVING_RUNGS: Tuple[str, ...] = ("fast", "xla", "oracle")
+SERVING_RUNGS: Tuple[str, ...] = ("ivf", "fast", "xla", "oracle")
 
 #: backend -> fallback rungs, most-capable first.
 LADDER: Dict[str, Tuple[str, ...]] = {

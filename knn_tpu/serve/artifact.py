@@ -49,8 +49,14 @@ from knn_tpu.resilience.errors import DataError
 #: field (the training distribution's per-feature summary,
 #: obs/drift.py) — loaders accept BOTH, and a format-1 (sketch-less)
 #: artifact serves normally with drift scoring in its distinct
-#: "no baseline" state (never fabricated scores).
-ARTIFACT_FORMAT = 2
+#: "no baseline" state (never fabricated scores); 3 adds the optional
+#: IVF partition (``save-index --ivf-cells``): an ``ivf`` manifest block
+#: plus ``ivf_centroids``/``ivf_row_perm``/``ivf_cell_offsets`` in
+#: ``arrays.npz`` (knn_tpu/index/ivf.py, docs/INDEXES.md) — loaders
+#: accept 1-3, and a format-1/2 (partition-less) artifact serves
+#: exact-only with zero IVF machinery constructed
+#: (scripts/check_disabled_overhead.py).
+ARTIFACT_FORMAT = 3
 MANIFEST_NAME = "manifest.json"
 ARRAYS_NAME = "arrays.npz"
 
@@ -106,16 +112,41 @@ def _model_manifest(model) -> dict:
     )
 
 
-def save_index(model, path) -> Path:
+def save_index(model, path, ivf=None) -> Path:
     """Write a fitted model to ``path`` (a directory; created if missing).
+
+    ``ivf`` — an optional :class:`~knn_tpu.index.ivf.IVFIndex` to persist
+    alongside the model (the ``save-index --ivf-cells`` path); when None,
+    a partition already attached to the model (``model.ivf_`` — the
+    load/re-save round trip) is kept. The partition must span exactly the
+    train rows being saved.
 
     Refuses to clobber a non-empty directory that is not already an
     artifact (no ``manifest.json``) — re-saving over an existing artifact
     is fine. Raises ``ValueError``/``OSError`` for bad inputs/paths (the
     CLI maps both to exit 2).
     """
+    from knn_tpu.index.ivf import IVF_ATTR
+
     train = model.train_  # RuntimeError before fit
     manifest = _model_manifest(model)
+    if ivf is None:
+        ivf = getattr(model, IVF_ATTR, None)
+    if ivf is not None and ivf.num_rows != train.num_instances:
+        raise ValueError(
+            f"ivf partition spans {ivf.num_rows} rows but the train set "
+            f"has {train.num_instances} — rebuild the partition from "
+            f"this data"
+        )
+    if ivf is not None and manifest.get("metric") != "euclidean":
+        # The partition's cells are Voronoi regions of the squared-
+        # euclidean k-means (index/ivf.py) — probing them under another
+        # metric ranks cells by the wrong geometry. The CLI refuses this
+        # too, but the contract must hold for library callers.
+        raise ValueError(
+            f"ivf partitions are euclidean-only; this model uses metric "
+            f"{manifest.get('metric')!r}"
+        )
     out = Path(path)
     if out.exists():
         if not out.is_dir():
@@ -130,6 +161,9 @@ def save_index(model, path) -> Path:
     arrays = {"features": train.features, "labels": train.labels}
     if train.raw_targets is not None:
         arrays["raw_targets"] = train.raw_targets
+    if ivf is not None:
+        arrays.update(ivf.to_arrays())
+        manifest["ivf"] = ivf.manifest_entry()
     np.savez(out / ARRAYS_NAME, **arrays)
     # The reference (training) distribution sketch for query-drift
     # detection (obs/drift.py): one exact numpy pass at build time — the
@@ -227,11 +261,19 @@ def load_index(path):
     manifest = _read_manifest(root)
     import zipfile
 
+    ivf_manifest = manifest.get("ivf")
+    ivf_arrays = None
     try:
         with np.load(root / ARRAYS_NAME, allow_pickle=False) as z:
             features = z["features"]
             labels = z["labels"]
             raw_targets = z["raw_targets"] if "raw_targets" in z else None
+            if isinstance(ivf_manifest, dict):
+                # Read inside the open npz; validated into an IVFIndex
+                # below, after the dataset's own schema checks pass.
+                ivf_arrays = {k: z[k] for k in
+                              ("ivf_centroids", "ivf_row_perm",
+                               "ivf_cell_offsets") if k in z}
     # BadZipFile subclasses Exception directly (not OSError/ValueError) and
     # is what a truncated/corrupt .npz actually raises.
     except (OSError, KeyError, ValueError, zipfile.BadZipFile) as e:
@@ -274,12 +316,35 @@ def load_index(path):
             )
         else:
             raise DataError(f"{root}: unknown model family {family!r}")
-        return model.fit(train)
+        model.fit(train)
     except (KeyError, TypeError, ValueError) as e:
         if isinstance(e, DataError):
             raise
         raise DataError(f"{root}: manifest does not describe a loadable "
                         f"model: {e}") from e
+    if isinstance(ivf_manifest, dict):
+        # Format 3: attach the validated IVF partition. A structurally
+        # corrupt partition is a typed load failure (never wrong answers
+        # mid-request); a format-1/2 artifact skips this entirely and the
+        # model carries no ivf_ attribute.
+        from knn_tpu.index.ivf import IVF_ATTR, IVFIndex
+
+        if manifest.get("metric") != "euclidean":
+            # save_index refuses this pairing; an artifact carrying it
+            # was hand-edited (schema_hash covers attribute metadata,
+            # not the metric field). Probing euclidean cells under
+            # another metric would serve wrong-geometry answers.
+            raise DataError(
+                f"{root}: artifact pairs an ivf partition with metric "
+                f"{manifest.get('metric')!r}; ivf partitions are "
+                f"euclidean-only — rebuild the index"
+            )
+        setattr(model, IVF_ATTR, IVFIndex.from_arrays(
+            ivf_arrays or {}, ivf_manifest,
+            train_rows=train.num_instances,
+            num_features=train.num_features, where=str(root),
+        ))
+    return model
 
 
 def warmup(model, batch_sizes=(1, 256), kinds=("predict",)) -> dict:

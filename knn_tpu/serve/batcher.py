@@ -94,6 +94,7 @@ from knn_tpu.resilience.errors import (
     DeadlineExceededError,
     DeviceError,
     OverloadError,
+    ResilienceError,
 )
 
 KINDS = ("predict", "kneighbors")
@@ -236,13 +237,25 @@ class MicroBatcher:
                          busy-time/occupancy feed its rate rings and the
                          headroom model (``knn_capacity_*``,
                          ``GET /debug/capacity``).
+    ``ivf``            — an optional
+                         :class:`~knn_tpu.index.ivf.IVFServing`: slots an
+                         ``ivf`` rung ABOVE ``fast`` in the ladder —
+                         probed approximate retrieval over the model's
+                         IVF partition (``model.ivf_``), with the probe
+                         policy choosing ``nprobe`` per dispatch. The
+                         exact rungs below stay the truth anchor: any
+                         typed ivf failure degrades to bit-exact
+                         retrieval. None (the default, and always for
+                         partition-less models) keeps the ladder exact
+                         with one ``is None`` predicate.
     """
 
     def __init__(self, model, *, max_batch: int = 256,
                  max_wait_ms: float = 2.0, max_queue_rows: int = 4096,
                  index_version: Optional[str] = None,
                  recorder: "Optional[reqtrace.FlightRecorder]" = None,
-                 quality=None, drift=None, accounting=None, capacity=None):
+                 quality=None, drift=None, accounting=None, capacity=None,
+                 ivf=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -260,6 +273,7 @@ class MicroBatcher:
         self.drift = drift
         self.accounting = accounting
         self.capacity = capacity
+        self.ivf = ivf
         # TEST-ONLY corruption hook (scripts/quality_soak.py): when armed
         # (the serve process installs a SIGUSR2 handler only under
         # KNN_TPU_TEST_QUALITY_CORRUPT), served neighbor indices are
@@ -597,11 +611,16 @@ class MicroBatcher:
 
     def _rungs(self, model):
         """The serving ladder for this batch's model snapshot:
-        ``fast`` (the model's own configured retrieval — engine selection
-        + device cache), ``xla`` (the tiled candidate scan, skipped when
-        it IS the fast engine), ``oracle`` (pure NumPy — cannot fail for
-        device reasons). Every rung retrieves under the same (distance,
-        train-index) contract, so votes are bit-identical down the ladder.
+        ``ivf`` (probed approximate retrieval over the model's IVF
+        partition — present only when this batcher serves approximate AND
+        the snapshot carries one), ``fast`` (the model's own configured
+        retrieval — engine selection + device cache), ``xla`` (the tiled
+        candidate scan, skipped when it IS the fast engine), ``oracle``
+        (pure NumPy — cannot fail for device reasons). The exact rungs
+        retrieve under the same (distance, train-index) contract, so
+        votes are bit-identical down the EXACT ladder; the ivf rung
+        trades recall for sub-linear cost and is held to its floor by the
+        shadow scorer + probe policy (docs/INDEXES.md).
         """
         train = model.train_
         k, metric = model.k, model.metric
@@ -626,7 +645,11 @@ class MicroBatcher:
             engine = model._retrieval_engine()
         else:
             engine = model.engine
-        rungs = [("fast", fast)]
+        rungs = []
+        if self.ivf is not None and getattr(model, "ivf_", None) is not None:
+            rungs.append(("ivf",
+                          lambda feats: self.ivf.kneighbors(model, feats)))
+        rungs.append(("fast", fast))
         if engine != "xla":  # "auto" may resolve to stripe on real TPU
             rungs.append(("xla", xla))
         rungs.append(("oracle", oracle))
@@ -807,6 +830,17 @@ class MicroBatcher:
                         continue  # same rung, smaller chunks
                     last_err = e
                 except (CompileError, CollectiveError, OSError) as e:
+                    self._account_attempt(model, live, traced, name,
+                                          t_rung, feats, error=e)
+                    last_err = e
+                except ResilienceError as e:
+                    # The ivf rung degrades on the REST of the taxonomy
+                    # too (a DataError from an index/model desync):
+                    # approximation is traded away for bit-exact
+                    # retrieval, never a failed batch. On exact rungs
+                    # these errors stay the request's own typed outcome.
+                    if name != "ivf":
+                        raise
                     self._account_attempt(model, live, traced, name,
                                           t_rung, feats, error=e)
                     last_err = e

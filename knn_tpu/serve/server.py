@@ -161,7 +161,9 @@ class ServeApp:
                  quality_queue: int = 256, quality_seed: int = 0,
                  reference_sketch: Optional[dict] = None,
                  cost_accounting: bool = False,
-                 capacity_window_s: int = 60):
+                 capacity_window_s: int = 60,
+                 ivf_probes: Optional[int] = None,
+                 ivf_recall_floor: float = 0.95):
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -169,6 +171,28 @@ class ServeApp:
         self.deadline_ms = deadline_ms
         self.index_path = index_path
         self.index_version = index_version
+        # Approximate serving (docs/INDEXES.md): --ivf-probes opts in to
+        # the ivf rung over the artifact's IVF partition. Validated FIRST
+        # — a DataError here must abort before any worker thread exists.
+        # None (the default, and always for partition-less artifacts)
+        # constructs NOTHING: no IVFServing, no probe policy, no
+        # knn_ivf_* instruments (scripts/check_disabled_overhead.py).
+        if ivf_probes is not None:
+            from knn_tpu.index.ivf import IVF_ATTR, IVFServing
+
+            partition = getattr(model, IVF_ATTR, None)
+            if partition is None:
+                raise DataError(
+                    "--ivf-probes needs an artifact with an IVF partition "
+                    "(format 3, built with `save-index --ivf-cells N`); "
+                    "this one is exact-only"
+                )
+            if not 1 <= ivf_probes <= partition.num_cells:
+                raise DataError(
+                    f"--ivf-probes {ivf_probes} out of range: the "
+                    f"partition has {partition.num_cells} cells"
+                )
+        self.ivf_recall_floor = float(ivf_recall_floor)
         # Request tracing: the flight recorder holds the last-N completed
         # request timelines + a slowest-K reservoir (/debug/requests,
         # /debug/slowest). Size 0 disables the layer entirely (the batcher
@@ -203,9 +227,21 @@ class ServeApp:
             self.quality = ShadowScorer(
                 shadow_rate, queue_cap=quality_queue, seed=quality_seed,
                 slo=self.slo,
+                # The ivf rung is held to its recall FLOOR, not the exact
+                # rungs' bit-exact bar (obs/quality.py) — the quality SLI
+                # this feeds is what the probe policy closes its loop on.
+                approx_floors=({"ivf": self.ivf_recall_floor}
+                               if ivf_probes is not None else None),
             )
         else:
             self.quality = None
+        if ivf_probes is not None:
+            self.ivf = IVFServing(
+                ivf_probes, partition.num_cells, slo=self.slo,
+                recall_floor=self.ivf_recall_floor,
+            )
+        else:
+            self.ivf = None
         # Cost & capacity (obs/accounting.py, obs/capacity.py): off (the
         # embedded default) constructs NOTHING — no accountant, no
         # tracker, no knn_cost_*/knn_capacity_* instruments, no x-knn-class
@@ -226,6 +262,7 @@ class ServeApp:
             max_queue_rows=max_queue_rows, index_version=index_version,
             recorder=self.recorder, quality=self.quality, drift=self.drift,
             accounting=self.accounting, capacity=self.capacity,
+            ivf=self.ivf,
         )
         self.ready = False
         self.draining = False
@@ -236,6 +273,14 @@ class ServeApp:
         self._reload_lock = threading.Lock()
         self._inflight = 0
         self._inflight_cond = threading.Condition()
+
+    @property
+    def primary_rung(self) -> str:
+        """The rung a healthy request is EXPECTED to ride — what the
+        fast_rung SLI scores against: ``ivf`` when approximate serving is
+        on (an ivf-answered request is the designed operating point, not
+        a degradation), ``fast`` otherwise."""
+        return "ivf" if self.ivf is not None else "fast"
 
     def warm(self, batch_sizes=None) -> dict:
         """Compile the serving dispatch shapes, then report ready.
@@ -323,6 +368,14 @@ class ServeApp:
                     f"were validated against the old schema; rejecting the "
                     f"swap"
                 )
+            new_partition = getattr(model, "ivf_", None)
+            if self.ivf is not None and new_partition is None:
+                raise DataError(
+                    f"{target}: this process serves the ivf rung "
+                    f"(--ivf-probes) but the replacement artifact has no "
+                    f"IVF partition — rebuild it with `save-index "
+                    f"--ivf-cells N` or redeploy exact-only"
+                )
             # Warm in the background sense: the OLD index keeps serving
             # while these compiles run — they touch only the new model's
             # device cache.
@@ -342,6 +395,10 @@ class ServeApp:
             self.model = model
             self.index_version = version
             self.reloads += 1
+            if self.ivf is not None:
+                # Re-bound the probe policy: the new partition may have a
+                # different cell count (the operating point clamps).
+                self.ivf.set_num_cells(new_partition.num_cells)
             if self.capacity is not None:
                 # The new index's dispatch-cost curve replaces the old
                 # seeds (runs on the reload thread, off the serving path).
@@ -463,6 +520,10 @@ class ServeApp:
             "slo": self.slo.export(),
             "device": self._device_block(),
             "quality": self.quality_block(),
+            # The approximate-serving summary (probe policy operating
+            # point, partition shape); None for exact-only serves.
+            "ivf": (self.ivf.export(self.model)
+                    if self.ivf is not None else None),
             # The capacity summary (export() also refreshes the
             # knn_capacity_* gauges); None while --cost-accounting off.
             "capacity": (self.capacity.export()
@@ -831,8 +892,12 @@ class _Handler(BaseHTTPRequestHandler):
         never admitted), and the structured access-log line."""
         ms = (time.monotonic() - t0) * 1e3
         if status != 400:
+            # degraded = not the rung a healthy request is expected to
+            # ride: "fast" normally, "ivf" when approximate serving is on
+            # (an ivf answer is the designed operating point there, and a
+            # FALLBACK to exact is the capacity-burning degradation).
             self.app.slo.record(status == 200, ms,
-                                degraded=(rung != "fast"))
+                                degraded=(rung != self.app.primary_rung))
         if trace is not None:
             trace.annotate(status=status)
             if not trace.finished:

@@ -101,6 +101,22 @@ def main() -> int:
                 or app.batcher.capacity is not None):
             return fail("the batcher holds an accounting/capacity tap "
                         "while disabled")
+        # IVF (PR 9): a format-1/2 / exact-only model (no ivf_ partition,
+        # no --ivf-probes) must construct ZERO approximate-serving
+        # machinery — no IVFServing, no probe policy, no ivf ladder rung;
+        # the exact ladder is untouched.
+        if app.ivf is not None or app.batcher.ivf is not None:
+            return fail("ServeApp built IVF serving machinery for an "
+                        "exact-only model — the ivf layer must not exist "
+                        "while disabled")
+        if any(name == "ivf" for name, _fn
+               in app.batcher._rungs(app.batcher._model)):
+            return fail("the serving ladder grew an ivf rung for an "
+                        "exact-only model")
+        if app.primary_rung != "fast":
+            return fail(f"primary rung {app.primary_rung!r} on an "
+                        f"exact-only serve; the fast_rung SLI would "
+                        f"misattribute")
         app.batcher.predict(test.features[0], timeout=60)
     finally:
         app.close()
@@ -111,13 +127,15 @@ def main() -> int:
                     f"{bad_threads}")
     leaked = [i.name for i in obs.registry().instruments()
               if i.name.startswith(("knn_quality_", "knn_drift_",
-                                    "knn_cost_", "knn_capacity_"))]
+                                    "knn_cost_", "knn_capacity_",
+                                    "knn_ivf_"))]
     if leaked:
-        return fail(f"quality/drift/cost/capacity instrument(s) recorded "
-                    f"while disabled: {leaked}")
-    print("disabled-overhead: quality/drift/cost/capacity off-state ok "
-          "(no scorer, no monitor, no accountant, no tracker, no worker "
-          "threads, zero instruments, zero queue activity)")
+        return fail(f"quality/drift/cost/capacity/ivf instrument(s) "
+                    f"recorded while disabled: {leaked}")
+    print("disabled-overhead: quality/drift/cost/capacity/ivf off-state "
+          "ok (no scorer, no monitor, no accountant, no tracker, no probe "
+          "policy, no worker threads, zero instruments, zero queue "
+          "activity)")
 
     # -- 1b. the device-side layer (obs/devprof.py) off-state --------------
     # Even with the compile listener having been registered by a PRIOR

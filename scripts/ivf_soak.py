@@ -1,0 +1,401 @@
+"""IVF-soak gate (`make ivf-soak`): approximate serving held to its floor.
+
+The ivf rung ships behind two enforced promises (docs/INDEXES.md), and
+this gate measures both on the LARGE fixture — the regime partitioned
+retrieval exists for:
+
+**Phase 1 — speed x recall.** Build a format-3 artifact
+(``save-index --ivf-cells``), boot `knn_tpu serve` twice under identical
+closed-loop load with shadow scoring at rate 1.0: once exact-only, once
+with ``--ivf-probes``. Assert the ivf serve sustains at least
+``--min-speedup`` (default 3.0) times the exact serve's row throughput
+AND the shadow-scored recall SLI on the ivf rung holds at or above the
+recall floor — the speed is real only if the quality SLI says the
+answers stayed good, and the recall is trusted only because the scorer
+recomputes every served distance itself.
+
+**Phase 2 — burn detected, probe policy recovers.** Boot with ``--ivf-
+probes 1`` (recall far below the floor on this partition) and fast
+policy knobs. Assert the causal chain the quality loop promises: the
+quality burn rate RISES above 1 (the shadow scorer caught the recall
+violation), the probe policy WIDENS nprobe (visible in /healthz), and
+the short-window burn then RECOVERS to <= 1 — the self-healing answer to
+"an approximate rung silently serving bad neighbors".
+
+Exit 0 when every invariant holds; 1 with a diagnosis. stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
+BOOT_TIMEOUT_S = 180
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--short", action="store_true",
+                   help="CI preset: ~6 s load windows")
+    p.add_argument("--window-s", type=float, default=None)
+    p.add_argument("--clients", type=int, default=2)
+    p.add_argument("--rows", type=int, default=16,
+                   help="query rows per request (a serving-shape batch)")
+    p.add_argument("--cells", type=int, default=128)
+    p.add_argument("--probes", type=int, default=8,
+                   help="phase-1 --ivf-probes (the healthy operating "
+                   "point)")
+    p.add_argument("--recall-floor", type=float, default=0.95)
+    p.add_argument("--min-speedup", type=float, default=3.0,
+                   help="required ivf/exact row-throughput multiple "
+                   "(the acceptance bar)")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--json-out", default=None, metavar="FILE")
+    args = p.parse_args()
+    if args.window_s is None:
+        args.window_s = 6.0 if args.short else 15.0
+    return args
+
+
+def fail(msg: str, *procs) -> int:
+    print(f"ivf-soak: FAIL: {msg}", file=sys.stderr)
+    for proc in procs:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+    return 1
+
+
+def http(base: str, path: str, payload=None, timeout=60):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"} if payload else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def boot(index: str, env: dict, extra_flags):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "knn_tpu.cli", "serve", index,
+         "--port", "0", "--max-batch", "32", "--max-wait-ms", "1",
+         *extra_flags],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO,
+    )
+    import queue
+
+    lines: "queue.Queue[str]" = queue.Queue()
+    threading.Thread(
+        target=lambda: [lines.put(ln) for ln in proc.stdout], daemon=True,
+    ).start()
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=min(1.0, max(
+                0.01, deadline - time.monotonic())))
+        except Exception:  # noqa: BLE001 — queue.Empty
+            if proc.poll() is not None:
+                return proc, None
+            continue
+        m = READY_RE.search(line)
+        if m:
+            print(f"ivf-soak: server: {line.rstrip()}")
+            return proc, m.group(1)
+    return proc, None
+
+
+def shutdown(proc, base=None) -> "int | None":
+    proc.send_signal(signal.SIGINT)
+    try:
+        return proc.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return None
+
+
+def run_load(base, rows_mat, n_clients, req_rows, window_s):
+    """Closed-loop predict load for ``window_s`` seconds; returns
+    (ok_requests, ok_rows, violations, wall_s)."""
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"ok": 0, "rows": 0}
+    violations: list = []
+    q = rows_mat.shape[0]
+
+    def loop(cid):
+        i = cid * 31
+        while not stop.is_set():
+            lo = (7 * i) % max(1, q - req_rows)
+            i += 1
+            payload = {"instances": rows_mat[lo:lo + req_rows].tolist()}
+            try:
+                st, body = http(base, "/predict", payload)
+            except Exception as e:  # noqa: BLE001 — recorded
+                with lock:
+                    violations.append(f"client {cid} transport error: {e}")
+                continue
+            if st == 200:
+                with lock:
+                    stats["ok"] += 1
+                    stats["rows"] += req_rows
+            elif st == 500:
+                with lock:
+                    violations.append(f"client {cid}: 500: {body[:200]}")
+
+    threads = [threading.Thread(target=loop, args=(c,), daemon=True)
+               for c in range(n_clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(window_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+        if t.is_alive():
+            violations.append("a client thread hung")
+    wall = time.monotonic() - t0
+    return stats["ok"], stats["rows"], violations, wall
+
+
+def quality_doc(base):
+    st, body = http(base, "/debug/quality", timeout=60)
+    if st != 200:
+        raise RuntimeError(f"/debug/quality: status {st}: {body[:200]}")
+    return json.loads(body)
+
+
+def wait_queue_drained(base, timeout_s=120):
+    deadline = time.monotonic() + timeout_s
+    doc = None
+    while time.monotonic() < deadline:
+        doc = quality_doc(base)
+        sh = doc["shadow"]
+        if sh["queue_depth"] == 0 and sh["scored"] + sh["shed"] > 0:
+            return doc
+        time.sleep(0.3)
+    return doc
+
+
+def main() -> int:
+    args = parse_args()
+    from bench import load_large  # noqa: E402 — repo-root import
+
+    train, test, _ = load_large()
+    d = Path(__file__).parent.parent / "build" / "fixtures"
+    ref = Path("/root/reference/datasets")
+    train_arff = str((ref if ref.exists() else d) / "large-train.arff")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KNN_TPU_RETRY_BASE_MS="0")
+    shadow_flags = [
+        "--shadow-rate", "1", "--quality-queue", "64",
+        "--quality-seed", str(args.seed), "--slo-windows", "5,60",
+    ]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        index = os.path.join(tmp, "index")
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index, "--k", "5", "--ivf-cells", str(args.cells),
+             "--ivf-seed", "0"],
+            env=env, capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: {build.stderr}")
+        print(f"ivf-soak: {build.stdout.strip()}")
+
+        # -- phase 1a: exact-only reference throughput ---------------------
+        proc, base = boot(index, env, shadow_flags)
+        if base is None:
+            return fail(f"exact serve: no ready banner (rc={proc.poll()})",
+                        proc)
+        ok_e, rows_e, viol, wall_e = run_load(
+            base, test.features, args.clients, args.rows, args.window_s)
+        if viol:
+            return fail(f"exact serve violations: {viol[:3]}", proc)
+        if ok_e < 5:
+            return fail(f"exact serve answered only {ok_e} requests — too "
+                        f"few to trust the ratio", proc)
+        exact_qps = rows_e / wall_e
+        rc = shutdown(proc)
+        if rc != 0:
+            return fail(f"exact serve exited rc={rc}")
+        print(f"ivf-soak: exact-only: {ok_e} requests, "
+              f"{exact_qps:.0f} rows/s")
+
+        # -- phase 1b: ivf serving — speed AND shadow-scored recall --------
+        proc, base = boot(index, env, shadow_flags + [
+            "--ivf-probes", str(args.probes),
+            "--ivf-recall-floor", str(args.recall_floor)])
+        if base is None:
+            return fail(f"ivf serve: no ready banner (rc={proc.poll()})",
+                        proc)
+        ok_i, rows_i, viol, wall_i = run_load(
+            base, test.features, args.clients, args.rows, args.window_s)
+        if viol:
+            return fail(f"ivf serve violations: {viol[:3]}", proc)
+        ivf_qps = rows_i / wall_i
+        doc = wait_queue_drained(base)
+        sh = doc["shadow"]
+        ivf_rung = sh["rungs"].get("ivf")
+        if ivf_rung is None or sh["scored"] < 20:
+            return fail(f"too few ivf shadow scores to trust the verdict "
+                        f"(rungs={sorted(sh['rungs'])}, "
+                        f"scored={sh['scored']})", proc)
+        recall = ivf_rung["recall"]
+        if recall is None or recall < args.recall_floor:
+            return fail(f"ivf rung recall SLI {recall} under the "
+                        f"{args.recall_floor} floor at the healthy "
+                        f"operating point (nprobe {args.probes})", proc)
+        speedup = ivf_qps / exact_qps
+        st, body = http(base, "/healthz")
+        ivf_block = json.loads(body).get("ivf") or {}
+        rc = shutdown(proc)
+        if rc != 0:
+            return fail(f"ivf serve exited rc={rc}")
+        if speedup < args.min_speedup:
+            return fail(f"ivf rung {ivf_qps:.0f} rows/s is only "
+                        f"{speedup:.2f}x the exact rung's "
+                        f"{exact_qps:.0f} — under the {args.min_speedup}x "
+                        f"bar")
+        print(f"ivf-soak: phase 1 ok — ivf {ivf_qps:.0f} rows/s = "
+              f"{speedup:.2f}x exact {exact_qps:.0f}, recall SLI "
+              f"{recall} >= {args.recall_floor} ({sh['scored']} scored, "
+              f"{sh['shed']} shed, nprobe {ivf_block.get('nprobe')})")
+
+        # -- phase 2: starve probes; burn must rise, policy must recover ---
+        env2 = dict(env,
+                    KNN_TPU_PROBE_COOLDOWN_MS="800",
+                    KNN_TPU_PROBE_EVAL_MS="100")
+        proc, base = boot(index, env2, shadow_flags + [
+            "--ivf-probes", "1",
+            "--ivf-recall-floor", str(args.recall_floor)])
+        if base is None:
+            return fail(f"phase-2 serve: no ready banner "
+                        f"(rc={proc.poll()})", proc)
+        stop = threading.Event()
+        lock = threading.Lock()
+        viol2: list = []
+
+        def bg_loop(cid):
+            i = cid * 13
+            q = test.features.shape[0]
+            while not stop.is_set():
+                lo = (7 * i) % max(1, q - args.rows)
+                i += 1
+                try:
+                    st, body = http(base, "/predict", {
+                        "instances":
+                            test.features[lo:lo + args.rows].tolist()})
+                    if st == 500:
+                        with lock:
+                            viol2.append(f"500: {body[:120]}")
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        viol2.append(f"transport: {e}")
+
+        clients = [threading.Thread(target=bg_loop, args=(c,), daemon=True)
+                   for c in range(args.clients)]
+        for t in clients:
+            t.start()
+        burn_peak = 0.0
+        burned = widened = recovered = False
+        nprobe_seen = 1
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            doc = quality_doc(base)
+            burns = doc["slo_quality"]["burn_rates"]
+            short = burns.get("5s", max(burns.values(), default=0.0))
+            burn_peak = max(burn_peak, max(burns.values(), default=0.0))
+            if burn_peak > 1.0:
+                burned = True
+            _, hb = http(base, "/healthz")
+            ivf_block = json.loads(hb).get("ivf") or {}
+            nprobe_seen = max(nprobe_seen, ivf_block.get("nprobe", 1))
+            if burned and nprobe_seen > 1:
+                widened = True
+            if widened and short <= 1.0:
+                recovered = True
+                break
+            time.sleep(0.25)
+        stop.set()
+        for t in clients:
+            t.join(timeout=90)
+        if viol2:
+            return fail(f"phase-2 serving violations: {viol2[:3]}", proc)
+        if not burned:
+            return fail(f"quality burn never rose above 1 with nprobe "
+                        f"starved to 1 (peak {burn_peak:.2f}) — the "
+                        f"recall violation went undetected", proc)
+        if not widened:
+            return fail(f"probe policy never widened nprobe past 1 "
+                        f"(burn peak {burn_peak:.2f}) — the quality loop "
+                        f"is open", proc)
+        if not recovered:
+            return fail(f"short-window quality burn did not recover "
+                        f"<= 1.0 after widening to nprobe "
+                        f"{nprobe_seen}", proc)
+        moves = (ivf_block.get("moves") or {})
+        print(f"ivf-soak: phase 2 ok — burn peaked {burn_peak:.1f}, "
+              f"policy widened 1 -> {nprobe_seen} "
+              f"({moves.get('widen', '?')} widen moves), short-window "
+              f"burn recovered <= 1")
+        rc = shutdown(proc)
+        if rc != 0:
+            return fail(f"phase-2 serve exited rc={rc}")
+
+        report = {
+            "ivf_soak": {
+                "train_rows": train.num_instances,
+                "cells": args.cells,
+                "probes": args.probes,
+                "recall_floor": args.recall_floor,
+                "rows_per_request": args.rows,
+                "clients": args.clients,
+                "window_s": args.window_s,
+            },
+            "phase1": {
+                "exact_rows_per_s": round(exact_qps, 1),
+                "ivf_rows_per_s": round(ivf_qps, 1),
+                "speedup": round(speedup, 2),
+                "min_speedup": args.min_speedup,
+                "recall_sli": recall,
+                "scored": sh["scored"],
+                "shed": sh["shed"],
+            },
+            "phase2": {
+                "burn_peak": round(burn_peak, 2),
+                "widened_to_nprobe": nprobe_seen,
+                "recovered": True,
+            },
+        }
+        out = json.dumps(report, indent=2)
+        print(out)
+        if args.json_out:
+            Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+            Path(args.json_out).write_text(out + "\n")
+        print("ivf-soak: PASS")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,691 @@
+"""The IVF index family (knn_tpu/index/, docs/INDEXES.md): k-means
+partition build, the shared (distance, index) tie-order contract pinned
+across every rung, probed search with the bit-identity and
+never-return-short guarantees, degenerate partitions through
+save/load/serve, the burn-aware probe policy, and the serving ladder's
+ivf rung."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.backends.oracle import oracle_kneighbors
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.index.ivf import IVFIndex, IVFServing
+from knn_tpu.index.kmeans import kmeans
+from knn_tpu.index.probe_policy import ProbePolicy
+from knn_tpu.models.knn import KNNClassifier, _kneighbors_arrays
+from knn_tpu.models.ordering import lexicographic_topk
+from knn_tpu.resilience.errors import DataError
+from knn_tpu.serve import artifact
+from knn_tpu.serve.batcher import MicroBatcher
+from knn_tpu.serve.server import ServeApp
+
+
+def _data(rng, n=1200, q=60, d=7):
+    """Clustered rows with duplicated blocks (dist==0 ties) and a few
+    exact-hit queries — the adversarial tie surface."""
+    centers = rng.normal(0, 5, (8, d))
+    x = (centers[rng.integers(0, 8, n)]
+         + rng.normal(0, 1.0, (n, d))).astype(np.float32)
+    dup = min(50, n // 3)  # duplicate rows: dist==0 and tie-order pressure
+    x[dup:2 * dup] = x[:dup]
+    qx = (centers[rng.integers(0, 8, q)]
+          + rng.normal(0, 1.0, (q, d))).astype(np.float32)
+    hits = min(10, q)
+    qx[:hits] = x[rng.integers(0, n, hits)]  # exact hits
+    return x, qx
+
+
+class TestKMeans:
+    def test_deterministic_and_covering(self, rng):
+        x, _ = _data(rng)
+        c1, a1, info1 = kmeans(x, 16, seed=3)
+        c2, a2, info2 = kmeans(x, 16, seed=3)
+        assert np.array_equal(c1, c2) and np.array_equal(a1, a2)
+        assert info1 == info2
+        assert a1.min() >= 0 and a1.max() < 16
+        assert c1.shape == (16, x.shape[1]) and c1.dtype == np.float32
+
+    def test_seed_changes_partition(self, rng):
+        x, _ = _data(rng)
+        _, a1, _ = kmeans(x, 16, seed=0)
+        _, a2, _ = kmeans(x, 16, seed=99)
+        assert not np.array_equal(a1, a2)
+
+    def test_duplicate_heavy_data_allows_empty_cells(self):
+        # 3 distinct points, 8 cells: the empty-cell repair saturates and
+        # the residual cells stay (validly) empty.
+        x = np.repeat(np.arange(3, dtype=np.float32)[:, None], 10,
+                      axis=0).repeat(2, axis=1)
+        cents, assign, info = kmeans(x, 8, seed=0)
+        assert info["empty_cells"] >= 5
+        assert np.bincount(assign, minlength=8).sum() == x.shape[0]
+
+    def test_validation(self, rng):
+        x, _ = _data(rng, n=50)
+        with pytest.raises(ValueError):
+            kmeans(x, 0)
+        with pytest.raises(ValueError):
+            kmeans(x, 51)
+        with pytest.raises(ValueError):
+            kmeans(x, 4, iters=0)
+
+
+class TestTieOrderHelper:
+    def test_matches_naive_lexsort_on_heavy_ties(self, rng):
+        d = rng.integers(0, 3, (40, 120)).astype(np.float32)
+        idx = rng.permutation(120)
+        got_d, got_i = lexicographic_topk(d, idx, 9)
+        for row in range(40):
+            order = np.lexsort((idx, d[row]))[:9]
+            assert np.array_equal(got_i[row], idx[order])
+            assert np.array_equal(got_d[row], d[row][order])
+
+    def test_packed_equals_fallback(self, rng):
+        # float32 rides the packed-key path; float64 the lexsort loop —
+        # the two realizations of the ONE contract must agree exactly.
+        d = rng.integers(0, 4, (30, 200)).astype(np.float32)
+        d[:, ::7] = np.inf  # inf ties too
+        idx = np.arange(200)
+        pd, pi = lexicographic_topk(d, idx, 11)
+        fd, fi = lexicographic_topk(d.astype(np.float64), idx, 11)
+        assert np.array_equal(pi, fi)
+        assert np.array_equal(pd, fd.astype(np.float32))
+
+    def test_per_row_indices_and_validation(self, rng):
+        d = rng.random((4, 10)).astype(np.float32)
+        idx = np.tile(np.arange(10), (4, 1))
+        a = lexicographic_topk(d, idx, 3)
+        b = lexicographic_topk(d, np.arange(10), 3)
+        assert np.array_equal(a[1], b[1])
+        with pytest.raises(ValueError):
+            lexicographic_topk(d, np.arange(9), 3)
+        with pytest.raises(ValueError):
+            lexicographic_topk(d[0], np.arange(10), 3)
+
+
+class TestTieOrderEveryRung:
+    """The satellite pin: EVERY rung — oracle, xla, auto(fast), and the
+    ivf path at full probe — reproduces the shared helper's order on
+    tie-heavy data."""
+
+    def test_all_rungs_match_helper(self, rng):
+        x, qx = _data(rng, n=400, q=25)
+        k = 7
+        from knn_tpu.backends.oracle import _metric_dists
+
+        full = _metric_dists(qx, x, "euclidean")
+        np.nan_to_num(full, copy=False, nan=np.inf)
+        want_d, want_i = lexicographic_topk(full, np.arange(x.shape[0]), k)
+
+        got_d, got_i = oracle_kneighbors(x, qx, k)
+        assert np.array_equal(got_i, want_i), "oracle diverged"
+        assert np.array_equal(got_d, want_d)
+
+        for engine in ("xla", "auto"):
+            got_d, got_i = _kneighbors_arrays(x, qx, k, engine=engine)
+            assert np.array_equal(np.asarray(got_i, np.int64), want_i), \
+                f"engine {engine} diverged from the tie-order contract"
+            np.testing.assert_allclose(got_d, want_d, rtol=1e-5)
+
+        ivf = IVFIndex.build(x, 8, seed=0)
+        got_d, got_i = ivf.search(x, qx, k, 8)[:2]
+        assert np.array_equal(got_i, want_i), "ivf full probe diverged"
+        assert np.array_equal(got_d, want_d)
+
+
+class TestIVFSearch:
+    def test_full_probe_bit_identical_to_exact(self, rng):
+        x, qx = _data(rng)
+        qx[5] = np.nan  # NaN query row follows the NaN -> +inf policy
+        ivf = IVFIndex.build(x, 24, seed=0)
+        od, oi = oracle_kneighbors(x, qx, 5)
+        d, i, st = ivf.search(x, qx, 5, 24)
+        assert np.array_equal(d, od) and np.array_equal(i, oi)
+        assert st.nprobe == 24 and st.candidate_rows == qx.shape[0] * 1200
+
+    def test_recall_monotone_to_one(self, rng):
+        x, qx = _data(rng)
+        ivf = IVFIndex.build(x, 24, seed=0)
+        od, oi = oracle_kneighbors(x, qx, 5)
+        from knn_tpu.obs.quality import recall_at_k
+
+        r1 = recall_at_k(ivf.search(x, qx, 5, 1)[1], oi,
+                         od.astype(np.float64),
+                         ivf.search(x, qx, 5, 1)[0].astype(
+                             np.float64)).mean()
+        r24 = recall_at_k(ivf.search(x, qx, 5, 24)[1], oi,
+                          od.astype(np.float64),
+                          ivf.search(x, qx, 5, 24)[0].astype(
+                              np.float64)).mean()
+        assert r24 == 1.0 and r1 <= r24
+
+    def test_k_over_probed_candidates_widens_never_short(self, rng):
+        # 32 cells over 64 rows: ~2 rows per cell; k=10 forces widening.
+        x, qx = _data(rng, n=64, q=8)
+        ivf = IVFIndex.build(x, 32, seed=1)
+        d, i, st = ivf.search(x, qx, 10, 1)
+        assert i.shape == (8, 10)
+        assert st.forced_widenings > 0 and st.nprobe > st.requested
+        assert (i < 64).all(), "a pad sentinel leaked into results"
+        # and the widened result is still tie-contract-correct
+        od, oi = oracle_kneighbors(x, qx, 10)
+        from knn_tpu.obs.quality import recall_at_k
+
+        r = recall_at_k(i, oi, od.astype(np.float64),
+                        d.astype(np.float64)).mean()
+        assert r > 0.5
+
+    def test_empty_cells_serve(self):
+        # Duplicate-heavy data leaves cells empty; search must still
+        # answer exactly at full probe and never return short.
+        x = np.repeat(np.arange(4, dtype=np.float32)[:, None], 8,
+                      axis=0).repeat(3, axis=1)
+        ivf = IVFIndex.build(x, 16, seed=0)
+        assert int((ivf.cell_sizes == 0).sum()) > 0
+        qx = x[:5] + 0.1
+        od, oi = oracle_kneighbors(x, qx, 6)
+        d, i, st = ivf.search(x, qx, 6, 16)
+        assert np.array_equal(d, od) and np.array_equal(i, oi)
+
+    def test_single_cell_is_exact(self, rng):
+        x, qx = _data(rng, n=300, q=20)
+        ivf = IVFIndex.build(x, 1, seed=0)
+        od, oi = oracle_kneighbors(x, qx, 5)
+        d, i, _ = ivf.search(x, qx, 5, 1)
+        assert np.array_equal(d, od) and np.array_equal(i, oi)
+
+    def test_k_clamps_to_n_and_nprobe_to_cells(self, rng):
+        x, qx = _data(rng, n=40, q=4)
+        ivf = IVFIndex.build(x, 4, seed=0)
+        d, i, st = ivf.search(x, qx, 100, 99)
+        assert i.shape == (4, 40) and st.nprobe == 4
+        od, oi = oracle_kneighbors(x, qx, 100)
+        assert np.array_equal(i, oi)
+
+    def test_row_count_mismatch_typed(self, rng):
+        x, qx = _data(rng, n=100, q=4)
+        ivf = IVFIndex.build(x, 4, seed=0)
+        with pytest.raises(DataError):
+            ivf.search(x[:50], qx, 3, 2)
+
+
+def _save_ivf_index(tmp_path, x, cells=8, k=3, name="idx"):
+    train = Dataset(x, np.zeros(x.shape[0], np.int32))
+    model = KNNClassifier(k=k).fit(train)
+    ivf = IVFIndex.build(x, cells, seed=0)
+    out = artifact.save_index(model, tmp_path / name, ivf=ivf)
+    return out, model, ivf
+
+
+class TestIVFArtifact:
+    def test_round_trip(self, rng, tmp_path):
+        x, qx = _data(rng, n=200, q=10)
+        out, model, ivf = _save_ivf_index(tmp_path, x)
+        manifest = artifact.read_manifest(out)
+        assert manifest["format"] == 3
+        assert manifest["ivf"]["num_cells"] == 8
+        assert manifest["ivf"]["seed"] == 0
+        loaded = artifact.load_index(out)
+        got = loaded.ivf_
+        assert np.array_equal(got.centroids, ivf.centroids)
+        assert np.array_equal(got.row_perm, ivf.row_perm)
+        assert np.array_equal(got.cell_offsets, ivf.cell_offsets)
+        # and re-saving a LOADED model keeps the partition (ivf rides
+        # model.ivf_ through save_index's default)
+        out2 = artifact.save_index(loaded, tmp_path / "resave")
+        assert artifact.read_manifest(out2)["ivf"]["num_cells"] == 8
+
+    def test_exact_only_artifact_has_no_partition(self, rng, tmp_path):
+        x, _ = _data(rng, n=200, q=10)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        out = artifact.save_index(model, tmp_path / "plain")
+        loaded = artifact.load_index(out)
+        assert getattr(loaded, "ivf_", None) is None
+
+    def test_format2_artifact_loads_and_serves_exact_only(
+            self, rng, tmp_path):
+        # A pre-IVF artifact: rewrite the manifest to format 2 (no ivf
+        # block). It must load, carry no partition, and serve exact.
+        x, qx = _data(rng, n=200, q=10)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        out = artifact.save_index(model, tmp_path / "fmt2")
+        mf = json.loads((out / "manifest.json").read_text())
+        assert "ivf" not in mf
+        mf["format"] = 2
+        (out / "manifest.json").write_text(json.dumps(mf))
+        loaded = artifact.load_index(out)
+        assert getattr(loaded, "ivf_", None) is None
+        app = ServeApp(loaded, max_batch=8, max_wait_ms=0.0)
+        try:
+            assert app.ivf is None and app.primary_rung == "fast"
+            h = app.batcher.submit(qx[:2], "kneighbors")
+            d, i = h.result(timeout=30)
+            od, oi = oracle_kneighbors(x, qx[:2], 3)
+            assert np.array_equal(i, oi)
+        finally:
+            app.close()
+
+    def test_ivf_probes_on_exact_only_artifact_typed(self, rng, tmp_path):
+        x, _ = _data(rng, n=200, q=10)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        with pytest.raises(DataError):
+            ServeApp(model, ivf_probes=4)
+
+    def test_corrupt_partition_typed_at_load(self, rng, tmp_path):
+        x, _ = _data(rng, n=200, q=10)
+        out, _, ivf = _save_ivf_index(tmp_path, x, name="corrupt")
+        arrays = dict(np.load(out / "arrays.npz", allow_pickle=False))
+        arrays["ivf_row_perm"] = np.zeros_like(arrays["ivf_row_perm"])
+        np.savez(out / "arrays.npz", **arrays)
+        with pytest.raises(DataError, match="permutation"):
+            artifact.load_index(out)
+
+    def test_manifest_arrays_cell_count_mismatch_typed(
+            self, rng, tmp_path):
+        x, _ = _data(rng, n=200, q=10)
+        out, _, _ = _save_ivf_index(tmp_path, x, name="mismatch")
+        mf = json.loads((out / "manifest.json").read_text())
+        mf["ivf"]["num_cells"] = 99
+        (out / "manifest.json").write_text(json.dumps(mf))
+        with pytest.raises(DataError, match="num_cells"):
+            artifact.load_index(out)
+
+    def test_stale_partition_rejected_at_save(self, rng, tmp_path):
+        x, _ = _data(rng, n=200, q=10)
+        other = rng.normal(0, 1, (50, 7)).astype(np.float32)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        with pytest.raises(ValueError, match="rebuild"):
+            artifact.save_index(model, tmp_path / "stale",
+                                ivf=IVFIndex.build(other, 4, seed=0))
+
+    def test_non_euclidean_partition_rejected_at_save(self, rng, tmp_path):
+        # The cells are squared-euclidean Voronoi regions; pairing them
+        # with any other metric would rank cells by the wrong geometry.
+        x, _ = _data(rng, n=150, q=5)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3, metric="manhattan").fit(train)
+        with pytest.raises(ValueError, match="euclidean-only"):
+            artifact.save_index(model, tmp_path / "manh",
+                                ivf=IVFIndex.build(x, 4, seed=0))
+
+    def test_hand_edited_metric_with_partition_typed_at_load(
+            self, rng, tmp_path):
+        # schema_hash covers attribute metadata, not the metric field —
+        # an edited manifest must fail typed at load, never serve
+        # wrong-geometry answers.
+        x, _ = _data(rng, n=150, q=5)
+        out, _, _ = _save_ivf_index(tmp_path, x, name="edited")
+        mf = json.loads((out / "manifest.json").read_text())
+        mf["metric"] = "manhattan"
+        (out / "manifest.json").write_text(json.dumps(mf))
+        with pytest.raises(DataError, match="euclidean-only"):
+            artifact.load_index(out)
+
+    def test_degenerate_partitions_round_trip_serve(self, rng, tmp_path):
+        # single-cell AND empty-cell partitions survive
+        # save -> load -> serve with full-probe exactness.
+        dup = np.repeat(np.arange(4, dtype=np.float32)[:, None], 8,
+                        axis=0).repeat(3, axis=1)
+        cases = {
+            "single": (_data(rng, n=150, q=6)[0], 1),
+            "empties": (dup, 16),
+        }
+        for name, (x, cells) in cases.items():
+            out, model, _ = _save_ivf_index(tmp_path, x, cells=cells,
+                                            name=name)
+            loaded = artifact.load_index(out)
+            app = ServeApp(loaded, max_batch=8, max_wait_ms=0.0,
+                           ivf_probes=cells)
+            try:
+                qx = x[:4] + 0.05
+                h = app.batcher.submit(qx, "kneighbors")
+                d, i = h.result(timeout=30)
+                assert h.meta["rung"] == "ivf"
+                od, oi = oracle_kneighbors(x, qx, 3)
+                assert np.array_equal(d, od) and np.array_equal(i, oi)
+            finally:
+                app.close()
+
+
+class _FakeSLO:
+    """Programmable quality-burn source for policy tests."""
+
+    def __init__(self, burn=0.0):
+        self.burn = burn
+        self.windows_s = (5, 60)
+
+    def burn_rates(self):
+        return {"quality": {"5s": self.burn, "1m": self.burn / 2}}
+
+
+class TestProbePolicy:
+    def _policy(self, slo, **kw):
+        kw.setdefault("cooldown_ms", 0.0)
+        kw.setdefault("eval_ms", 0.0)
+        return ProbePolicy(4, 32, slo=slo, **kw)
+
+    def test_static_without_signal(self):
+        p = ProbePolicy(4, 32, slo=None)
+        assert p.current() == 4
+
+    def test_widens_under_burn_doubling_to_ceiling(self):
+        slo = _FakeSLO(burn=5.0)
+        p = self._policy(slo)
+        seen = [p.current() for _ in range(5)]
+        assert seen == [8, 16, 32, 32, 32]
+
+    def test_narrows_back_to_base_when_healthy(self):
+        slo = _FakeSLO(burn=5.0)
+        p = self._policy(slo)
+        for _ in range(4):
+            p.current()
+        slo.burn = 0.0
+        seen = [p.current() for _ in range(5)]
+        assert seen == [16, 8, 4, 4, 4]
+        assert p.moves == {"widen": 3, "narrow": 3}
+
+    def test_hysteresis_band_holds(self):
+        # Between narrow_burn and widen_burn: no move in either direction.
+        slo = _FakeSLO(burn=0.6)
+        p = self._policy(slo)
+        assert [p.current() for _ in range(3)] == [4, 4, 4]
+
+    def test_cooldown_freezes_moves(self):
+        slo = _FakeSLO(burn=5.0)
+        p = ProbePolicy(4, 32, slo=slo, cooldown_ms=60000.0, eval_ms=0.0)
+        assert p.current() == 8  # first move
+        assert p.current() == 8  # frozen by cooldown
+        assert p.moves["widen"] == 1
+
+    def test_eval_interval_caches(self):
+        slo = _FakeSLO(burn=5.0)
+        p = ProbePolicy(4, 32, slo=slo, cooldown_ms=0.0, eval_ms=60000.0)
+        assert p.current() == 8
+        assert p.current() == 8  # cached, no re-eval
+
+    def test_broken_signal_reads_zero_not_crash(self):
+        class Broken:
+            windows_s = (5,)
+
+            def burn_rates(self):
+                raise RuntimeError("scrape exploded")
+
+        p = self._policy(Broken())
+        assert p.current() == 4
+
+    def test_reload_rebound(self):
+        p = self._policy(_FakeSLO(burn=5.0))
+        for _ in range(3):
+            p.current()
+        assert p.current() == 32
+        p.set_num_cells(8)
+        assert p.export()["nprobe"] == 8
+        assert p.export()["max_probes"] == 8
+
+    def test_reload_round_trip_restores_configured_base(self):
+        # small-index reload clamps base down; reloading the original
+        # index back must restore the operator's configured operating
+        # point — never a one-way ratchet.
+        p = ProbePolicy(8, 128, slo=None)
+        p.set_num_cells(4)
+        assert p.export()["base_probes"] == 4
+        assert p.current() == 4
+        p.set_num_cells(128)
+        assert p.export()["base_probes"] == 8
+        assert p.current() == 8
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ProbePolicy(0, 8)
+        with pytest.raises(ValueError):
+            ProbePolicy(9, 8)
+        with pytest.raises(ValueError):
+            ProbePolicy(2, 8, widen_burn=0.5, narrow_burn=1.0)
+
+
+class TestServingLadder:
+    def test_ivf_rung_answers_and_tags_meta(self, rng):
+        x, qx = _data(rng, n=400, q=8)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        setattr(model, "ivf_", IVFIndex.build(x, 8, seed=0))
+        serving = IVFServing(4, 8)
+        with MicroBatcher(model, max_batch=16, max_wait_ms=0.0,
+                          ivf=serving) as b:
+            h = b.submit(qx[:3], "kneighbors")
+            d, i = h.result(timeout=30)
+            assert h.meta["rung"] == "ivf"
+            want = model.ivf_.search(x, qx[:3], 3, 4)
+            assert np.array_equal(d, want[0])
+            assert np.array_equal(i, want[1])
+            # predict requests vote from the ivf candidates
+            h2 = b.submit(qx[:3], "predict")
+            preds = h2.result(timeout=30)
+            assert h2.meta["rung"] == "ivf"
+            assert preds.shape == (3,)
+
+    def test_ivf_failure_degrades_to_exact(self, rng, monkeypatch):
+        x, qx = _data(rng, n=400, q=8)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        setattr(model, "ivf_", IVFIndex.build(x, 8, seed=0))
+        serving = IVFServing(4, 8)
+        from knn_tpu.resilience.errors import DeviceError
+
+        def boom(model_, feats):
+            raise DeviceError("ivf rung down")
+
+        monkeypatch.setattr(serving, "kneighbors", boom)
+        with MicroBatcher(model, max_batch=16, max_wait_ms=0.0,
+                          ivf=serving) as b:
+            h = b.submit(qx[:3], "kneighbors")
+            d, i = h.result(timeout=30)
+            # fell to an EXACT rung: bit-identical to the oracle contract
+            od, oi = oracle_kneighbors(x, qx[:3], 3)
+            assert h.meta["rung"] != "ivf"
+            assert np.array_equal(np.asarray(i, np.int64), oi)
+
+    def test_ivf_data_error_degrades_to_exact(self, rng, monkeypatch):
+        # The ivf rung degrades on the WHOLE typed taxonomy, not just
+        # device errors: a DataError (index/model desync) trades
+        # approximation away for bit-exact retrieval, never a failed
+        # batch.
+        x, qx = _data(rng, n=400, q=8)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        setattr(model, "ivf_", IVFIndex.build(x, 8, seed=0))
+        serving = IVFServing(4, 8)
+
+        def boom(model_, feats):
+            raise DataError("index spans 0 rows but the train set has 400")
+
+        monkeypatch.setattr(serving, "kneighbors", boom)
+        with MicroBatcher(model, max_batch=16, max_wait_ms=0.0,
+                          ivf=serving) as b:
+            h = b.submit(qx[:3], "kneighbors")
+            d, i = h.result(timeout=30)
+            od, oi = oracle_kneighbors(x, qx[:3], 3)
+            assert h.meta["rung"] != "ivf"
+            assert np.array_equal(np.asarray(i, np.int64), oi)
+
+    def test_without_serving_wrapper_no_ivf_rung(self, rng):
+        x, qx = _data(rng, n=200, q=4)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        setattr(model, "ivf_", IVFIndex.build(x, 8, seed=0))
+        with MicroBatcher(model, max_batch=8, max_wait_ms=0.0) as b:
+            assert [n for n, _ in b._rungs(model)][0] == "fast"
+            h = b.submit(qx[:2], "kneighbors")
+            h.result(timeout=30)
+            assert h.meta["rung"] == "fast"
+
+
+class TestQualityFloor:
+    def test_approx_floor_gates_the_sli(self, rng):
+        from knn_tpu.obs.quality import ShadowScorer
+
+        x, qx = _data(rng, n=300, q=4)
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        model = KNNClassifier(k=4).fit(train)
+        verdicts = []
+
+        class SpySLO:
+            def record_quality(self, good):
+                verdicts.append(good)
+
+        scorer = ShadowScorer(1.0, seed=0, slo=SpySLO(),
+                              approx_floors={"ivf": 0.75},
+                              autostart=False)
+        ivf = IVFIndex.build(x, 8, seed=0)
+        od, oi = oracle_kneighbors(x, qx, 4)
+
+        def offer(rung, d, i):
+            assert scorer.offer(features=qx, kind="kneighbors", dists=d,
+                                idx=i, preds=None, rung=rung, model=model,
+                                version="v1")
+            scorer._sq.start()
+            assert scorer.drain(30)
+
+        # full probe: recall 1.0 >= floor -> good
+        d, i, _ = ivf.search(x, qx, 4, 8)
+        offer("ivf", d, i)
+        assert verdicts[-1] is True
+        # an artificially bad list (k wrong neighbors) on the ivf rung:
+        # mean recall under the floor -> bad, and on an exact rung any
+        # divergence at all -> bad
+        far = np.argsort(((x[None] - qx[:, None]) ** 2).sum(-1),
+                         axis=1)[:, -4:]
+        far_d = np.take_along_axis(
+            ((x[None] - qx[:, None]) ** 2).sum(-1), far, axis=1)
+        offer("ivf", far_d.astype(np.float32), far)
+        assert verdicts[-1] is False
+        summary = scorer.export()
+        assert summary["approx_floors"] == {"ivf": 0.75}
+        assert summary["rungs"]["ivf"]["divergence"].get("neighbors")
+
+    def test_floor_validation(self):
+        from knn_tpu.obs.quality import ShadowScorer
+
+        with pytest.raises(ValueError):
+            ShadowScorer(1.0, approx_floors={"ivf": 1.5}, autostart=False)
+
+
+class TestServeAppIVF:
+    def test_healthz_block_and_primary_rung(self, rng, tmp_path):
+        x, qx = _data(rng, n=300, q=6)
+        out, _, _ = _save_ivf_index(tmp_path, x, cells=8, name="hz")
+        model = artifact.load_index(out)
+        app = ServeApp(model, max_batch=8, max_wait_ms=0.0, ivf_probes=2,
+                       shadow_rate=1.0, quality_seed=0)
+        try:
+            assert app.primary_rung == "ivf"
+            assert app.quality.approx_floors == {"ivf": 0.95}
+            h = app.health()
+            assert h["ivf"]["num_cells"] == 8
+            assert h["ivf"]["nprobe"] == 2
+            assert h["ivf"]["recall_floor"] == 0.95
+            fut = app.batcher.submit(qx[:2], "predict")
+            fut.result(timeout=30)
+        finally:
+            app.close()
+
+    def test_probes_out_of_range_typed(self, rng, tmp_path):
+        x, _ = _data(rng, n=300, q=6)
+        out, _, _ = _save_ivf_index(tmp_path, x, cells=8, name="range")
+        model = artifact.load_index(out)
+        with pytest.raises(DataError, match="out of range"):
+            ServeApp(model, ivf_probes=9)
+
+    def test_reload_to_partitionless_artifact_rolls_back(
+            self, rng, tmp_path):
+        x, _ = _data(rng, n=300, q=6)
+        out, _, _ = _save_ivf_index(tmp_path, x, cells=8, name="a")
+        train = Dataset(x, np.zeros(x.shape[0], np.int32))
+        plain = artifact.save_index(
+            KNNClassifier(k=3).fit(train), tmp_path / "plain")
+        model = artifact.load_index(out)
+        app = ServeApp(model, max_batch=8, max_wait_ms=0.0, ivf_probes=4,
+                       index_path=str(out))
+        try:
+            app.warm((1,))
+            before = app.index_version
+            with pytest.raises(DataError, match="no IVF partition"):
+                app.reload(str(plain))
+            assert app.index_version == before  # old index still serving
+            h = app.batcher.submit(x[:2], "kneighbors")
+            h.result(timeout=30)
+            assert h.meta["rung"] == "ivf"
+        finally:
+            app.close()
+
+
+class TestCLI:
+    def test_save_index_ivf_flags(self, small_paths, tmp_path, capsys):
+        from knn_tpu import cli
+
+        train_arff, _ = small_paths
+        out = tmp_path / "idx"
+        rc = cli.run(["save-index", train_arff, str(out), "--k", "3",
+                      "--ivf-cells", "16"])
+        assert rc == 0
+        assert "ivf_cells=16" in capsys.readouterr().out
+        assert artifact.read_manifest(out)["ivf"]["num_cells"] == 16
+
+    def test_save_index_ivf_rejections(self, small_paths, tmp_path):
+        from knn_tpu import cli
+
+        train_arff, _ = small_paths
+        out = str(tmp_path / "idx")
+        assert cli.run(["save-index", train_arff, out,
+                        "--ivf-cells", "0"]) == 2
+        assert cli.run(["save-index", train_arff, out, "--ivf-cells", "4",
+                        "--metric", "cosine"]) == 2
+        assert cli.run(["save-index", train_arff, out, "--ivf-cells", "4",
+                        "--ivf-iters", "0"]) == 2
+        assert cli.run(["save-index", train_arff, out,
+                        "--ivf-cells", "99999999"]) == 2
+
+    def test_serve_ivf_flag_rejections(self, small_paths, tmp_path):
+        from knn_tpu import cli
+
+        train_arff, _ = small_paths
+        idx = str(tmp_path / "idx")
+        assert cli.run(["save-index", train_arff, idx, "--k", "3"]) == 0
+        # bad values fail before any load
+        assert cli.run(["serve", idx, "--ivf-probes", "0"]) == 2
+        assert cli.run(["serve", idx, "--ivf-recall-floor", "1.5"]) == 2
+        # probes against an exact-only artifact: typed, exit 2, no serve
+        assert cli.run(["serve", idx, "--ivf-probes", "4"]) == 2
+
+
+class TestInstruments:
+    def test_ivf_rung_records_knn_ivf_metrics(self, rng, obs_on=None):
+        obs.enable()
+        obs.reset()
+        try:
+            x, qx = _data(rng, n=300, q=4)
+            train = Dataset(x, np.zeros(x.shape[0], np.int32))
+            model = KNNClassifier(k=3).fit(train)
+            setattr(model, "ivf_", IVFIndex.build(x, 8, seed=0))
+            serving = IVFServing(2, 8)
+            with MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                              ivf=serving) as b:
+                b.submit(qx[:2], "kneighbors").result(timeout=30)
+            names = {i.name for i in obs.registry().instruments()}
+            assert "knn_ivf_probes" in names
+            assert "knn_ivf_queries_total" in names
+            assert "knn_ivf_candidate_rows_total" in names
+            assert "knn_ivf_cell_imbalance" in names
+        finally:
+            obs.reset()
+            obs.disable()

@@ -344,7 +344,7 @@ class TestArtifact:
         train, _ = _problem(rng)
         out = save_index(KNNClassifier(k=5).fit(train), tmp_path / "m")
         manifest = json.loads((out / "manifest.json").read_text())
-        assert manifest["format"] == 2
+        assert manifest["format"] == 3
         assert manifest["family"] == "classifier"
         assert manifest["k"] == 5
         assert manifest["metric"] == "euclidean"
