@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak capacity-probe bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak capacity-probe bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -119,6 +119,22 @@ quality-soak:
 ivf-soak:
 	JAX_PLATFORMS=cpu python3 scripts/ivf_soak.py --short \
 		--json-out build/ivf-soak-verdict.json
+
+# The online-mutation gate (docs/INDEXES.md §Mutable tier): boot serve
+# --mutable on and assert the four mutable contracts — (1) under the
+# chaos fault burst, every read's indices are bit-identical to an oracle
+# replay of the acknowledged mutation history at that read's
+# mutation_seq (distances inside float32 ulp — the rung-form rule) and
+# write-to-visible freshness p99 stays bounded; (2) a compaction swap
+# under concurrent load is atomic (every response carries exactly the
+# old or the new index_version) and replay holds across the fold in
+# BOTH generations' positional spaces; (3) a fault-armed compaction
+# rolls back with the old generation serving and every write intact;
+# (4) a SIGKILL mid-compaction recovers with zero acknowledged writes
+# lost. The verdict JSON lands in build/ (CI uploads it).
+mutable-soak:
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/mutable_soak.py \
+		--short --json-out build/mutable-soak-verdict.json
 
 # The cost & capacity gate (docs/OBSERVABILITY.md §Cost & capacity): boot
 # serve with cost accounting on and assert (1) every 200's timeline

@@ -1346,20 +1346,15 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
     # (default: uninstrumented) path.
     from knn_tpu.obs.capacity import CapacityTracker
 
-    serve_trials = []
-    occ_trials, duty_trials, waste_trials = [], [], []
     reqs, conc = 15, 8
-    for _ in range(serving_trials):
+
+    def closed_loop_p50(batcher):
+        """One closed-loop c8 trial against ``batcher`` (closed on exit):
+        p50 of per-request walls, or None if every request failed. ONE
+        load shape for the plain and costed serving trials — the two p50s
+        must measure the same thing to be comparable."""
         lats = []
         lock = threading.Lock()
-        # Batching-efficiency telemetry rides the gate record as
-        # REPORT-ONLY metrics (absent from the committed baseline ->
-        # regress.compare_records lists them under new_metrics, never
-        # gates): occupancy/duty/waste at this fixed load are visibility,
-        # not a pass/fail surface yet.
-        capacity = CapacityTracker(64, window_s=120)
-        batcher = MicroBatcher(model, max_batch=64, max_wait_ms=2.0,
-                               capacity=capacity)
         try:
             batcher.predict(test.features[0], timeout=120)  # warm the path
 
@@ -1385,13 +1380,45 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
         finally:
             batcher.close()
         if lats:
-            serve_trials.append(round(float(np.percentile(lats, 50)), 3))
+            return round(float(np.percentile(lats, 50)), 3)
+        return None
+
+    serve_trials = []
+    occ_trials, duty_trials, waste_trials = [], [], []
+    for _ in range(serving_trials):
+        # Batching-efficiency telemetry rides the gate record as
+        # REPORT-ONLY metrics (absent from the committed baseline ->
+        # regress.compare_records lists them under new_metrics, never
+        # gates): occupancy/duty/waste at this fixed load are visibility,
+        # not a pass/fail surface yet.
+        capacity = CapacityTracker(64, window_s=120)
+        p50 = closed_loop_p50(MicroBatcher(model, max_batch=64,
+                                           max_wait_ms=2.0,
+                                           capacity=capacity))
+        if p50 is not None:
+            serve_trials.append(p50)
         cap_doc = capacity.export()
         occ_trials.append(cap_doc["occupancy_mean"])
         duty_trials.append(cap_doc["duty_cycle"])
         waste_trials.append(cap_doc["padded_row_waste_ratio"])
     log(f"gate serving c8 p50: {serve_trials} ms (occupancy {occ_trials}, "
         f"duty {duty_trials}, padded-row waste {waste_trials})")
+
+    # The costed serving p50 (PR 8's c8_cost_p50_ms, gate-shaped): the
+    # same closed-loop load with the accounting + capacity layers
+    # attached, one p50 per trial — so a cost-attribution overhead
+    # regression gates once a baseline entry carries it.
+    from knn_tpu.obs.accounting import CostAccountant
+
+    cost_trials = []
+    for _ in range(serving_trials):
+        p50 = closed_loop_p50(MicroBatcher(
+            model, max_batch=64, max_wait_ms=2.0,
+            accounting=CostAccountant(),
+            capacity=CapacityTracker(64, window_s=120)))
+        if p50 is not None:
+            cost_trials.append(p50)
+    log(f"gate serving c8 costed p50: {cost_trials} ms")
 
     d = Path(__file__).parent / "build" / "fixtures"
     ref = Path("/root/reference/datasets")
@@ -1456,9 +1483,11 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
                                    "direction": "lower", "unit": "ms"},
             "serve_c8_p50_ms": {"trials": serve_trials,
                                 "direction": "lower", "unit": "ms"},
-            # PR 8 batching-efficiency telemetry: report-only until a
-            # baseline entry carries them (new metrics never gate —
-            # obs/regress.py).
+            "serve_c8_cost_p50_ms": {"trials": cost_trials,
+                                     "direction": "lower", "unit": "ms"},
+            # PR 8 batching-efficiency telemetry: armed by the PR 10
+            # baseline refresh (present in BENCH_GATE_BASELINE.json ->
+            # regressions gate; obs/regress.py).
             "serve_c8_occupancy_mean": {"trials": occ_trials,
                                         "direction": "higher",
                                         "unit": "ratio"},

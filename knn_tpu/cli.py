@@ -195,6 +195,28 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    "shadow-scored ivf answer under this mean recall "
                    "burns the quality SLO (the signal the probe policy "
                    "acts on)")
+    p.add_argument("--mutable", choices=["on", "off"], default="off",
+                   help="online-mutable serving (docs/INDEXES.md "
+                   "§Mutable tier): POST /insert and /delete mutate a "
+                   "delta tier + tombstone set merged into every answer "
+                   "under the shared (distance, index) contract, with a "
+                   "write-ahead epoch log in the artifact directory and "
+                   "background compaction folding writes into fresh "
+                   "index generations (POST /admin/compact forces one). "
+                   "'off' (the default) constructs zero mutable "
+                   "machinery and keeps today's immutable behavior "
+                   "byte-identical")
+    p.add_argument("--delta-cap", type=int, default=4096,
+                   help="delta-tier row bound: inserts past this are "
+                   "refused HTTP 429 until compaction folds the tier "
+                   "(back-pressure, not data loss)")
+    p.add_argument("--compact-threshold", type=int, default=1024,
+                   help="pending mutations (delta rows + tombstones) "
+                   "that trigger a background compaction")
+    p.add_argument("--compact-interval-s", type=float, default=30.0,
+                   help="background compaction check interval; 0 "
+                   "disables the timer thread (threshold kicks and "
+                   "/admin/compact still compact)")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -629,6 +651,14 @@ def _run_serve(args, stdout) -> int:
         (not 0 < args.ivf_recall_floor <= 1,
          f"--ivf-recall-floor must be in (0, 1], got "
          f"{args.ivf_recall_floor}"),
+        (args.delta_cap < 1,
+         f"--delta-cap must be >= 1, got {args.delta_cap}"),
+        (args.compact_threshold < 1,
+         f"--compact-threshold must be >= 1, got "
+         f"{args.compact_threshold}"),
+        (args.compact_interval_s < 0,
+         f"--compact-interval-s must be >= 0, got "
+         f"{args.compact_interval_s}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -665,9 +695,19 @@ def _run_serve(args, stdout) -> int:
     from knn_tpu.serve import artifact
     from knn_tpu.serve.server import ServeApp, make_server, serve_forever
 
+    mutable_on = args.mutable == "on"
     try:
-        model = artifact.load_index(args.index)
-        manifest = artifact.read_manifest(args.index)
+        if mutable_on:
+            # The mutable tier owns the artifact's lifecycle: boot from
+            # the generation CURRENT.json points at (the most recent
+            # completed compaction), falling back to the root artifact
+            # for a never-compacted index; the engine replays any epoch
+            # records newer than that generation's fold point.
+            base_dir, current = artifact.resolve_mutable_base(args.index)
+        else:
+            base_dir, current = args.index, None
+        model = artifact.load_index(base_dir)
+        manifest = artifact.read_manifest(base_dir)
         version = artifact.index_version(manifest)
     except DataError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -699,6 +739,11 @@ def _run_serve(args, stdout) -> int:
             capacity_window_s=args.capacity_window_s,
             ivf_probes=args.ivf_probes,
             ivf_recall_floor=args.ivf_recall_floor,
+            mutable=mutable_on, delta_cap=args.delta_cap,
+            compact_threshold=args.compact_threshold,
+            compact_interval_s=args.compact_interval_s,
+            mutable_current=current,
+            mutable_base_dir=base_dir if mutable_on else None,
         )
     except OSError as e:  # an unwritable --access-log path
         print(f"error: --access-log {args.access_log}: {e}", file=sys.stderr)
@@ -729,11 +774,19 @@ def _run_serve(args, stdout) -> int:
     if app.ivf is not None:
         ivf_note = (f", ivf_probes={args.ivf_probes}/"
                     f"{model.ivf_.num_cells}")
+    mutable_note = ""
+    if app.mutable is not None:
+        m = app.mutable.export()
+        mutable_note = (f", mutable=on (gen={m['generation']}, "
+                        f"epoch={m['epoch']}, "
+                        f"replayed_delta={m['delta_slots']}, "
+                        f"delta_cap={args.delta_cap})")
     print(
         f"knn-tpu serve: ready on http://{host}:{port} "
         f"(family={app.family}, k={model.k}, "
         f"train_rows={model.train_.num_instances}, "
-        f"index_version={version}{ivf_note}, warmed={sorted(warmed)})",
+        f"index_version={version}{ivf_note}{mutable_note}, "
+        f"warmed={sorted(warmed)})",
         file=stdout, flush=True,
     )
     return serve_forever(server, drain_timeout_s=args.drain_timeout_s)

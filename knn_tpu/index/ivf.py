@@ -328,16 +328,22 @@ class IVFServing:
         with a different cell count."""
         self.policy.set_num_cells(num_cells)
 
-    def kneighbors(self, model, feats: np.ndarray):
+    def kneighbors(self, model, feats: np.ndarray, k: Optional[int] = None):
         """One ivf-rung dispatch for the micro-batcher: policy-chosen
         ``nprobe``, probed search, instruments. Returns ``(dists, idx)``
-        like every other rung closure."""
+        like every other rung closure. ``k`` overrides ``model.k`` for
+        the mutable tier's tombstone k-coverage widening
+        (``knn_tpu/mutable/state.py``) — the probed search takes k as a
+        plain host argument, so widening recompiles nothing and the
+        delta rows are searched exhaustively beside the probed cells by
+        the merge layer."""
         index = getattr(model, IVF_ATTR, None)
         if index is None:  # reload validation forbids this; stay typed
             raise DataError("serving model has no ivf partition")
         train = model.train_
         dists, idx, stats = index.search(
-            train.features, feats, model.k, self.policy.current())
+            train.features, feats, model.k if k is None else k,
+            self.policy.current())
         obs.gauge_set(
             "knn_ivf_probes", stats.nprobe,
             help="cells probed per query by the last ivf-rung dispatch "

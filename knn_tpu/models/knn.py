@@ -300,6 +300,41 @@ def _inverse_distance_weights(dists: np.ndarray):
     return w, degenerate
 
 
+def vote_from_labels(dists: np.ndarray, labels: np.ndarray,
+                     num_classes: int, weights: str) -> np.ndarray:
+    """Classifier vote from an EXPLICIT per-candidate label matrix
+    ``labels [Q, k]`` — the label-lookup-agnostic half of
+    :meth:`KNNClassifier.predict_from_candidates`. The serving mutable
+    tier (``knn_tpu/mutable/``) votes through this with labels gathered
+    from the base+delta id space, so a delta-row neighbor votes with its
+    OWN label instead of a clamped base row's; both callers share the one
+    first-max / inverse-distance contract (SURVEY.md §3.5)."""
+    if weights == "distance":
+        w, degenerate = _inverse_distance_weights(np.asarray(dists))
+        w = np.where(degenerate[:, None], 1.0, w)
+        scores = np.zeros((labels.shape[0], num_classes))
+        for c in range(num_classes):
+            scores[:, c] = np.where(labels == c, w, 0.0).sum(axis=1)
+        return np.argmax(scores, axis=1).astype(np.int32)
+    return _host_vote(labels, num_classes)
+
+
+def aggregate_targets(dists: np.ndarray, neigh: np.ndarray,
+                      weights: str) -> np.ndarray:
+    """Regression aggregation from an EXPLICIT neighbor-target matrix
+    ``neigh [Q, k]`` — the target-lookup-agnostic half of
+    :meth:`KNNRegressor.predict`, shared with the serving mutable tier
+    for the same reason as :func:`vote_from_labels`."""
+    if weights == "uniform":
+        return neigh.mean(axis=1).astype(np.float32)
+    w, degenerate = _inverse_distance_weights(dists)
+    w_sum = w.sum(axis=1)
+    weighted = (w * neigh).sum(axis=1) / np.where(degenerate, 1.0, w_sum)
+    # All-inf distances (e.g. NaN queries) zero every weight; fall back to
+    # the uniform mean rather than emitting 0/0.
+    return np.where(degenerate, neigh.mean(axis=1), weighted).astype(np.float32)
+
+
 def radius_neighbors_arrays(
     train_x: np.ndarray,
     test_x: np.ndarray,
@@ -462,13 +497,14 @@ class KNNClassifier:
         predictions to :meth:`predict` by the shared (distance, train-index,
         first-max vote) contracts (SURVEY.md §3.5)."""
         train = self.train_
+        labels = train.labels[np.minimum(idx, train.num_instances - 1)]
         if self.weights == "distance":
-            scores = self._weighted_class_scores(neighbors=(dists, idx))
             with obs.span("vote", weighted=True):
-                return np.argmax(scores, axis=1).astype(np.int32)
+                return vote_from_labels(dists, labels, train.num_classes,
+                                        "distance")
         with obs.span("vote"):
-            labels = train.labels[np.minimum(idx, train.num_instances - 1)]
-            return _host_vote(labels, train.num_classes)
+            return vote_from_labels(dists, labels, train.num_classes,
+                                    "uniform")
 
     def kneighbors(self, test: Dataset):
         """Per-query neighbor candidates: ``(dists [Q,k], indices [Q,k])``
@@ -661,14 +697,7 @@ class KNNRegressor:
         train = self.train_
         dists, idx = neighbors
         neigh = train.targets[np.minimum(idx, train.num_instances - 1)]
-        if self.weights == "uniform":
-            return neigh.mean(axis=1).astype(np.float32)
-        w, degenerate = _inverse_distance_weights(dists)
-        w_sum = w.sum(axis=1)
-        weighted = (w * neigh).sum(axis=1) / np.where(degenerate, 1.0, w_sum)
-        # All-inf distances (e.g. NaN queries) zero every weight; fall back to
-        # the uniform mean rather than emitting 0/0.
-        return np.where(degenerate, neigh.mean(axis=1), weighted).astype(np.float32)
+        return aggregate_targets(dists, neigh, self.weights)
 
     def score(self, test: Dataset, predictions: Optional[np.ndarray] = None) -> float:
         """Coefficient of determination R^2 against ``test.targets``."""

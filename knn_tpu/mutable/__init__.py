@@ -1,0 +1,20 @@
+"""Online mutable indexes: delta tier, tombstones, background compaction.
+
+The LSM-style split (docs/INDEXES.md §Mutable tier): an immutable base —
+every existing rung, cache, and compiled executable untouched — plus a
+small mutable tail merged into every answer under the shared
+(distance, index) contract, folded back into a fresh immutable
+generation by background compaction through the live swap path.
+
+- :mod:`knn_tpu.mutable.state`   — the per-dispatch immutable view and
+  the lexicographic base+delta+tombstone merge;
+- :mod:`knn_tpu.mutable.engine`  — write-ahead epoch log, mutation
+  application, boot replay, compaction seal/rebase;
+- :mod:`knn_tpu.mutable.compact` — the fold + the background compactor.
+
+Nothing here is imported unless a server boots with ``--mutable on``
+(the zero-cost-when-disabled contract,
+scripts/check_disabled_overhead.py).
+"""
+
+from knn_tpu.mutable.state import MutableView, MutationConflict  # noqa: F401

@@ -1,0 +1,352 @@
+"""Background compaction: fold delta + tombstones into a fresh generation.
+
+The LSM merge step (PAPERS.md: O'Neil et al. 1996; Fresh-DiskANN's
+StreamingMerge): a worker folds the sealed delta tier and tombstones into
+a brand-new immutable base — surviving base rows in their original order,
+then surviving delta rows in insert order (the DETERMINISTIC id
+assignment the soak's oracle replay reproduces) — re-runs IVF cell
+assignment when the serving index is partitioned, saves the result as an
+ordinary artifact generation (``serve/artifact.py``), warms it OFF the
+serving path, and swaps it through the existing
+``MicroBatcher.swap_model`` machinery with the engine rebase executed
+inside the same critical section.
+
+Failure semantics (the hot-reload rollback contract, extended):
+
+- any failure BEFORE the swap leaves the old generation serving and the
+  sealed epoch's records on disk — nothing acknowledged is lost, the
+  next attempt re-folds from scratch (``knn_mutable_compactions_total
+  {outcome="rolled_back"}``);
+- the COMMIT POINT is the atomic ``CURRENT.json`` replace: a process
+  killed anywhere before it boots from the old base and replays every
+  epoch record; killed after it boots from the new generation and
+  replays only the records past ``folded_seq``;
+- mid-compaction writes land in the fresh epoch the seal opened and are
+  re-anchored onto the new base by the rebase — zero acknowledged writes
+  lost (the mutable-soak kill test).
+
+A seeded fault point (``mutable.compact``) sits between warmup and swap
+so the chaos tooling can prove the rollback path without timing luck.
+"""
+
+from __future__ import annotations
+
+import shutil
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor
+from knn_tpu.resilience import faults
+from knn_tpu.serve import artifact
+
+
+class CompactionInProgress(Exception):
+    """One compaction at a time (the reload-lock rule); /admin/compact
+    maps this to HTTP 409."""
+
+
+class CompactionCommitFailed(Exception):
+    """A POST-SWAP step failed (the CURRENT.json commit): the new
+    generation IS serving (swap+rebase succeeded) but the on-disk pointer
+    still names the old one. NOT a rollback — and must never be reported
+    as one. State stays consistent either way: the sealed epoch's records
+    are still on disk, so a reboot loads the old base and replays the
+    full acknowledged history; the next successful compaction re-folds
+    and commits."""
+
+
+def fold(base_train: Dataset, fold_input: dict,
+         base_stable: np.ndarray) -> "tuple[Dataset, np.ndarray, dict]":
+    """Pure fold: ``(new_train, new_base_stable, stats)``.
+
+    Survivors keep their relative order — base rows first (ascending
+    position), then live delta rows in insert order — so the new
+    positional id space is a deterministic function of the acknowledged
+    mutation history, which is exactly what lets an oracle replay verify
+    post-compaction answers bit-for-bit."""
+    count = fold_input["count"]
+    tombs = fold_input["tomb_stable"]
+    tomb_arr = (np.fromiter(tombs, np.int64, len(tombs)) if tombs
+                else np.empty(0, np.int64))
+    base_stable = np.asarray(base_stable, np.int64)
+    base_keep = ~np.isin(base_stable, tomb_arr)
+    delta_stable = np.asarray(fold_input["stable"][:count], np.int64)
+    delta_keep = ~np.isin(delta_stable, tomb_arr)
+    feats = np.concatenate([
+        base_train.features[base_keep],
+        np.asarray(fold_input["features"][:count], np.float32)[delta_keep],
+    ])
+    delta_vals = np.asarray(fold_input["values"][:count],
+                            np.float32)[delta_keep]
+    labels = np.concatenate([
+        base_train.labels[base_keep],
+        delta_vals.astype(base_train.labels.dtype),
+    ])
+    raw_targets = None
+    if base_train.raw_targets is not None:
+        raw_targets = np.concatenate([
+            base_train.raw_targets[base_keep],
+            delta_vals.astype(base_train.raw_targets.dtype),
+        ])
+    elif not np.array_equal(
+            delta_vals.astype(base_train.labels.dtype).astype(np.float32),
+            delta_vals):
+        # Regression targets a sketch-less base stores as int labels
+        # (Dataset.targets falls back to labels): a fractional/negative
+        # acked target would silently truncate through the int cast and
+        # the same read would answer differently after compaction.
+        # Promote to raw_targets so the folded train set serves the
+        # exact values the delta tier did.
+        raw_targets = np.concatenate([
+            base_train.labels[base_keep].astype(np.float32), delta_vals])
+    new_train = Dataset(
+        features=feats, labels=labels, relation=base_train.relation,
+        attributes=list(base_train.attributes), raw_targets=raw_targets,
+    )
+    new_stable = np.concatenate([
+        np.asarray(base_stable, np.int64)[base_keep],
+        delta_stable[delta_keep],
+    ])
+    stats = {
+        "base_kept": int(base_keep.sum()),
+        "base_dropped": int((~base_keep).sum()),
+        "delta_folded": int(delta_keep.sum()),
+        "delta_dropped": int((~delta_keep).sum()),
+        "rows": int(new_stable.shape[0]),
+    }
+    return new_train, new_stable, stats
+
+
+def clone_fitted(model, train: Dataset):
+    """A fresh model with the serving model's hyperparameters, fitted on
+    the folded train set (compaction must not inherit device caches or
+    any state tied to the old base)."""
+    if isinstance(model, KNNClassifier):
+        fresh = KNNClassifier(
+            model.k, backend=model.backend_name, metric=model.metric,
+            weights=model.weights, **dict(model.backend_opts),
+        )
+    elif isinstance(model, KNNRegressor):
+        fresh = KNNRegressor(
+            model.k, weights=model.weights, metric=model.metric,
+            engine=model.engine,
+        )
+    else:
+        raise TypeError(f"cannot compact a {type(model).__name__}")
+    return fresh.fit(train)
+
+
+class Compactor:
+    """Owns the compaction lock, the optional interval thread, and the
+    swap callback into the serving app.
+
+    ``swap`` — ``swap(new_model, version, rebase_hook)``: must execute
+    ``rebase_hook()`` inside the batcher's model-swap critical section
+    (``ServeApp._mutable_swap`` does); ``warm`` — ``warm(new_model)``
+    compiles the serving batch shapes off the serving path.
+    """
+
+    def __init__(self, engine, *, swap, warm,
+                 threshold: int = 1024, interval_s: float = 30.0):
+        if threshold < 1:
+            raise ValueError(f"compact threshold must be >= 1, got "
+                             f"{threshold}")
+        if interval_s < 0:
+            raise ValueError(f"compact interval must be >= 0, got "
+                             f"{interval_s}")
+        self.engine = engine
+        self.threshold = int(threshold)
+        self.interval_s = float(interval_s)
+        self._swap = swap
+        self._warm = warm
+        self._lock = threading.Lock()
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.compactions = 0
+        engine.on_pressure(self._on_pressure)
+
+    # -- background thread -------------------------------------------------
+
+    def start(self) -> None:
+        """Start the interval worker (no thread at ``interval_s == 0`` —
+        then only /admin/compact and threshold kicks run, synchronously
+        and on demand; the zero-thread embedded mode)."""
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="knn-compactor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _on_pressure(self, pressure: int) -> None:
+        if pressure < self.threshold:
+            return
+        self._kick.set()
+        if self._thread is None and not self._stop.is_set():
+            # Zero-thread mode (interval_s == 0) has no interval worker to
+            # consume the kick — the CLI promise ("threshold kicks still
+            # compact") needs a one-shot worker. run_once's non-blocking
+            # lock dedupes concurrent kicks; compacting ON the mutation
+            # thread would stall reads for the whole fold.
+            threading.Thread(target=self._kick_once, name="knn-compactor",
+                             daemon=True).start()
+
+    def _kick_once(self) -> None:
+        try:
+            self.run_once()
+        except CompactionInProgress:
+            pass
+        except Exception as e:  # noqa: BLE001 — logged, old gen serving
+            print(f"warning: compaction failed ({type(e).__name__}: {e}); "
+                  f"the previous generation keeps serving", flush=True)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._kick.wait(self.interval_s)
+            if self._stop.is_set():
+                return
+            kicked = self._kick.is_set()
+            self._kick.clear()
+            if (self.engine.pressure() >= self.threshold
+                    or (kicked and self.engine.pressure() > 0)):
+                try:
+                    self.run_once()
+                except CompactionInProgress:
+                    pass
+                except Exception as e:  # noqa: BLE001 — logged + counted,
+                    # the old generation keeps serving; retried next tick.
+                    print(f"warning: compaction failed "
+                          f"({type(e).__name__}: {e}); the previous "
+                          f"generation keeps serving", flush=True)
+
+    # -- one compaction ----------------------------------------------------
+
+    def run_once(self, force: bool = False) -> dict:
+        """Fold → save generation → warm → swap+rebase → commit pointer.
+        Folds whatever exists — threshold gating is the CALLER's job
+        (``_run``/``_on_pressure``); ``force`` marks the /admin/compact
+        trigger. With nothing to fold it returns ``compacted: False``
+        without sealing. Raises :class:`CompactionInProgress` when
+        another compaction holds the lock."""
+        if not self._lock.acquire(blocking=False):
+            raise CompactionInProgress(
+                "a compaction is already in progress")
+        t0 = time.monotonic()
+        swapped = False
+        try:
+            eng = self.engine
+            if eng.pressure() == 0:
+                return {"compacted": False, "reason": "nothing to fold"}
+            old_model = eng._model
+            base_train = old_model.train_
+            base_stable = eng._base_stable
+            with obs.span("mutable.compact",
+                          pressure=eng.pressure()):
+                fold_input = eng.seal()
+                new_train, new_stable, stats = fold(
+                    base_train, fold_input, base_stable)
+                new_model = clone_fitted(old_model, new_train)
+                new_ivf = None
+                old_ivf = getattr(old_model, "ivf_", None)
+                if old_ivf is not None:
+                    # Re-run cell assignment: the partition is a function
+                    # of the row set, so folded rows get fresh cells
+                    # (same seed — deterministic artifacts).
+                    from knn_tpu.index.ivf import IVF_ATTR, IVFIndex
+
+                    new_ivf = IVFIndex.build(
+                        new_train.features,
+                        min(old_ivf.num_cells, new_train.num_instances),
+                        seed=int(old_ivf.meta.get("seed", 0)),
+                    )
+                    setattr(new_model, IVF_ATTR, new_ivf)
+                generation = fold_input["generation"] + 1
+                gen_dir = artifact.generation_path(eng.root, generation)
+                artifact.save_index(
+                    new_model, gen_dir, ivf=new_ivf,
+                    mutable_block=eng.base_manifest_block(
+                        fold_input, new_stable),
+                )
+                version = artifact.index_version(
+                    artifact.read_manifest(gen_dir))
+                self._warm(new_model)
+                # Seeded fault point for the rollback/crash legs of the
+                # mutable soak: everything is built and warmed, nothing
+                # swapped yet.
+                faults.fault_point("mutable.compact")
+                previous = self._swap(
+                    new_model, version,
+                    lambda: eng.rebase(fold_input, new_model, new_stable,
+                                       generation, version=version),
+                )
+                swapped = True
+                # COMMIT: after this atomic replace, boots load the new
+                # generation and replay only records past folded_seq.
+                artifact.write_current(eng.root, {
+                    "generation": generation,
+                    "base": str(gen_dir.relative_to(eng.root)),
+                    "folded_seq": int(fold_input["seq"]),
+                    "next_stable": int(eng._next_stable),
+                    "active_epoch": int(eng._epoch),
+                })
+                self._cleanup(fold_input, generation)
+            wall_ms = (time.monotonic() - t0) * 1e3
+            self.compactions += 1
+            detail = {
+                "generation": generation, "index_version": version,
+                "previous_version": previous, **stats,
+            }
+            eng.note_compaction("ok", wall_ms, detail)
+            return {"compacted": True, "ms": round(wall_ms, 3), **detail}
+        except CompactionInProgress:
+            raise
+        except Exception as e:
+            if swapped:
+                # The new generation is already serving — saying
+                # "rolled_back" here would tell the operator the exact
+                # opposite of the truth (e.g. CURRENT.json commit hit a
+                # full disk). Reboot-safety holds regardless: the sealed
+                # epoch is still on disk, so the old pointer + full
+                # replay reconstruct every acknowledged write.
+                self.engine.note_compaction(
+                    "commit_failed", (time.monotonic() - t0) * 1e3)
+                raise CompactionCommitFailed(
+                    f"compaction swapped generation in but the pointer "
+                    f"commit failed ({type(e).__name__}: {e}); the new "
+                    f"generation is serving, a reboot replays onto the "
+                    f"old one, and the next compaction re-commits"
+                ) from e
+            self.engine.note_compaction(
+                "rolled_back", (time.monotonic() - t0) * 1e3)
+            raise
+        finally:
+            self._lock.release()
+
+    def _cleanup(self, fold_input: dict, generation: int) -> None:
+        """Best-effort removal of folded epoch files and superseded
+        generation directories — AFTER the pointer committed, so a crash
+        during cleanup only leaves redundant (skipped-on-replay) files."""
+        for n, path in artifact.list_epochs(self.engine.root):
+            if n <= fold_input["sealed_epoch"]:
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+        gen_root = self.engine.root / artifact.GENERATIONS_DIR
+        if gen_root.is_dir():
+            keep = artifact.generation_path(self.engine.root,
+                                            generation).name
+            for p in gen_root.iterdir():
+                if p.is_dir() and p.name != keep:
+                    shutil.rmtree(p, ignore_errors=True)

@@ -126,14 +126,16 @@ def true_distances(train_x: np.ndarray, queries: np.ndarray,
 
 class _Sample:
     """One sampled served request, queued for background scoring. Carries
-    the batch's own (model, version) snapshot so scoring is correct
-    across hot reloads."""
+    the batch's own (model, version) snapshot — and, under mutable
+    serving, the batch's own immutable
+    :class:`~knn_tpu.mutable.state.MutableView` — so scoring is correct
+    across hot reloads AND compaction swaps."""
 
     __slots__ = ("features", "kind", "dists", "idx", "preds", "rung",
-                 "model", "version", "t_ns")
+                 "model", "version", "mview", "t_ns")
 
     def __init__(self, features, kind, dists, idx, preds, rung, model,
-                 version):
+                 version, mview=None):
         self.features = features
         self.kind = kind
         self.dists = dists
@@ -142,6 +144,7 @@ class _Sample:
         self.rung = rung
         self.model = model
         self.version = version
+        self.mview = mview
         self.t_ns = time.monotonic_ns()
 
 
@@ -231,17 +234,21 @@ class ShadowScorer:
     # -- producer side (the batcher worker thread) -------------------------
 
     def offer(self, *, features, kind: str, dists, idx, preds, rung: str,
-              model, version) -> bool:
+              model, version, mview=None) -> bool:
         """Sample one served request. O(1) — one RNG draw, one append —
         and NEVER blocks: a full queue sheds the sample and serving
         proceeds (the :class:`~knn_tpu.obs.shedqueue.ShedQueue`
         contract). ``dists``/``idx`` are the request's served slices;
-        ``preds`` the served predictions (None for kneighbors requests).
-        Returns whether the sample was queued."""
+        ``preds`` the served predictions (None for kneighbors requests);
+        ``mview`` the batch's mutable view snapshot (None for immutable
+        serving) — the scorer then re-answers against the LIVE
+        base+delta+tombstone truth, so a server silently ignoring fresh
+        writes (staleness) burns the quality SLI like any other wrong
+        answer. Returns whether the sample was queued."""
         self.offered += 1
         return self._sq.offer(
             lambda: _Sample(features, kind, dists, idx, preds, rung,
-                            model, version)
+                            model, version, mview)
         )
 
     # -- worker side -------------------------------------------------------
@@ -263,13 +270,29 @@ class ShadowScorer:
 
         model = s.model
         train = model.train_
+        merged = s.mview is not None and not s.mview.empty
         with obs.span("quality.shadow_score", rung=s.rung, kind=s.kind,
                       rows=int(np.shape(s.features)[0])):
-            oracle_d, oracle_i = oracle_kneighbors(
-                train.features, s.features, model.k, model.metric)
-            true_d = true_distances(train.features, s.features, s.idx,
-                                    model.metric)
-            recalls = recall_at_k(s.idx, oracle_i, oracle_d, true_d)
+            if merged:
+                # Mutable serving: the truth is the LIVE view — oracle
+                # base retrieval folded with this batch's own delta and
+                # tombstone snapshot. A served answer that ignored fresh
+                # writes (or resurrected a deleted row) diverges here.
+                from knn_tpu.mutable.state import (
+                    merged_oracle_kneighbors, view_true_distances,
+                )
+
+                oracle_d, oracle_i = merged_oracle_kneighbors(
+                    model, s.mview, s.features)
+                true_d = view_true_distances(model, s.mview, s.features,
+                                             s.idx, model.metric)
+            else:
+                oracle_d, oracle_i = oracle_kneighbors(
+                    train.features, s.features, model.k, model.metric)
+                true_d = true_distances(train.features, s.features, s.idx,
+                                        model.metric)
+            recalls = recall_at_k(s.idx, oracle_i,
+                                  oracle_d.astype(np.float64), true_d)
             # Distance divergence: the served DISTANCE disagrees with the
             # recomputed distance of the served index — corrupted distance
             # values, a failure mode selection recall cannot see.
@@ -283,8 +306,18 @@ class ShadowScorer:
             dist_rows = int(np.count_nonzero(mismatch.any(axis=1)))
             vote_rows = vote_ok = 0
             if s.kind == "predict" and isinstance(model, KNNClassifier):
-                want_preds = model.predict_from_candidates(
-                    oracle_d.astype(np.float32), oracle_i)
+                if merged:
+                    # The oracle's candidates span base+delta ids: vote
+                    # through the view-aware label gather, the same
+                    # helper the serving path votes with.
+                    from knn_tpu.mutable.state import predict_from_view
+
+                    want_preds = predict_from_view(
+                        model, s.mview, oracle_d.astype(np.float32),
+                        oracle_i)
+                else:
+                    want_preds = model.predict_from_candidates(
+                        oracle_d.astype(np.float32), oracle_i)
                 got = np.asarray(s.preds)
                 vote_rows = int(got.shape[0])
                 vote_ok = int(np.count_nonzero(got == want_preds))

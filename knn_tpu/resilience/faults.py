@@ -78,6 +78,11 @@ FAULT_POINTS: Dict[str, str] = {
     "multihost.init": "worker",
     "native.load": "io",
     "serve.dispatch": "device",
+    # Between a compaction's warmup and its swap (knn_tpu/mutable/
+    # compact.py): the mutable soak's rollback leg proves a failed
+    # compaction leaves the old generation serving with zero
+    # acknowledged writes lost.
+    "mutable.compact": "device",
 }
 
 _KINDS = ("data", "compile", "device", "collective", "worker", "io", "oom")

@@ -60,6 +60,21 @@ ARTIFACT_FORMAT = 3
 MANIFEST_NAME = "manifest.json"
 ARRAYS_NAME = "arrays.npz"
 
+#: Delta-epoch persistence (the mutable tier, knn_tpu/mutable/): an
+#: artifact directory serving with ``--mutable on`` grows a write-ahead
+#: epoch log (``epochs/epoch-<N>.jsonl`` — one JSON record per
+#: acknowledged mutation, flushed before the ack), compacted generations
+#: (``generations/gen-<N>/`` — ordinary format-3 artifacts carrying an
+#: additive ``mutable`` manifest block + a ``mutable_stable_ids`` array),
+#: and an atomically-replaced ``CURRENT.json`` pointer naming the base
+#: generation and the sequence number folded into it. None of this bumps
+#: ARTIFACT_FORMAT: the extras are additive, so every format-1..3 loader
+#: (including older builds) still reads a compacted generation as a plain
+#: exact/IVF artifact, and a never-mutated artifact has none of them.
+EPOCHS_DIR = "epochs"
+GENERATIONS_DIR = "generations"
+CURRENT_NAME = "CURRENT.json"
+
 
 def schema_hash(ds: Dataset) -> str:
     """Digest over the dataset's SCHEMA — attribute metadata plus array
@@ -112,7 +127,7 @@ def _model_manifest(model) -> dict:
     )
 
 
-def save_index(model, path, ivf=None) -> Path:
+def save_index(model, path, ivf=None, mutable_block=None) -> Path:
     """Write a fitted model to ``path`` (a directory; created if missing).
 
     ``ivf`` — an optional :class:`~knn_tpu.index.ivf.IVFIndex` to persist
@@ -120,6 +135,12 @@ def save_index(model, path, ivf=None) -> Path:
     a partition already attached to the model (``model.ivf_`` — the
     load/re-save round trip) is kept. The partition must span exactly the
     train rows being saved.
+
+    ``mutable_block`` — the compactor's generation metadata (a dict with
+    ``stable_ids`` — int64 per train row — plus JSON fields like
+    ``folded_seq``/``next_stable``/``generation``): persisted as an
+    ADDITIVE ``mutable`` manifest entry and a ``mutable_stable_ids``
+    array, ignored by plain loads (no format bump; see EPOCHS_DIR).
 
     Refuses to clobber a non-empty directory that is not already an
     artifact (no ``manifest.json``) — re-saving over an existing artifact
@@ -164,6 +185,16 @@ def save_index(model, path, ivf=None) -> Path:
     if ivf is not None:
         arrays.update(ivf.to_arrays())
         manifest["ivf"] = ivf.manifest_entry()
+    if mutable_block is not None:
+        block = dict(mutable_block)
+        stable = np.asarray(block.pop("stable_ids"), np.int64)
+        if stable.shape != (train.num_instances,):
+            raise ValueError(
+                f"mutable stable_ids must be one int64 per train row "
+                f"({train.num_instances}), got shape {stable.shape}"
+            )
+        arrays["mutable_stable_ids"] = stable
+        manifest["mutable"] = block
     np.savez(out / ARRAYS_NAME, **arrays)
     # The reference (training) distribution sketch for query-drift
     # detection (obs/drift.py): one exact numpy pass at build time — the
@@ -345,6 +376,180 @@ def load_index(path):
             num_features=train.num_features, where=str(root),
         ))
     return model
+
+
+# -- delta-epoch persistence (the mutable tier) -----------------------------
+
+
+def epoch_path(root, epoch: int) -> Path:
+    return Path(root) / EPOCHS_DIR / f"epoch-{epoch:08d}.jsonl"
+
+
+def generation_path(root, generation: int) -> Path:
+    return Path(root) / GENERATIONS_DIR / f"gen-{generation:06d}"
+
+
+def list_epochs(root) -> "list[tuple[int, Path]]":
+    """Epoch-log files under ``root``, sorted by epoch number. Files that
+    do not match the naming scheme are a typed refusal — something else
+    wrote into the artifact's epochs directory."""
+    d = Path(root) / EPOCHS_DIR
+    if not d.is_dir():
+        return []
+    out = []
+    for p in sorted(d.iterdir()):
+        if p.name.endswith(".jsonl.tmp"):
+            # A crash inside repair_epoch's write-then-replace window
+            # leaves its temp file behind; the original epoch is intact
+            # (the replace never happened), so the leftover is garbage —
+            # refusing to boot over it would brick the artifact.
+            continue
+        if not (p.name.startswith("epoch-") and p.name.endswith(".jsonl")):
+            raise DataError(
+                f"{p}: not an epoch-log file; the {EPOCHS_DIR}/ directory "
+                f"belongs to the mutable tier's write-ahead log"
+            )
+        try:
+            out.append((int(p.name[len("epoch-"):-len(".jsonl")]), p))
+        except ValueError as e:
+            raise DataError(f"{p}: unparseable epoch number") from e
+    out.sort()
+    return out
+
+
+def read_epoch_records(path, tolerate_torn: bool = False):
+    """Parse one epoch log. Returns ``(records, torn)`` — ``torn`` is True
+    when the FINAL line is an unparseable fragment and ``tolerate_torn``
+    allowed it (a crash mid-append; that mutation was never acknowledged,
+    so dropping it loses nothing). A bad line anywhere else — or a final
+    fragment without tolerance — is a typed :class:`DataError`: the log
+    is corrupt, not merely truncated."""
+    path = Path(path)
+    try:
+        lines = path.read_text(encoding="utf-8").splitlines()
+    except OSError as e:
+        raise DataError(f"{path}: unreadable epoch log: {e}") from e
+    records = []
+    for n, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "seq" not in rec:
+                raise ValueError("not a mutation record")
+        except ValueError as e:
+            if tolerate_torn and n == len(lines) - 1:
+                return records, True
+            raise DataError(
+                f"{path}:{n + 1}: corrupt epoch-log record: {e}"
+            ) from e
+        records.append(rec)
+    return records, False
+
+
+def repair_epoch(path, records: "list[dict]") -> None:
+    """Rewrite an epoch log as exactly ``records`` (atomic replace) —
+    called by boot replay after it DROPPED a tolerated torn final
+    fragment. Boot owns the WAL, so repairing here matters: once a later
+    epoch exists this one is no longer last and gets no torn-tolerance,
+    and without the repair the NEXT boot would refuse (typed DataError) a
+    state this boot accepted."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class EpochLog:
+    """Append-side of one write-ahead epoch file. Every record is written
+    and FLUSHED before the mutation is acknowledged: a SIGKILL'd process
+    loses at most the in-flight (never-acked) append — the crash-recovery
+    half of the mutable-soak gate."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def append(self, record: dict) -> None:
+        self._f.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+def read_current(root) -> Optional[dict]:
+    """The compaction pointer, or None for a never-compacted artifact.
+    Validated minimally — the named base generation must exist and be a
+    loadable artifact (the caller loads it)."""
+    p = Path(root) / CURRENT_NAME
+    if not p.exists():
+        return None
+    try:
+        doc = json.loads(p.read_text())
+        if not isinstance(doc, dict) or "generation" not in doc:
+            raise ValueError("not a compaction pointer")
+        return doc
+    except (OSError, ValueError) as e:
+        raise DataError(f"{p}: unreadable compaction pointer: {e}") from e
+
+
+def write_current(root, doc: dict) -> None:
+    """Atomically replace the compaction pointer — the commit point of a
+    compaction: a crash before this line leaves the old generation
+    serving with every epoch record still replayable."""
+    p = Path(root) / CURRENT_NAME
+    tmp = p.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    os.replace(tmp, p)
+
+
+def resolve_mutable_base(root) -> "tuple[Path, Optional[dict]]":
+    """Where a mutable-serving boot actually loads its base model from:
+    the generation ``CURRENT.json`` points at, or ``root`` itself for a
+    never-compacted artifact. Returns ``(base_dir, current_doc)``."""
+    root = Path(root)
+    cur = read_current(root)
+    if cur is None:
+        return root, None
+    rel = cur.get("base")
+    base = root / rel if rel else root
+    if not (base / MANIFEST_NAME).exists():
+        raise DataError(
+            f"{root}: {CURRENT_NAME} points at missing generation "
+            f"{rel!r}; the artifact is corrupt"
+        )
+    return base, cur
+
+
+def read_mutable_block(base_dir) -> "tuple[Optional[dict], Optional[np.ndarray]]":
+    """The generation's mutable metadata: ``(manifest block, stable_ids)``
+    — both None for a plain (never-compacted) artifact, whose base rows
+    implicitly keep stable ids ``0..N-1``."""
+    base_dir = Path(base_dir)
+    manifest = _read_manifest(base_dir)
+    block = manifest.get("mutable")
+    if not isinstance(block, dict):
+        return None, None
+    import zipfile
+
+    try:
+        with np.load(base_dir / ARRAYS_NAME, allow_pickle=False) as z:
+            stable = np.asarray(z["mutable_stable_ids"], np.int64)
+    except (OSError, KeyError, ValueError, zipfile.BadZipFile) as e:
+        raise DataError(
+            f"{base_dir}: manifest declares a mutable block but "
+            f"mutable_stable_ids is unreadable: {e}"
+        ) from e
+    return block, stable
 
 
 def warmup(model, batch_sizes=(1, 256), kinds=("predict",)) -> dict:

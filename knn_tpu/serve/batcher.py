@@ -99,6 +99,48 @@ from knn_tpu.resilience.errors import (
 
 KINDS = ("predict", "kneighbors")
 
+MUTATION_OPS = ("insert", "delete")
+
+
+class _Mutation:
+    """One queued mutation: applied by the worker thread between read
+    dispatches (mutations serialize against dispatches; read admission
+    never blocks on a write). The future contract mirrors
+    :class:`_Request` — exactly one terminal outcome."""
+
+    __slots__ = ("op", "payload", "enqueued_ns", "event", "value", "error")
+
+    def __init__(self, op: str, payload: dict):
+        self.op = op
+        self.payload = payload
+        self.enqueued_ns = time.monotonic_ns()
+        self.event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+
+    def succeed(self, value) -> None:
+        self.value = value
+        self.event.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.event.set()
+
+    def handle(self) -> AsyncResult:
+        def finish(timeout: Optional[float] = None):
+            if not self.event.wait(timeout):
+                raise DeadlineExceededError(
+                    f"{self.op} mutation not applied within "
+                    f"{timeout * 1e3:.0f} ms (result() again to keep "
+                    f"waiting)"
+                )
+            if self.error is not None:
+                raise self.error
+            return self.value
+
+        finish.__accepts_timeout__ = True
+        return AsyncResult(finish)
+
 
 class _Request:
     """One queued request: features, kind, timing, the completion event
@@ -255,7 +297,7 @@ class MicroBatcher:
                  index_version: Optional[str] = None,
                  recorder: "Optional[reqtrace.FlightRecorder]" = None,
                  quality=None, drift=None, accounting=None, capacity=None,
-                 ivf=None):
+                 ivf=None, mutable=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -274,6 +316,13 @@ class MicroBatcher:
         self.accounting = accounting
         self.capacity = capacity
         self.ivf = ivf
+        # Mutable serving (knn_tpu/mutable/): an optional MutableEngine.
+        # None (the default, and always for --mutable off) constructs
+        # NOTHING — no mutation queue work, no per-dispatch snapshot or
+        # merge, one `is None` predicate per call site
+        # (scripts/check_disabled_overhead.py pins it).
+        self.mutable = mutable
+        self._mutations: deque = deque()
         # TEST-ONLY corruption hook (scripts/quality_soak.py): when armed
         # (the serve process installs a SIGUSR2 handler only under
         # KNN_TPU_TEST_QUALITY_CORRUPT), served neighbor indices are
@@ -414,6 +463,44 @@ class MicroBatcher:
             self.capacity.note_arrival(req.rows)
         return req.handle()
 
+    def submit_mutation(self, op: str, payload: dict) -> AsyncResult:
+        """Enqueue one mutation for the worker to apply between read
+        dispatches (the mutation-admission contract: writes serialize
+        against dispatches on the one worker thread; reads never block on
+        a write's WAL append). ``payload``: ``{"rows", "values"}`` for
+        insert, ``{"ids"}`` for delete. Raises :class:`OverloadError`
+        while draining/closed or when the delta tier is already full
+        (cheap pre-check; the engine re-checks authoritatively at
+        apply)."""
+        if self.mutable is None:
+            raise ValueError(
+                "this batcher serves an immutable index (no mutable "
+                "engine wired in)")
+        if op not in MUTATION_OPS:
+            raise ValueError(f"unknown mutation op {op!r}; choose "
+                             f"{' or '.join(MUTATION_OPS)}")
+        if op == "insert" and self.mutable.delta_full():
+            instrument.record_serve_rejected("delta_full")
+            raise OverloadError(
+                f"delta tier full ({self.mutable.delta_cap} slots); "
+                f"compaction is behind — retry after backoff or trigger "
+                f"/admin/compact"
+            )
+        mut = _Mutation(op, payload)
+        with self._cond:
+            if self._closed:
+                instrument.record_serve_rejected("closed")
+                raise OverloadError("batcher is shut down")
+            if self._draining:
+                instrument.record_serve_rejected("draining")
+                raise OverloadError(
+                    "server is draining (shutting down); no new "
+                    "mutations accepted"
+                )
+            self._mutations.append(mut)
+            self._cond.notify_all()
+        return mut.handle()
+
     def predict(self, features, timeout: Optional[float] = None):
         """Synchronous convenience: ``submit(..., 'predict').result()``."""
         return self.submit(features, "predict").result(timeout=timeout)
@@ -433,19 +520,35 @@ class MicroBatcher:
         """The ladder rung that answered the most recent batch."""
         return self._last_rung
 
-    def swap_model(self, model, index_version: Optional[str] = None):
+    def swap_model(self, model, index_version: Optional[str] = None,
+                   hook=None):
         """Atomically replace the served model (the hot-reload path).
 
         The worker snapshots ``(model, version)`` once per batch under the
         queue lock, so every response reflects exactly one index — the old
         or the new, never a mix. The caller is responsible for warming the
         replacement first (``artifact.warmup``); the swap itself is one
-        reference assignment. Returns the previous version tag."""
+        reference assignment. ``hook`` (compaction's engine rebase) runs
+        INSIDE the same critical section, so a dispatch snapshot can never
+        pair the new model with a pre-rebase mutable view. Returns the
+        previous version tag."""
         model.train_  # fitted-model check, same as the constructor
         with self._cond:
+            previous_model = self._model
             previous = self._index_version
             self._model = model
             self._index_version = index_version
+            if hook is not None:
+                try:
+                    hook()
+                except BaseException:
+                    # A failed rebase must not leave the NEW model paired
+                    # with the OLD (un-rebased) mutable view — restore so
+                    # "rolled back" means the old generation really keeps
+                    # serving (the compaction failure contract).
+                    self._model = previous_model
+                    self._index_version = previous
+                    raise
         return previous
 
     def begin_drain(self) -> None:
@@ -469,11 +572,16 @@ class MicroBatcher:
             doomed = list(self._queue)
             self._queue.clear()
             self._queued_rows = 0
+            doomed_muts = list(self._mutations)
+            self._mutations.clear()
             self._cond.notify_all()
         for req in doomed:
             if not req.event.is_set():
                 req.fail(error, outcome=outcome)
-        return len(doomed)
+        for mut in doomed_muts:
+            if not mut.event.is_set():
+                mut.fail(error)
+        return len(doomed) + len(doomed_muts)
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop accepting work, drain the queue, and join the worker.
@@ -557,9 +665,15 @@ class MicroBatcher:
         larger than ``max_batch`` dispatches alone, oversized."""
         with self._cond:
             while True:
-                while not self._queue and not self._closed:
+                while (not self._queue and not self._closed
+                       and not self._mutations):
                     self._cond.wait()
                 if not self._queue:
+                    if self._mutations and not self._closed:
+                        # Pending writes, no reads: hand control back to
+                        # _run so the mutation batch applies NOW instead
+                        # of idling until a read arrives.
+                        return None
                     return []
                 # The span covers only the coalescing window, not the idle
                 # block above — an idle server must not inflate queue
@@ -597,7 +711,10 @@ class MicroBatcher:
         # recovery path itself kills the worker — and the supervisor
         # restarts it, counted and logged, with the queue intact.
         while True:
+            self._apply_mutations()
             batch = self._collect()
+            if batch is None:
+                continue  # mutations arrived while idle; apply them
             if not batch:
                 return
             try:
@@ -607,9 +724,41 @@ class MicroBatcher:
                     if not req.event.is_set():
                         req.fail(e)
 
+    def _apply_mutations(self) -> None:
+        """Drain the mutation queue on the worker thread — between read
+        dispatches, never inside one, which is the whole serialization
+        contract. A failed apply (typed validation/conflict/overload)
+        goes to THAT mutation's future; the worker survives anything."""
+        if self.mutable is None:
+            return
+        with self._cond:
+            if not self._mutations:
+                return
+            muts = list(self._mutations)
+            self._mutations.clear()
+        for mut in muts:
+            try:
+                if mut.op == "insert":
+                    out = self.mutable.apply_insert(
+                        mut.payload["rows"], mut.payload["values"],
+                        mut.enqueued_ns,
+                    )
+                else:
+                    out = self.mutable.apply_delete(
+                        mut.payload["ids"], mut.enqueued_ns,
+                        expect_version=mut.payload.get("expect_version"))
+                # No version stamp here: the ENGINE stamps it under its
+                # own lock, so the ack's ids and tag name one generation
+                # (reading self._index_version after apply would race a
+                # compaction swap).
+                mut.succeed(out)
+            except BaseException as e:  # noqa: BLE001 — per-future
+                if not mut.event.is_set():
+                    mut.fail(e)
+
     # -- the degradation ladder --------------------------------------------
 
-    def _rungs(self, model):
+    def _rungs(self, model, mview=None):
         """The serving ladder for this batch's model snapshot:
         ``ivf`` (probed approximate retrieval over the model's IVF
         partition — present only when this batcher serves approximate AND
@@ -653,7 +802,42 @@ class MicroBatcher:
         if engine != "xla":  # "auto" may resolve to stripe on real TPU
             rungs.append(("xla", xla))
         rungs.append(("oracle", oracle))
+        if mview is not None and not mview.empty:
+            # Mutable serving with live mutations: every rung's base-only
+            # answer is folded with the delta tier + tombstones under the
+            # shared (distance, index) order (knn_tpu/mutable/state.py).
+            # An EMPTY view never reaches here — the ladder (and its
+            # bytes) is exactly the immutable one, the pinned bit-identity
+            # contract.
+            rungs = [(name, self._merged_rung(name, fn, model, mview))
+                     for name, fn in rungs]
         return rungs
+
+    def _merged_rung(self, name: str, fn, model, mview):
+        """Wrap one rung closure with the delta/tombstone merge. The
+        k-coverage widening re-retrieves affected rows through the SAME
+        family: the ivf rung widens its own probed search, exact rungs
+        widen through the oracle (bit-identical to every exact rung by
+        the ladder contract)."""
+        from knn_tpu.mutable import state as mstate
+
+        k = model.k
+        if name == "ivf":
+            def wide(feats, k_wide):
+                return self.ivf.kneighbors(model, feats, k=k_wide)
+        else:
+            def wide(feats, k_wide):
+                from knn_tpu.backends.oracle import oracle_kneighbors
+
+                return oracle_kneighbors(model.train_.features, feats,
+                                         k_wide, model.metric)
+
+        def merged(feats):
+            d, i = fn(feats)
+            return mstate.merge_candidates(mview, feats, d, i, k,
+                                           model.metric, wide)
+
+        return merged
 
     def _call_rung(self, fn, feats):
         """Dispatch ``feats`` through one rung, chunked to the CURRENT
@@ -737,7 +921,7 @@ class MicroBatcher:
             )
         return pad
 
-    def _retrieve(self, model, live: "list[_Request]"):
+    def _retrieve(self, model, live: "list[_Request]", mview=None):
         """Candidate retrieval for the coalesced batch, through the
         breaker + ladder. Returns ``(live, dists, idx, rung,
         padded_rows)`` — ``live`` may have shrunk (mid-fallback deadline
@@ -750,7 +934,7 @@ class MicroBatcher:
         fast dispatch is device time the surviving requests paid; a
         request that expired mid-fallback is attributed only the attempts
         it rode — tests/test_accounting.py)."""
-        rungs = self._rungs(model)
+        rungs = self._rungs(model, mview)
         decision = self.breaker.decide()
         start = 0
         if decision == "open":
@@ -871,9 +1055,13 @@ class MicroBatcher:
     def _dispatch(self, batch: "list[_Request]") -> None:
         with self._cond:
             # One snapshot per batch: swap_model can never split a batch
-            # across two indexes.
+            # across two indexes — and the mutable view snapshots in the
+            # SAME critical section compaction's swap+rebase runs in, so
+            # (model, version, view) are always one consistent triple.
             model = self._model
             version = self._index_version
+            mview = (self.mutable.snapshot()
+                     if self.mutable is not None else None)
         now_ns = time.monotonic_ns()
         live: "list[_Request]" = []
         for req in batch:
@@ -906,7 +1094,8 @@ class MicroBatcher:
         try:
             with obs.span("serve.dispatch", requests=len(live),
                           rows=rows) as dispatch_span:
-                live, dists, idx, rung, padded = self._retrieve(model, live)
+                live, dists, idx, rung, padded = self._retrieve(
+                    model, live, mview)
                 if not live:
                     # Every request expired mid-fallback — but the failed
                     # rung attempts were real worker busy time the duty
@@ -929,6 +1118,7 @@ class MicroBatcher:
                     # Test-only (see __init__): every served neighbor is
                     # off by one train row while distances stay plausible.
                     idx = (idx + 1) % model.train_.num_instances
+                merged = mview is not None and not mview.empty
                 off = 0
                 for req in live:
                     d = dists[off:off + req.rows]
@@ -936,10 +1126,22 @@ class MicroBatcher:
                     off += req.rows
                     req.meta["index_version"] = version
                     req.meta["rung"] = rung
+                    if mview is not None:
+                        # The read's sequence point: which acknowledged
+                        # mutations this answer reflects (the anchor the
+                        # mutable soak's oracle replay verifies against).
+                        req.meta["mutation_seq"] = mview.seq
                     if req.trace is not None:
                         req.trace.annotate(index_version=version, rung=rung)
                     if req.kind == "kneighbors":
                         value = (d, i)
+                    elif merged:
+                        # Candidate ids span base+delta: labels/targets
+                        # must be gathered across BOTH spaces (a clamped
+                        # base lookup would vote with the wrong label).
+                        from knn_tpu.mutable.state import predict_from_view
+
+                        value = predict_from_view(model, mview, d, i)
                     elif isinstance(model, KNNClassifier):
                         value = model.predict_from_candidates(d, i)
                     else:
@@ -960,6 +1162,7 @@ class MicroBatcher:
                             preds=(value if req.kind == "predict"
                                    else None),
                             rung=rung, model=model, version=version,
+                            mview=mview,
                         )
                     if self.drift is not None:
                         self.drift.offer(req.features)

@@ -163,7 +163,12 @@ class ServeApp:
                  cost_accounting: bool = False,
                  capacity_window_s: int = 60,
                  ivf_probes: Optional[int] = None,
-                 ivf_recall_floor: float = 0.95):
+                 ivf_recall_floor: float = 0.95,
+                 mutable: bool = False, delta_cap: int = 4096,
+                 compact_threshold: int = 1024,
+                 compact_interval_s: float = 30.0,
+                 mutable_current: Optional[dict] = None,
+                 mutable_base_dir=None):
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -257,13 +262,46 @@ class ServeApp:
         else:
             self.accounting = None
             self.capacity = None
+        # Mutable serving (knn_tpu/mutable/, docs/INDEXES.md §Mutable
+        # tier): --mutable on builds the delta/tombstone engine (replaying
+        # any existing epoch logs — the crash-recovery path) and the
+        # background compactor. Off (the default) constructs NOTHING: no
+        # engine, no compactor thread, no knn_mutable_* instruments, no
+        # per-dispatch snapshot/merge work
+        # (scripts/check_disabled_overhead.py pins it).
+        if mutable:
+            from knn_tpu.mutable.engine import MutableEngine
+
+            if index_path is None:
+                raise DataError(
+                    "mutable serving needs an artifact directory for its "
+                    "write-ahead epoch log; build one with `knn_tpu "
+                    "save-index` and boot `serve INDEX --mutable on`"
+                )
+            self.mutable = MutableEngine(
+                model, index_path, delta_cap=delta_cap,
+                current=mutable_current, base_dir=mutable_base_dir,
+                version=index_version,
+            )
+        else:
+            self.mutable = None
         self.batcher = MicroBatcher(
             model, max_batch=max_batch, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, index_version=index_version,
             recorder=self.recorder, quality=self.quality, drift=self.drift,
             accounting=self.accounting, capacity=self.capacity,
-            ivf=self.ivf,
+            ivf=self.ivf, mutable=self.mutable,
         )
+        if mutable:
+            from knn_tpu.mutable.compact import Compactor
+
+            self.compactor = Compactor(
+                self.mutable, swap=self._mutable_swap,
+                warm=self._warm_replacement, threshold=compact_threshold,
+                interval_s=compact_interval_s,
+            )
+        else:
+            self.compactor = None
         self.ready = False
         self.draining = False
         self.started_unix = time.time()
@@ -297,8 +335,48 @@ class ServeApp:
         )
         if self.capacity is not None:
             self._seed_capacity_model()
+        if self.compactor is not None:
+            # Only after warmup: a compaction before ready would compile
+            # against the batcher's serving shapes anyway.
+            self.compactor.start()
         self.ready = True
         return self.warmup_ms
+
+    def _warm_replacement(self, model) -> dict:
+        """Compile a compaction's replacement model at the serving batch
+        shapes, OFF the serving path (the reload warmup rule)."""
+        return artifact.warmup(
+            model,
+            batch_sizes=self._warm_sizes or (1, self.batcher.max_batch),
+            kinds=("predict",),
+        )
+
+    def _mutable_swap(self, model, version, rebase_hook):
+        """Compaction's swap callback: model swap + engine rebase in ONE
+        batcher critical section (every dispatch snapshot sees exactly
+        the old or the new (model, version, view) triple — the
+        atomic-swap assertion of the mutable soak), then the app-level
+        bookkeeping hot reload also does."""
+        previous = self.batcher.swap_model(model, version,
+                                           hook=rebase_hook)
+        # Past this point the swap HAPPENED (run_once reports a failure
+        # below as commit_failed, never rolled_back) — so the app-level
+        # bookkeeping is best-effort: a capacity-seed probe error must
+        # not turn a served generation into a misreported rollback.
+        self.model = model
+        self.index_version = version
+        try:
+            new_partition = getattr(model, "ivf_", None)
+            if self.ivf is not None and new_partition is not None:
+                self.ivf.set_num_cells(new_partition.num_cells)
+            if self.capacity is not None:
+                self._seed_capacity_model()
+        except Exception as e:  # noqa: BLE001 — advisory layers only
+            print(f"warning: post-compaction bookkeeping failed "
+                  f"({type(e).__name__}: {e}); serving the new "
+                  f"generation regardless (capacity/probe state refits "
+                  f"from live traffic)", flush=True)
+        return previous
 
     def _seed_capacity_model(self) -> None:
         """Seed the headroom model's affine dispatch-cost fit
@@ -338,6 +416,13 @@ class ServeApp:
         missing/corrupt/newer-format artifact, incompatible schema, a
         warmup compile error — raises typed and leaves the old index
         serving untouched (rollback is "never swapped")."""
+        if self.mutable is not None:
+            raise DataError(
+                "hot reload is disabled under --mutable on: the mutable "
+                "tier owns the artifact's lifecycle (its epoch log and "
+                "generations); fold pending writes with POST "
+                "/admin/compact instead"
+            )
         if not self._reload_lock.acquire(blocking=False):
             raise ReloadInProgress("a reload is already in progress")
         try:
@@ -492,7 +577,11 @@ class ServeApp:
 
     def close(self) -> None:
         self.ready = False
+        if self.compactor is not None:
+            self.compactor.stop()
         self.batcher.close()
+        if self.mutable is not None:
+            self.mutable.close()
         if self.quality is not None:
             self.quality.close()
         if self.drift is not None:
@@ -528,6 +617,12 @@ class ServeApp:
             # knn_capacity_* gauges); None while --cost-accounting off.
             "capacity": (self.capacity.export()
                          if self.capacity is not None else None),
+            # The mutable-tier summary (delta/tombstone/freshness/
+            # compaction; export() refreshes the knn_mutable_* gauges).
+            # None — the DISTINCT "mutable: absent" state, never
+            # fabricated freshness numbers — while --mutable off.
+            "mutable": (self.mutable.export()
+                        if self.mutable is not None else None),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
@@ -652,6 +747,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.app.drift.export()
             if self.app.capacity is not None:
                 self.app.capacity.export()
+            if self.app.mutable is not None:
+                self.app.mutable.export()
             accept = self.headers.get("Accept", "")
             if "application/openmetrics-text" in accept:
                 self._send_text(
@@ -721,6 +818,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "max_wait_ms": b.max_wait_ms,
                 "max_queue_rows": b.max_queue_rows,
             },
+            # Compaction debt is capacity debt: the delta ratio prices
+            # the extra per-dispatch merge work, so it belongs on the
+            # page an operator sizes replicas from. None while off.
+            "mutable": (self.app.mutable.export()
+                        if self.app.mutable is not None else None),
             "index_version": self.app.index_version,
         }
         # No request_id stamped into a payload about OTHER requests (the
@@ -843,6 +945,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/admin/reload":
             self._do_reload()
             return
+        if self.path == "/admin/compact":
+            self._do_compact()
+            return
+        if self.path in ("/insert", "/delete"):
+            with self.app.track_request():
+                self._do_mutation(self.path[1:])
+            return
         # Error replies sent before the body was drained must also close
         # the connection: with HTTP/1.1 keep-alive the unread bytes would
         # be parsed as the next request line.
@@ -852,6 +961,109 @@ class _Handler(BaseHTTPRequestHandler):
             return
         with self.app.track_request():
             self._do_inference(self.path[1:])
+
+    # -- mutations (the mutable tier, docs/SERVING.md) ---------------------
+
+    def _do_mutation(self, op: str):
+        """``POST /insert`` (``{"rows": [[...]], "labels": [...]}``) and
+        ``POST /delete`` (``{"ids": [...], "index_version": optional}``).
+        Typed status contract: 404 while ``--mutable off`` (the layer
+        does not exist — the /debug/requests rule), 400 malformed, 409
+        conflict (unknown/deleted row, k-floor, stale version
+        precondition), 429 delta tier full, 503 draining, 504 apply
+        deadline; a 200 ack means the mutation is DURABLE (epoch-logged,
+        flushed) and visible to every subsequent dispatch."""
+        if self.app.mutable is None:
+            self.close_connection = True
+            self._send(404, {"error": "mutable serving is off — boot "
+                                      "with `serve INDEX --mutable on`"})
+            return
+        body, err, status = self._read_json_body(required=True)
+        if err is not None:
+            self.close_connection = True
+            self._send(status, {"error": err})
+            return
+        from knn_tpu.mutable.state import MutationConflict
+
+        try:
+            if op == "insert":
+                if "rows" not in body:
+                    raise ValueError('insert body needs "rows" '
+                                     '(and "labels", one per row)')
+                payload = {"rows": body["rows"],
+                           "values": body.get("labels")}
+            else:
+                if "ids" not in body:
+                    raise ValueError('delete body needs "ids"')
+                # The version precondition rides the payload and is
+                # checked by the ENGINE at apply time, under the lock the
+                # compaction rebase holds — a handler-side check would
+                # race the swap and a stale positional id could silently
+                # delete a different row in the new generation.
+                payload = {"ids": body["ids"],
+                           "expect_version": body.get("index_version")}
+            handle = self.app.batcher.submit_mutation(op, payload)
+            value = handle.result(timeout=30)
+        except MutationConflict as e:
+            self._send(409, {"error": str(e)})
+            return
+        except OverloadError as e:
+            st = 503 if self.app.draining else 429
+            self._send(st, {"error": str(e)})
+            return
+        except DeadlineExceededError as e:
+            self._send(504, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": f"bad request body: {e}"})
+            return
+        except Exception as e:  # noqa: BLE001 — typed JSON, never a
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, value)
+
+    def _do_compact(self):
+        """``POST /admin/compact``: fold the delta tier + tombstones into
+        a fresh generation NOW and swap it in (the admin trigger for the
+        background compactor). 404 while ``--mutable off``, 409 while
+        another compaction runs, 500 ``rolled_back`` on failure with the
+        old generation still serving."""
+        if self.app.compactor is None:
+            self.close_connection = True
+            self._send(404, {"error": "mutable serving is off — boot "
+                                      "with `serve INDEX --mutable on`"})
+            return
+        body, err, status = self._read_json_body(required=False)
+        if err is not None:
+            self.close_connection = True
+            self._send(status, {"error": err})
+            return
+        from knn_tpu.mutable.compact import (
+            CompactionCommitFailed,
+            CompactionInProgress,
+        )
+
+        try:
+            result = self.app.compactor.run_once(force=True)
+        except CompactionInProgress as e:
+            self._send(409, {"error": str(e)})
+            return
+        except CompactionCommitFailed as e:
+            # The NEW generation is serving; only the pointer commit
+            # failed — claiming rolled_back would be the opposite of the
+            # truth (the reboot/replay contract still holds).
+            self._send(500, {
+                "error": str(e), "rolled_back": False,
+                "index_version": self.app.index_version,
+            })
+            return
+        except Exception as e:  # noqa: BLE001 — rollback is implicit
+            self._send(500, {
+                "error": f"{type(e).__name__}: {e}", "rolled_back": True,
+                "index_version": self.app.index_version,
+            })
+            return
+        self._send(200, result)
 
     def _do_reload(self):
         body, err, status = self._read_json_body(required=False)
@@ -1036,17 +1248,23 @@ class _Handler(BaseHTTPRequestHandler):
         ms = round((time.monotonic() - t0) * 1e3, 3)
         meta = handle.meta or {}
         if kind == "predict":
-            self._send(200, {"predictions": np.asarray(value).tolist(),
-                             "index_version": meta.get("index_version"),
-                             "ms": ms})
+            payload = {"predictions": np.asarray(value).tolist(),
+                       "index_version": meta.get("index_version"),
+                       "ms": ms}
         else:
             dists, idx = value
-            self._send(200, {
+            payload = {
                 "distances": np.asarray(dists).tolist(),
                 "indices": np.asarray(idx).tolist(),
                 "index_version": meta.get("index_version"),
                 "ms": ms,
-            })
+            }
+        if "mutation_seq" in meta:
+            # Mutable serving: the read's sequence point — which
+            # acknowledged mutations this answer reflects (what the
+            # mutable soak's oracle replay verifies against).
+            payload["mutation_seq"] = meta["mutation_seq"]
+        self._send(200, payload)
         self._account(kind, 200, "ok", t0, trace=trace,
                       rung=meta.get("rung"), rows=rows,
                       index_version=meta.get("index_version"),

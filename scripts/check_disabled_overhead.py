@@ -117,25 +117,41 @@ def main() -> int:
             return fail(f"primary rung {app.primary_rung!r} on an "
                         f"exact-only serve; the fast_rung SLI would "
                         f"misattribute")
+        # Mutable tier (PR 10): the default (--mutable off /
+        # ServeApp's mutable=False) must construct NOTHING — no delta
+        # engine, no tombstone state, no compactor thread, no epoch log,
+        # no per-dispatch snapshot/merge (the batcher pays one `is None`
+        # predicate per dispatch and never wraps a rung).
+        if app.mutable is not None or app.compactor is not None:
+            return fail("ServeApp built a mutable engine / compactor with "
+                        "mutable off — the layer must not exist while "
+                        "disabled")
+        if app.batcher.mutable is not None:
+            return fail("the batcher holds a mutable engine while disabled")
+        if any("_merged_rung" in fn.__qualname__
+               for _name, fn in app.batcher._rungs(app.batcher._model)):
+            return fail("the serving ladder wrapped a rung with the "
+                        "mutable merge while disabled")
         app.batcher.predict(test.features[0], timeout=60)
     finally:
         app.close()
     bad_threads = [t.name for t in threading.enumerate()
-                   if t.name.startswith(("knn-quality", "knn-drift"))]
+                   if t.name.startswith(("knn-quality", "knn-drift",
+                                         "knn-compactor"))]
     if bad_threads:
-        return fail(f"quality/drift worker thread(s) alive while disabled: "
-                    f"{bad_threads}")
+        return fail(f"quality/drift/compactor worker thread(s) alive while "
+                    f"disabled: {bad_threads}")
     leaked = [i.name for i in obs.registry().instruments()
               if i.name.startswith(("knn_quality_", "knn_drift_",
                                     "knn_cost_", "knn_capacity_",
-                                    "knn_ivf_"))]
+                                    "knn_ivf_", "knn_mutable_"))]
     if leaked:
-        return fail(f"quality/drift/cost/capacity/ivf instrument(s) "
-                    f"recorded while disabled: {leaked}")
-    print("disabled-overhead: quality/drift/cost/capacity/ivf off-state "
-          "ok (no scorer, no monitor, no accountant, no tracker, no probe "
-          "policy, no worker threads, zero instruments, zero queue "
-          "activity)")
+        return fail(f"quality/drift/cost/capacity/ivf/mutable "
+                    f"instrument(s) recorded while disabled: {leaked}")
+    print("disabled-overhead: quality/drift/cost/capacity/ivf/mutable "
+          "off-state ok (no scorer, no monitor, no accountant, no "
+          "tracker, no probe policy, no delta engine, no compactor, no "
+          "worker threads, zero instruments, zero queue activity)")
 
     # -- 1b. the device-side layer (obs/devprof.py) off-state --------------
     # Even with the compile listener having been registered by a PRIOR

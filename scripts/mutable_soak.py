@@ -1,0 +1,702 @@
+"""Mutable-soak gate (`make mutable-soak`): online mutation held to its
+contracts (docs/INDEXES.md §Mutable tier).
+
+Four phases, every one against a real `knn_tpu serve --mutable on`
+subprocess:
+
+**Phase 1 — oracle replay under chaos.** Concurrent writers (inserts +
+deletes) and readers under the chaos fault burst
+(``KNN_TPU_FAULTS=serve.dispatch=N`` — the degradation ladder is
+exercised mid-mutation). Every read carries its ``mutation_seq`` sequence
+point; the gate replays the acknowledged mutation history to exactly that
+seq through an independent fold/merge mirror and requires the served
+indices BIT-IDENTICAL to the replay (the selection/tie-order truth — the
+same contract every ladder rung is pinned to) with distances inside
+float32 ulp of it (the rung distance forms differ in the last ulp) — on
+every rung the burst pushed the ladder through. Freshness p99 (write-ack
+to
+visible-in-snapshots, /healthz) must stay under the bound.
+
+**Phase 2 — atomic compaction swap under load.** Writers and readers
+stay hot while ``POST /admin/compact`` folds the tier into a fresh
+generation. Every response must carry exactly the old or the new
+``index_version`` (never a mix, never a 500), reads under BOTH versions
+must replay bit-identical against their own generation's positional
+space, and writes acknowledged mid-compaction must survive the swap
+(the fresh-epoch re-anchor).
+
+**Phase 3 — rollback.** With the seeded ``mutable.compact`` fault armed
+(``once``), the first compaction attempt fails AFTER fold+warm: the gate
+requires HTTP 500 with ``rolled_back: true``, the old generation still
+serving, every acknowledged write still answering, and the NEXT attempt
+(fault exhausted) succeeding.
+
+**Phase 4 — crash recovery.** SIGKILL the server while a compaction is
+in flight, reboot over the same artifact directory, and require zero
+acknowledged writes lost: the rebooted ``mutation_seq`` equals the last
+acknowledged seq and a fresh read replays bit-identical (whether the
+kill landed before or after the CURRENT.json commit point).
+
+Exit 0 when every invariant holds; 1 with a diagnosis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
+BOOT_TIMEOUT_S = 180
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--short", action="store_true",
+                   help="CI preset: ~6 s load windows")
+    p.add_argument("--window-s", type=float, default=None)
+    p.add_argument("--writers", type=int, default=2)
+    p.add_argument("--readers", type=int, default=2)
+    p.add_argument("--rows", type=int, default=4,
+                   help="query rows per read request")
+    p.add_argument("--faults", type=int, default=3,
+                   help="phase-1 serve.dispatch fault burst size")
+    p.add_argument("--freshness-p99-ms", type=float, default=2000.0)
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--json-out", default=None, metavar="FILE")
+    args = p.parse_args()
+    if args.window_s is None:
+        args.window_s = 6.0 if args.short else 15.0
+    return args
+
+
+def fail(msg: str, *procs) -> int:
+    print(f"mutable-soak: FAIL: {msg}", file=sys.stderr)
+    for proc in procs:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+    return 1
+
+
+def http(base: str, path: str, payload=None, timeout=60):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"} if payload else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def boot(index: str, env: dict, extra_flags=()):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "knn_tpu.cli", "serve", index,
+         "--port", "0", "--max-batch", "32", "--max-wait-ms", "1",
+         "--mutable", "on", "--compact-interval-s", "0",
+         "--compact-threshold", "100000", *extra_flags],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO,
+    )
+    import queue
+
+    lines: "queue.Queue[str]" = queue.Queue()
+    threading.Thread(
+        target=lambda: [lines.put(ln) for ln in proc.stdout], daemon=True,
+    ).start()
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=min(1.0, max(
+                0.01, deadline - time.monotonic())))
+        except Exception:  # noqa: BLE001 — queue.Empty
+            if proc.poll() is not None:
+                return proc, None
+            continue
+        m = READY_RE.search(line)
+        if m:
+            print(f"mutable-soak: server: {line.rstrip()}")
+            return proc, m.group(1)
+    return proc, None
+
+
+def shutdown(proc) -> "int | None":
+    proc.send_signal(signal.SIGINT)
+    try:
+        return proc.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return None
+
+
+def healthz(base) -> dict:
+    st, body = http(base, "/healthz")
+    if st != 200:
+        raise RuntimeError(f"/healthz: status {st}")
+    return json.loads(body)
+
+
+# -- the replay mirror ------------------------------------------------------
+
+
+class Mirror:
+    """Independent oracle replay of the acknowledged mutation history.
+
+    ``history``: seq -> ("insert", rows[f32]) | ("delete", [positional
+    ids]) — exactly what the server acknowledged, keyed by the seq it
+    acknowledged with (mutations are serialized, so seqs are a total
+    order). ``folds``: the seqs at which compactions committed, in order
+    — the fold is a deterministic function of the history (survivor
+    order: base positions ascending, then live delta rows in insert
+    order), so each generation's positional space is re-derivable."""
+
+    def __init__(self, base_features, k, metric):
+        import numpy as np
+
+        self.np = np
+        self.base0 = np.asarray(base_features, np.float32)
+        self.k = k
+        self.metric = metric
+        self.lock = threading.Lock()
+        self.history: "dict[int, tuple]" = {}
+        self._gen_cache: "dict[tuple, object]" = {(): self.base0}
+
+    def ack(self, seq: int, op: str, payload) -> None:
+        with self.lock:
+            if seq in self.history:
+                raise AssertionError(
+                    f"two mutations acknowledged with seq {seq} — the "
+                    f"serialization contract is broken")
+            self.history[seq] = (op, payload)
+
+    def _window(self, lo: int, hi: int):
+        with self.lock:
+            seqs = sorted(s for s in self.history if lo < s <= hi)
+            missing = [s for s in range(lo + 1, hi + 1) if s not in
+                       self.history]
+            if missing:
+                # A seq we never saw an ack for (e.g. its HTTP response
+                # raced a kill): the replay cannot cover this window.
+                raise KeyError(f"unacknowledged seq(s) {missing[:5]} in "
+                               f"({lo}, {hi}]")
+            return [(s, *self.history[s]) for s in seqs]
+
+    def base_at(self, folds: "tuple[int, ...]"):
+        """The generation's base features after folding the history at
+        each seq in ``folds`` (cached — folds repeat across reads)."""
+        np = self.np
+        if folds in self._gen_cache:
+            return self._gen_cache[folds]
+        base = self.base_at(folds[:-1])
+        lo = folds[-2] if len(folds) > 1 else 0
+        tomb = set()
+        ins = []
+        for _s, op, payload in self._window(lo, folds[-1]):
+            if op == "insert":
+                ins.append(payload)
+            else:
+                tomb.update(payload)
+        delta = (np.concatenate(ins) if ins
+                 else np.zeros((0, base.shape[1]), np.float32))
+        base_n = base.shape[0]
+        keep_base = [p for p in range(base_n) if p not in tomb]
+        keep_delta = [j for j in range(delta.shape[0])
+                      if base_n + j not in tomb]
+        folded = np.concatenate([base[keep_base], delta[keep_delta]])
+        self._gen_cache[folds] = folded
+        return folded
+
+    def expect(self, folds: "tuple[int, ...]", seq: int, queries):
+        """The bit-exact answer the live view at ``seq`` (over the
+        generation ``folds`` names) must serve."""
+        import numpy as np
+
+        from knn_tpu.backends.oracle import oracle_kneighbors
+        from knn_tpu.mutable.state import MutableView, merge_candidates
+
+        base = self.base_at(folds)
+        lo = folds[-1] if folds else 0
+        ins, tomb = [], set()
+        for _s, op, payload in self._window(lo, seq):
+            if op == "insert":
+                ins.append(payload)
+            else:
+                tomb.update(payload)
+        delta = (np.concatenate(ins) if ins
+                 else np.zeros((0, base.shape[1]), np.float32))
+        count = delta.shape[0]
+        base_n = base.shape[0]
+        q = np.asarray(queries, np.float32)
+        base_d, base_i = oracle_kneighbors(base, q, self.k, self.metric)
+        if count == 0 and not tomb:
+            return np.asarray(base_d, np.float32), np.asarray(base_i)
+        view = MutableView(
+            features=delta, values=np.zeros(count, np.float32),
+            stable=np.zeros(count, np.int64), count=count,
+            tomb_pos=frozenset(tomb),
+            tomb_base=np.array(sorted(p for p in tomb if p < base_n),
+                               np.int64),
+            tomb_delta_slots=np.array(
+                sorted(p - base_n for p in tomb if p >= base_n), np.int64),
+            seq=seq, base_n=base_n, generation=len(folds),
+        )
+        d, i = merge_candidates(
+            view, q, base_d, base_i, self.k, self.metric,
+            lambda f, kw: oracle_kneighbors(base, f, kw, self.metric),
+        )
+        return np.asarray(d, np.float32), np.asarray(i)
+
+    def verify_reads(self, reads, version_folds, where: str):
+        """``reads``: (instances, seq, version, distances, indices);
+        ``version_folds``: index_version -> folds tuple. Returns the
+        list of violation strings (empty = every read bit-identical)."""
+        import numpy as np
+
+        bad = []
+        for n, (inst, seq, version, dists, idx) in enumerate(reads):
+            if version not in version_folds:
+                bad.append(f"{where} read {n}: unknown index_version "
+                           f"{version!r}")
+                continue
+            want_d, want_i = self.expect(version_folds[version], seq, inst)
+            got_d = np.asarray(dists, np.float64).astype(np.float32)
+            got_i = np.asarray(idx, np.int64)
+            # Indices BIT-identical (the selection/tie-order truth, the
+            # same contract every ladder rung is pinned to); distances
+            # within float32 ulp of the replay (the rung distance FORMS
+            # differ in the last ulp — tests/test_serve_resilience.py's
+            # degrades_with_identical_indices is the existing precedent).
+            if not (np.array_equal(got_i, want_i)
+                    and np.allclose(got_d, want_d.astype(np.float32),
+                                    rtol=1e-5, atol=1e-5)):
+                bad.append(
+                    f"{where} read {n} (seq {seq}, version {version}): "
+                    f"served {got_i.tolist()}/{got_d.tolist()} != replay "
+                    f"{want_i.tolist()}/{want_d.tolist()}")
+                if len(bad) >= 3:
+                    break
+        return bad
+
+
+# -- load generation --------------------------------------------------------
+
+
+class Load:
+    """Concurrent writers + readers against one server; collects the
+    acknowledged history into the mirror and every read for replay."""
+
+    def __init__(self, base, mirror, test_x, num_classes, args, *,
+                 deletes: bool, seed: int):
+        import numpy as np
+
+        self.base = base
+        self.mirror = mirror
+        self.test_x = test_x
+        self.num_classes = num_classes
+        self.args = args
+        self.deletes = deletes
+        self.rng = np.random.default_rng(seed)
+        self.stop = threading.Event()
+        self.lock = threading.Lock()
+        self.reads: list = []
+        self.violations: list = []
+        self.acked_seqs: list = []
+        self.my_live_ids: list = []  # positional ids we may delete
+        self.versions_seen: set = set()
+        self.threads: list = []
+
+    def _writer(self, wid: int):
+        import numpy as np
+
+        rng = np.random.default_rng(self.args.seed * 1000 + wid)
+        d = self.test_x.shape[1]
+        while not self.stop.is_set():
+            do_delete = False
+            if self.deletes:
+                with self.lock:
+                    do_delete = (len(self.my_live_ids) > 4
+                                 and rng.random() < 0.3)
+            try:
+                if do_delete:
+                    with self.lock:
+                        pick = self.my_live_ids.pop(
+                            int(rng.integers(len(self.my_live_ids))))
+                    st, body = http(self.base, "/delete", {"ids": [pick]})
+                    if st == 200:
+                        doc = json.loads(body)
+                        self.mirror.ack(doc["seq"], "delete", [pick])
+                        with self.lock:
+                            self.acked_seqs.append(doc["seq"])
+                    elif st not in (409, 429):
+                        with self.lock:
+                            self.violations.append(
+                                f"delete: status {st}: {body[:160]}")
+                else:
+                    m = int(rng.integers(1, 4))
+                    rows = rng.uniform(0, 4, (m, d)).astype(np.float32)
+                    labels = rng.integers(
+                        0, self.num_classes, m).tolist()
+                    st, body = http(self.base, "/insert",
+                                    {"rows": rows.tolist(),
+                                     "labels": labels})
+                    if st == 200:
+                        doc = json.loads(body)
+                        self.mirror.ack(doc["seq"], "insert", rows)
+                        with self.lock:
+                            self.acked_seqs.append(doc["seq"])
+                            self.my_live_ids.extend(doc["ids"])
+                    elif st not in (429,):
+                        with self.lock:
+                            self.violations.append(
+                                f"insert: status {st}: {body[:160]}")
+            except Exception as e:  # noqa: BLE001 — recorded
+                with self.lock:
+                    self.violations.append(f"writer transport: {e}")
+            time.sleep(0.002)
+
+    def _reader(self, rid: int):
+        import numpy as np
+
+        rng = np.random.default_rng(self.args.seed * 2000 + rid)
+        q = self.test_x.shape[0]
+        r = self.args.rows
+        while not self.stop.is_set():
+            lo = int(rng.integers(0, max(1, q - r)))
+            inst = self.test_x[lo:lo + r]
+            try:
+                st, body = http(self.base, "/kneighbors",
+                                {"instances": inst.tolist()})
+            except Exception as e:  # noqa: BLE001
+                with self.lock:
+                    self.violations.append(f"reader transport: {e}")
+                continue
+            if st != 200:
+                if st == 500:
+                    with self.lock:
+                        self.violations.append(f"read 500: {body[:160]}")
+                continue
+            doc = json.loads(body)
+            if "mutation_seq" not in doc:
+                with self.lock:
+                    self.violations.append(
+                        "a 200 read carried no mutation_seq")
+                continue
+            with self.lock:
+                self.versions_seen.add(doc["index_version"])
+                self.reads.append((np.asarray(inst), doc["mutation_seq"],
+                                   doc["index_version"], doc["distances"],
+                                   doc["indices"]))
+
+    def run_for(self, seconds: float) -> None:
+        self.start()
+        time.sleep(seconds)
+        self.finish()
+
+    def start(self) -> None:
+        self.threads = (
+            [threading.Thread(target=self._writer, args=(w,), daemon=True)
+             for w in range(self.args.writers)]
+            + [threading.Thread(target=self._reader, args=(r,),
+                                daemon=True)
+               for r in range(self.args.readers)])
+        for t in self.threads:
+            t.start()
+
+    def finish(self) -> None:
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=90)
+            if t.is_alive():
+                self.violations.append("a load thread hung")
+
+
+def wait_seq_visible(base, want_seq: int, timeout_s=30) -> dict:
+    deadline = time.monotonic() + timeout_s
+    blk = {}
+    while time.monotonic() < deadline:
+        blk = healthz(base).get("mutable") or {}
+        if blk.get("seq", -1) >= want_seq:
+            return blk
+        time.sleep(0.2)
+    return blk
+
+
+def main() -> int:
+    args = parse_args()
+    from bench import _load_medium  # noqa: E402 — repo-root import
+    from knn_tpu.serve.artifact import load_index
+
+    train, test = _load_medium()
+    d = Path(__file__).parent.parent / "build" / "fixtures"
+    ref = Path("/root/reference/datasets")
+    train_arff = str((ref if ref.exists() else d) / "medium-train.arff")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KNN_TPU_RETRY_BASE_MS="0")
+    report = {"mutable_soak": {
+        "train_rows": train.num_instances, "writers": args.writers,
+        "readers": args.readers, "rows_per_read": args.rows,
+        "window_s": args.window_s, "faults": args.faults,
+    }}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        index = os.path.join(tmp, "index")
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index, "--k", "5"],
+            env=env, capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: {build.stderr}")
+        model = load_index(index)
+
+        # ---- phase 1: oracle replay under the chaos fault burst ----------
+        env1 = dict(env, KNN_TPU_FAULTS=f"serve.dispatch={args.faults}:"
+                                        f"device",
+                    KNN_TPU_FAULT_SEED=str(args.seed))
+        proc, base = boot(index, env1)
+        if base is None:
+            return fail(f"phase-1 serve: no ready banner "
+                        f"(rc={proc.poll()})", proc)
+        v0 = healthz(base)["index_version"]
+        mirror = Mirror(model.train_.features, model.k, model.metric)
+        load = Load(base, mirror, test.features, train.num_classes, args,
+                    deletes=True, seed=args.seed)
+        load.run_for(args.window_s)
+        if load.violations:
+            return fail(f"phase-1 violations: {load.violations[:3]}", proc)
+        max_seq = max(load.acked_seqs, default=0)
+        blk = wait_seq_visible(base, max_seq)
+        if blk.get("seq", -1) < max_seq:
+            return fail(f"acknowledged seq {max_seq} never became visible "
+                        f"(healthz seq {blk.get('seq')})", proc)
+        if len(load.reads) < 20 or max_seq < 10:
+            return fail(f"too little load to trust the verdict "
+                        f"({len(load.reads)} reads, {max_seq} mutations)",
+                        proc)
+        bad = mirror.verify_reads(load.reads, {v0: ()}, "phase-1")
+        if bad:
+            return fail("; ".join(bad), proc)
+        fresh = blk.get("freshness") or {}
+        p99 = fresh.get("p99_ms")
+        if p99 is None or p99 > args.freshness_p99_ms:
+            return fail(f"freshness p99 {p99} ms over the "
+                        f"{args.freshness_p99_ms} ms bound "
+                        f"({fresh.get('count')} writes)", proc)
+        rc = shutdown(proc)
+        if rc != 0:
+            return fail(f"phase-1 serve exited rc={rc}")
+        report["phase1"] = {
+            "reads_verified": len(load.reads),
+            "mutations": max_seq,
+            "tombstones": blk.get("tombstones"),
+            "delta_rows": blk.get("delta_rows"),
+            "freshness_p99_ms": p99,
+        }
+        print(f"mutable-soak: phase 1 ok — {len(load.reads)} reads "
+              f"bit-identical to the replay of {max_seq} mutations under "
+              f"the fault burst; freshness p99 {p99} ms")
+
+        # ---- phase 2: atomic compaction swap under load ------------------
+        index2 = os.path.join(tmp, "index2")
+        subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index2, "--k", "5"],
+            env=env, capture_output=True, text=True, cwd=REPO, check=True)
+        proc, base = boot(index2, env)
+        if base is None:
+            return fail(f"phase-2 serve: no ready banner "
+                        f"(rc={proc.poll()})", proc)
+        v0 = healthz(base)["index_version"]
+        mirror = Mirror(model.train_.features, model.k, model.metric)
+        load = Load(base, mirror, test.features, train.num_classes, args,
+                    deletes=False, seed=args.seed + 1)
+        load.start()
+        time.sleep(args.window_s / 3)
+        st, body = http(base, "/admin/compact", {}, timeout=300)
+        if st != 200:
+            load.finish()
+            return fail(f"/admin/compact under load: status {st}: "
+                        f"{body[:200]}", proc)
+        compact = json.loads(body)
+        v1 = compact["index_version"]
+        time.sleep(args.window_s / 3)
+        load.finish()
+        if load.violations:
+            return fail(f"phase-2 violations: {load.violations[:3]}", proc)
+        stray = load.versions_seen - {v0, v1}
+        if stray:
+            return fail(f"responses carried version(s) {sorted(stray)} — "
+                        f"neither the old {v0} nor the new {v1} "
+                        f"(the swap was not atomic)", proc)
+        if v0 not in load.versions_seen or v1 not in load.versions_seen:
+            return fail(f"the swap was not observed under load (saw "
+                        f"{sorted(load.versions_seen)}; wanted both {v0} "
+                        f"and {v1})", proc)
+        max_seq = max(load.acked_seqs, default=0)
+        blk = wait_seq_visible(base, max_seq)
+        folded = int(blk.get("folded_seq", -1))
+        if blk.get("seq", -1) < max_seq:
+            return fail(f"phase-2: acked seq {max_seq} not visible after "
+                        f"the swap (healthz {blk.get('seq')}) — a "
+                        f"mid-compaction write was lost", proc)
+        try:
+            bad = mirror.verify_reads(
+                load.reads, {v0: (), v1: (folded,)}, "phase-2")
+        except KeyError as e:
+            return fail(f"phase-2 replay hole: {e}", proc)
+        if bad:
+            return fail("; ".join(bad), proc)
+        rc = shutdown(proc)
+        if rc != 0:
+            return fail(f"phase-2 serve exited rc={rc}")
+        old_reads = sum(1 for r in load.reads if r[2] == v0)
+        report["phase2"] = {
+            "reads_verified": len(load.reads),
+            "reads_old_version": old_reads,
+            "reads_new_version": len(load.reads) - old_reads,
+            "mutations": max_seq, "folded_seq": folded,
+            "compaction_ms": compact.get("ms"),
+        }
+        print(f"mutable-soak: phase 2 ok — swap atomic under load "
+              f"({old_reads} reads on {v0}, "
+              f"{len(load.reads) - old_reads} on {v1}, all bit-identical "
+              f"across the fold at seq {folded})")
+
+        # ---- phase 3: rollback, then ---- phase 4: kill + recover --------
+        index3 = os.path.join(tmp, "index3")
+        subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index3, "--k", "5"],
+            env=env, capture_output=True, text=True, cwd=REPO, check=True)
+        env3 = dict(env, KNN_TPU_FAULTS="mutable.compact=once")
+        proc, base = boot(index3, env3)
+        if base is None:
+            return fail(f"phase-3 serve: no ready banner "
+                        f"(rc={proc.poll()})", proc)
+        v0 = healthz(base)["index_version"]
+        mirror = Mirror(model.train_.features, model.k, model.metric)
+        import numpy as np
+
+        rng = np.random.default_rng(args.seed)
+        dim = test.features.shape[1]
+        for _ in range(5):
+            rows = rng.uniform(0, 4, (2, dim)).astype(np.float32)
+            st, body = http(base, "/insert", {
+                "rows": rows.tolist(),
+                "labels": rng.integers(0, train.num_classes, 2).tolist()})
+            if st != 200:
+                return fail(f"phase-3 insert: status {st}", proc)
+            mirror.ack(json.loads(body)["seq"], "insert", rows)
+        st, body = http(base, "/admin/compact", {}, timeout=300)
+        doc = json.loads(body)
+        if st != 500 or not doc.get("rolled_back"):
+            return fail(f"fault-armed compact: wanted 500 rolled_back, "
+                        f"got {st}: {body[:200]}", proc)
+        if doc.get("index_version") != v0:
+            return fail(f"rollback did not keep {v0} serving "
+                        f"(got {doc.get('index_version')})", proc)
+        blk = healthz(base)["mutable"]
+        if blk["generation"] != 0 or blk["seq"] != 5:
+            return fail(f"rollback corrupted state: {blk}", proc)
+        st, body = http(base, "/kneighbors",
+                        {"instances": test.features[:args.rows].tolist()})
+        doc = json.loads(body)
+        bad = mirror.verify_reads(
+            [(test.features[:args.rows], doc["mutation_seq"],
+              doc["index_version"], doc["distances"], doc["indices"])],
+            {v0: ()}, "post-rollback")
+        if bad:
+            return fail("; ".join(bad), proc)
+        st, body = http(base, "/admin/compact", {}, timeout=300)
+        if st != 200:
+            return fail(f"retry compact after rollback: status {st}: "
+                        f"{body[:200]}", proc)
+        v1 = json.loads(body)["index_version"]
+        f1 = healthz(base)["mutable"]["folded_seq"]
+        print(f"mutable-soak: phase 3 ok — fault-armed compaction rolled "
+              f"back with {v0} serving and every write intact; retry "
+              f"swapped to {v1}")
+        report["phase3"] = {"rolled_back": True, "retry_version": v1}
+
+        # Phase 4: more writes (all acked), then SIGKILL mid-compaction.
+        for _ in range(3):
+            rows = rng.uniform(0, 4, (2, dim)).astype(np.float32)
+            st, body = http(base, "/insert", {
+                "rows": rows.tolist(),
+                "labels": rng.integers(0, train.num_classes, 2).tolist()})
+            if st != 200:
+                return fail(f"phase-4 insert: status {st}", proc)
+            mirror.ack(json.loads(body)["seq"], "insert", rows)
+        max_seq = 8  # 5 phase-3 + 3 phase-4 insert requests, one seq each
+        killer = threading.Thread(
+            target=lambda: http(base, "/admin/compact", {}, timeout=10),
+            daemon=True)
+        killer.start()
+        time.sleep(0.05)  # land inside fold/save/warm/swap
+        proc.kill()  # SIGKILL — no drain, no flush beyond the WAL's own
+        proc.wait(timeout=20)
+        proc2, base2 = boot(index3, env)
+        if base2 is None:
+            return fail(f"phase-4 reboot: no ready banner "
+                        f"(rc={proc2.poll()})", proc2)
+        blk = healthz(base2)["mutable"]
+        if blk["seq"] != max_seq:
+            return fail(f"recovery lost acknowledged writes: rebooted seq "
+                        f"{blk['seq']} != acked {max_seq}", proc2)
+        gen = blk["generation"]
+        folds = {1: (f1,), 2: (f1, blk["folded_seq"])}.get(gen)
+        if folds is None:
+            return fail(f"unexpected rebooted generation {gen}", proc2)
+        v2 = healthz(base2)["index_version"]
+        st, body = http(base2, "/kneighbors",
+                        {"instances": test.features[:args.rows].tolist()})
+        if st != 200:
+            return fail(f"phase-4 read: status {st}", proc2)
+        doc = json.loads(body)
+        bad = mirror.verify_reads(
+            [(test.features[:args.rows], doc["mutation_seq"], v2,
+              doc["distances"], doc["indices"])],
+            {v2: folds}, "post-recovery")
+        if bad:
+            return fail("; ".join(bad), proc2)
+        rc = shutdown(proc2)
+        if rc != 0:
+            return fail(f"phase-4 serve exited rc={rc}")
+        kill_point = ("after the commit" if gen == 2
+                      else "before the commit")
+        report["phase4"] = {
+            "killed_mid_compaction": True,
+            "recovered_generation": gen,
+            "kill_landed": kill_point,
+            "acked_seq_recovered": blk["seq"],
+        }
+        print(f"mutable-soak: phase 4 ok — SIGKILL mid-compaction landed "
+              f"{kill_point}; reboot recovered every acknowledged write "
+              f"(seq {blk['seq']}) and replays bit-identical")
+
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.json_out:
+        Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json_out).write_text(out + "\n")
+    print("mutable-soak: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -310,7 +310,11 @@ class TestServeExitCodes:
                       ["--slo-quality-target", "0"],
                       # The cost & capacity knobs (PR 8) keep it too.
                       ["--capacity-window-s", "0"],
-                      ["--capacity-window-s", "4"]):
+                      ["--capacity-window-s", "4"],
+                      # The mutable-tier knobs (PR 10) keep it too.
+                      ["--delta-cap", "0"],
+                      ["--compact-threshold", "0"],
+                      ["--compact-interval-s", "-1"]):
             assert run(["serve", "/irrelevant/index", *extra]) == 2, extra
             assert "error:" in self._err(capsys)
 
@@ -318,6 +322,11 @@ class TestServeExitCodes:
         # argparse choice validation: anything but on/off is usage error.
         assert run(["serve", "/irrelevant/index",
                     "--cost-accounting", "maybe"]) == 2
+        assert "Traceback" not in capsys.readouterr().err
+
+    def test_serve_bad_mutable_choice_exits_2(self, capsys):
+        assert run(["serve", "/irrelevant/index",
+                    "--mutable", "maybe"]) == 2
         assert "Traceback" not in capsys.readouterr().err
 
     def test_serve_missing_positional_exits_2(self, capsys):

@@ -1,0 +1,1091 @@
+"""Mutable-index contract tests (docs/INDEXES.md §Mutable tier).
+
+The load-bearing claims, in dependency order:
+
+1. **Merge correctness** — base+delta+tombstone retrieval equals a brute-
+   force lexicographic top-k over the live view's full candidate matrix,
+   under THE shared (distance, index) contract (models/ordering.py), with
+   tombstone k-coverage widening so answers never come up short.
+2. **Empty-view bit-identity** — a mutable-on server with no mutations
+   runs the EXACT immutable ladder: ``_rungs`` returns the same closures
+   (not wrappers), and every rung's bytes match the mutable-off answer.
+3. **Durability** — every acknowledged mutation is WAL-appended + flushed
+   before the ack; a rebuilt engine replays to the identical view; a torn
+   final record (crash mid-append, never acked) is dropped; corruption
+   anywhere else is a typed :class:`DataError`.
+4. **Compaction** — the fold is a deterministic function of the
+   acknowledged history; the swap+rebase is atomic to dispatch snapshots;
+   any failure before the CURRENT.json commit leaves the old generation
+   serving with zero acknowledged writes lost.
+5. **HTTP mapping** — /insert, /delete, /admin/compact carry the typed
+   status contract (404 off, 400 malformed, 409 conflict, 429 full,
+   200 = durable + visible).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor
+from knn_tpu.models.ordering import lexicographic_topk
+from knn_tpu.mutable.compact import CompactionInProgress, Compactor, fold
+from knn_tpu.mutable.engine import MutableEngine
+from knn_tpu.mutable.state import (
+    MutableView,
+    MutationConflict,
+    merge_candidates,
+    merged_oracle_kneighbors,
+    validate_insert,
+)
+from knn_tpu.resilience.errors import DataError, OverloadError
+from knn_tpu.serve import artifact
+from knn_tpu.serve.artifact import load_index, save_index
+from knn_tpu.serve.batcher import MicroBatcher
+
+
+def _problem(rng, n=200, q=24, d=5, c=4):
+    train_x = rng.integers(0, 4, (n, d)).astype(np.float32)  # grid -> ties
+    train_y = rng.integers(0, c, n).astype(np.int32)
+    test_x = np.concatenate(
+        [train_x[rng.choice(n, q // 2, replace=False)],
+         rng.integers(0, 4, (q - q // 2, d)).astype(np.float32)]
+    )
+    return Dataset(train_x, train_y), test_x
+
+
+def _root(model, tmp_path):
+    """A mutable engine needs a real artifact directory (its WAL and
+    generations live inside one); reuse it across engines in a test."""
+    out = tmp_path / "idx"
+    if not (out / "manifest.json").exists():
+        save_index(model, out)
+    return out
+
+
+def _engine(model, root, **kw):
+    kw.setdefault("delta_cap", 256)
+    return MutableEngine(model, root, **kw)
+
+
+def _brute_force_view(model, view, queries, k):
+    """Independent re-derivation of the merge contract: full distance
+    matrix over [base; delta], tombstoned positional ids masked, one
+    lexicographic top-k."""
+    from knn_tpu.backends.oracle import _metric_dists
+
+    train = model.train_
+    full = np.concatenate(
+        [train.features, np.asarray(view.features[:view.count])])
+    d = np.asarray(_metric_dists(np.asarray(queries, np.float32), full,
+                                 model.metric), np.float64)
+    np.nan_to_num(d, copy=False, nan=np.inf)
+    ids = np.broadcast_to(np.arange(full.shape[0], dtype=np.int64),
+                          d.shape).copy()
+    for p in view.tomb_pos:
+        d[:, p] = np.inf
+        ids[:, p] = view.sentinel
+    return lexicographic_topk(d, ids, k)
+
+
+class TestMergeContract:
+    def test_merged_oracle_matches_brute_force(self, rng, tmp_path):
+        """Random inserts + deletes: the production merge (widening path
+        included) equals the brute-force lexicographic truth."""
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=4, engine="xla").fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            for lo in range(0, 24, 6):
+                eng.apply_insert(
+                    rng.integers(0, 4, (6, 5)).astype(np.float32),
+                    rng.integers(0, 4, 6), 0)
+            # Delete base rows that ARE someone's neighbor (forces the
+            # widening) plus a couple of delta rows.
+            _, base_i = model.kneighbors(
+                Dataset(test_x, np.zeros(len(test_x), np.int32)))
+            victims = sorted({int(base_i[0, 0]), int(base_i[3, 0]),
+                              int(base_i[7, 1]), 200 + 2, 200 + 11})
+            eng.apply_delete(victims, 0)
+            view = eng.snapshot()
+            got_d, got_i = merged_oracle_kneighbors(model, view, test_x)
+            want_d, want_i = _brute_force_view(model, view, test_x, model.k)
+            np.testing.assert_array_equal(got_i, want_i)
+            np.testing.assert_array_equal(
+                got_d.astype(np.float32), want_d.astype(np.float32))
+            for v in victims:
+                assert not (got_i == v).any()
+        finally:
+            eng.close()
+
+    def test_tie_order_base_beats_delta(self, rng, tmp_path):
+        """A delta row duplicating a base row loses the distance tie to
+        the lower positional id — THE (distance, index) contract."""
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            dup = train.features[17].copy()
+            eng.apply_insert(dup[None, :], [1], 0)
+            got_d, got_i = merged_oracle_kneighbors(
+                model, eng.snapshot(), dup[None, :])
+            row = got_i[0].tolist()
+            assert 17 in row and 200 in row
+            assert row.index(17) < row.index(200)
+            assert got_d[0][row.index(200)] == 0.0
+        finally:
+            eng.close()
+
+    def test_widening_never_returns_short_or_dead(self, rng, tmp_path):
+        """Delete a query's ENTIRE base top-k: the answer still has k
+        live rows and none of the dead ones."""
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            q = test_x[:1]
+            _, base_i = model.kneighbors(Dataset(q, np.zeros(1, np.int32)))
+            victims = [int(v) for v in base_i[0]]
+            eng.apply_delete(victims, 0)
+            view = eng.snapshot()
+            got_d, got_i = merged_oracle_kneighbors(model, view, q)
+            assert got_i.shape == (1, 3)
+            assert np.isfinite(got_d).all()
+            assert not np.isin(got_i, victims).any()
+            want_d, want_i = _brute_force_view(model, view, q, model.k)
+            np.testing.assert_array_equal(got_i, want_i)
+        finally:
+            eng.close()
+
+    def test_nan_query_masked_slots_rank_last(self, rng, tmp_path):
+        """A NaN query makes every real distance +inf; masked slots must
+        still rank after real rows (the sentinel-id rule), so the answer
+        is live rows in index order."""
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            eng.apply_delete([0, 1], 0)
+            q = np.full((1, 5), np.nan, np.float32)
+            _, got_i = merged_oracle_kneighbors(model, eng.snapshot(), q)
+            assert got_i[0].tolist() == [2, 3, 4]
+        finally:
+            eng.close()
+
+    def test_regressor_merge_votes_with_delta_targets(self, rng, tmp_path):
+        """A delta neighbor contributes its OWN target, not a clamped
+        base row's (the predict_from_view gather)."""
+        from knn_tpu.mutable.state import predict_from_view
+
+        train, _ = _problem(rng)
+        reg_train = Dataset(
+            train.features, train.labels,
+            raw_targets=np.linspace(0, 1, 200).astype(np.float32))
+        model = KNNRegressor(k=2, engine="xla").fit(reg_train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            q = np.full((1, 5), 77.0, np.float32)  # far from the grid
+            eng.apply_insert(np.full((2, 5), 77.0, np.float32),
+                             [5.0, 7.0], 0)
+            view = eng.snapshot()
+            d, i = merged_oracle_kneighbors(model, view, q)
+            assert sorted(i[0].tolist()) == [200, 201]
+            pred = predict_from_view(model, view, d, i)
+            np.testing.assert_allclose(pred, [6.0], atol=1e-6)
+        finally:
+            eng.close()
+
+    def test_validate_insert_typed_errors(self, rng):
+        train, _ = _problem(rng)
+        clf = KNNClassifier(k=3).fit(train)
+        with pytest.raises(ValueError, match=r"\[m, 5\]"):
+            validate_insert(clf, [[1.0, 2.0]], [1])
+        with pytest.raises(ValueError, match="empty insert"):
+            validate_insert(clf, np.empty((0, 5), np.float32), [])
+        with pytest.raises(ValueError, match="one label per row"):
+            validate_insert(clf, np.ones((2, 5), np.float32), [1])
+        with pytest.raises(ValueError, match="integers"):
+            validate_insert(clf, np.ones((1, 5), np.float32), [1.5])
+        with pytest.raises(ValueError, match="rebuild the index"):
+            validate_insert(clf, np.ones((1, 5), np.float32), [99])
+        reg = KNNRegressor(k=3).fit(
+            Dataset(train.features, train.labels,
+                    raw_targets=np.zeros(200, np.float32)))
+        with pytest.raises(ValueError, match="finite"):
+            validate_insert(reg, np.ones((1, 5), np.float32), [np.nan])
+
+
+class TestEngine:
+    def test_snapshot_is_immutable_under_growth(self, rng, tmp_path):
+        """A held view keeps reading its frozen prefix even after enough
+        inserts to trigger amortized-doubling reallocation."""
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path), delta_cap=512)
+        try:
+            eng.apply_insert(np.full((2, 5), 1.0, np.float32), [0, 1], 0)
+            view = eng.snapshot()
+            frozen = view.features[:view.count].copy()
+            eng.apply_insert(
+                rng.integers(0, 4, (200, 5)).astype(np.float32),
+                rng.integers(0, 4, 200), 0)  # forces 64 -> 256 growth
+            assert view.count == 2
+            np.testing.assert_array_equal(view.features[:2], frozen)
+            assert eng.snapshot().count == 202
+        finally:
+            eng.close()
+
+    def test_delta_cap_is_backpressure(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path), delta_cap=3)
+        try:
+            eng.apply_insert(np.ones((3, 5), np.float32), [0, 1, 2], 0)
+            with pytest.raises(OverloadError, match="delta tier full"):
+                eng.apply_insert(np.ones((1, 5), np.float32), [0], 0)
+            # Admission-side pre-check: a full tier refuses at
+            # submit_mutation, before the queue round-trip.
+            b = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                             mutable=eng)
+            try:
+                with pytest.raises(OverloadError, match="delta tier full"):
+                    b.submit_mutation(
+                        "insert", {"rows": np.ones((1, 5), np.float32),
+                                   "values": [0]})
+                assert not b._mutations  # never enqueued
+            finally:
+                b.close()
+            # The refusal is not durable: a reboot replays 3 rows, not 4.
+            eng.close()
+            eng2 = _engine(model, _root(model, tmp_path), delta_cap=3)
+            assert eng2.snapshot().count == 3
+            eng2.close()
+        finally:
+            eng.close()
+
+    def test_delete_conflicts_are_typed(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            with pytest.raises(MutationConflict, match="no such row"):
+                eng.apply_delete([9999], 0)
+            with pytest.raises(MutationConflict, match="duplicate id"):
+                eng.apply_delete([5, 5], 0)
+            eng.apply_delete([5], 0)
+            with pytest.raises(MutationConflict, match="already deleted"):
+                eng.apply_delete([5], 0)
+            with pytest.raises(ValueError, match="empty delete"):
+                eng.apply_delete([], 0)
+        finally:
+            eng.close()
+
+    def test_k_floor_refusal_leaves_wal_untouched(self, rng, tmp_path):
+        """A delete that would leave < k live rows is refused BEFORE the
+        WAL append — replay must not re-apply a never-acked mutation."""
+        train = Dataset(np.eye(4, dtype=np.float32)[:, :3].copy(),
+                        np.zeros(4, np.int32))
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            with pytest.raises(MutationConflict, match="below k"):
+                eng.apply_delete([0, 1], 0)
+            eng.apply_delete([0], 0)  # leaves exactly k=3
+            eng.close()
+            eng2 = _engine(model, _root(model, tmp_path))
+            view = eng2.snapshot()
+            assert view.tomb_pos == frozenset({0})
+            eng2.close()
+        finally:
+            eng.close()
+
+    def test_replay_rebuilds_identical_state(self, rng, tmp_path):
+        """SIGKILL semantics: a fresh engine over the same directory
+        replays the epoch log to the identical view, and continues the
+        stable-id sequence (no id reuse)."""
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        rows = rng.integers(0, 4, (5, 5)).astype(np.float32)
+        eng.apply_insert(rows, [0, 1, 2, 3, 0], 0)
+        eng.apply_delete([201, 17], 0)
+        before = eng.snapshot()
+        d0, i0 = merged_oracle_kneighbors(model, before, test_x)
+        eng.close()  # the process "dies"; the WAL is the truth
+
+        eng2 = _engine(model, _root(model, tmp_path))
+        try:
+            after = eng2.snapshot()
+            assert after.seq == before.seq
+            assert after.count == before.count
+            assert after.tomb_pos == before.tomb_pos
+            np.testing.assert_array_equal(
+                after.features[:after.count], before.features[:before.count])
+            d1, i1 = merged_oracle_kneighbors(model, after, test_x)
+            np.testing.assert_array_equal(i0, i1)
+            ack = eng2.apply_insert(rows[:1], [1], 0)
+            assert ack["seq"] == before.seq + 1
+            assert eng2.snapshot().stable[after.count] == 205
+        finally:
+            eng2.close()
+
+    def test_torn_final_record_dropped_with_warning(self, rng, tmp_path,
+                                                    capsys):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+        eng.close()
+        log = artifact.epoch_path(tmp_path / "idx", 1)
+        with open(log, "a") as f:
+            f.write('{"seq": 2, "op": "insert", "ro')  # crash mid-append
+        eng2 = _engine(model, _root(model, tmp_path))
+        try:
+            assert eng2.snapshot().seq == 1
+            assert eng2.snapshot().count == 1
+            assert "torn final record" in capsys.readouterr().out
+        finally:
+            eng2.close()
+        # The replay REPAIRED the log: epoch-1 is no longer the last file
+        # (boot 2 opened epoch-2) so it gets no torn-tolerance — without
+        # the repair, boot 3 would refuse a state boot 2 accepted.
+        assert '"seq": 2' not in log.read_text()
+        assert artifact.read_epoch_records(log) == ([json.loads(
+            log.read_text().splitlines()[0])], False)
+        eng3 = _engine(model, _root(model, tmp_path))
+        try:
+            assert eng3.snapshot().seq == 1
+            assert eng3.snapshot().count == 1
+        finally:
+            eng3.close()
+
+    def test_corrupt_mid_log_is_typed(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+        eng.close()
+        log = artifact.epoch_path(tmp_path / "idx", 1)
+        good = log.read_text()
+        log.write_text("GARBAGE\n" + good)
+        with pytest.raises(DataError, match="corrupt epoch-log record"):
+            _engine(model, _root(model, tmp_path))
+
+    def test_non_monotonic_seq_is_typed(self, rng, tmp_path):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+        eng.close()
+        log = artifact.epoch_path(tmp_path / "idx", 1)
+        log.write_text(log.read_text() * 2)  # seq 1 twice
+        with pytest.raises(DataError, match="not seq-monotonic"):
+            _engine(model, _root(model, tmp_path))
+
+    def test_freshness_and_export_fields(self, rng, tmp_path, obs_on):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            import time
+
+            eng.apply_insert(np.ones((2, 5), np.float32), [0, 1],
+                             time.monotonic_ns())
+            eng.apply_delete([0], time.monotonic_ns())
+            doc = eng.export()
+            assert doc["delta_rows"] == 2 and doc["delta_slots"] == 2
+            assert doc["tombstones"] == 1 and doc["seq"] == 2
+            assert doc["freshness"]["count"] == 2
+            assert doc["freshness"]["p99_ms"] is not None
+            names = {i.name for i in obs_on.instruments()}
+            assert {"knn_mutable_delta_rows", "knn_mutable_tombstones",
+                    "knn_mutable_freshness_ms",
+                    "knn_mutable_mutations_total"} <= names
+        finally:
+            eng.close()
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+class TestCompaction:
+    def _artifact_engine(self, rng, tmp_path, *, ivf_cells=None, k=3):
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=k, engine="xla").fit(train)
+        ivf = None
+        if ivf_cells:
+            from knn_tpu.index.ivf import IVFIndex
+
+            ivf = IVFIndex.build(train.features, ivf_cells, seed=0)
+            model.ivf_ = ivf
+        root = save_index(model, tmp_path / "idx", ivf=ivf)
+        model = load_index(root)
+        return model, test_x, root
+
+    def _compactor(self, eng, holder, **kw):
+        def swap(new_model, version, rebase_hook):
+            prev = holder.get("version")
+            rebase_hook()
+            holder["model"], holder["version"] = new_model, version
+            return prev
+
+        kw.setdefault("threshold", 10_000)
+        kw.setdefault("interval_s", 0.0)
+        return Compactor(eng, swap=swap,
+                         warm=lambda m: holder.setdefault("warmed", []).
+                         append(m), **kw)
+
+    def test_fold_keeps_survivor_order(self, rng):
+        """Base survivors in position order, then live delta rows in
+        insert order — the deterministic positional space the soak's
+        replay reproduces."""
+        train, _ = _problem(rng, n=6, q=4)
+        base_stable = np.arange(6, dtype=np.int64)
+        fold_input = {
+            "count": 3,
+            "stable": np.array([6, 7, 8], np.int64),
+            "features": rng.integers(0, 4, (3, 5)).astype(np.float32),
+            "values": np.array([1, 2, 3], np.float32),
+            "tomb_stable": frozenset({1, 4, 7}),
+            "seq": 5, "generation": 0,
+        }
+        new_train, new_stable, stats = fold(train, fold_input, base_stable)
+        assert new_stable.tolist() == [0, 2, 3, 5, 6, 8]
+        np.testing.assert_array_equal(new_train.features[:4],
+                                      train.features[[0, 2, 3, 5]])
+        np.testing.assert_array_equal(
+            new_train.features[4:], fold_input["features"][[0, 2]])
+        assert stats == {"base_kept": 4, "base_dropped": 2,
+                         "delta_folded": 2, "delta_dropped": 1, "rows": 6}
+
+    def test_compaction_round_trip_preserves_answers(self, rng, tmp_path):
+        """Fold + swap + rebase: merged answers (distances) are identical
+        before and after, the pointer commits, folded epochs are cleaned,
+        and a rebooted engine resumes from the new generation."""
+        model, test_x, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        holder = {"model": model, "version": "v0"}
+        comp = self._compactor(eng, holder)
+        rows = rng.integers(0, 4, (4, 5)).astype(np.float32)
+        eng.apply_insert(rows, [0, 1, 2, 3], 0)
+        eng.apply_delete([7, 203], 0)
+        before_d, _ = merged_oracle_kneighbors(model, eng.snapshot(),
+                                               test_x)
+        res = comp.run_once(force=True)
+        assert res["compacted"] and res["generation"] == 1
+        assert res["rows"] == 200 + 3 - 1
+        new_model = holder["model"]
+        after_d, after_i = merged_oracle_kneighbors(
+            new_model, eng.snapshot(), test_x)
+        np.testing.assert_array_equal(after_d, before_d)
+        assert eng.snapshot().count == 0  # everything folded
+        cur = artifact.read_current(root)
+        assert cur["generation"] == 1
+        assert artifact.list_epochs(root)[0][0] == 2  # epoch 1 cleaned
+        eng.close()
+
+        # Reboot: CURRENT points at gen-1; nothing left to replay.
+        base_dir, cur = artifact.resolve_mutable_base(root)
+        model2 = load_index(base_dir)
+        eng2 = _engine(model2, root, current=cur, base_dir=base_dir)
+        try:
+            d2, i2 = merged_oracle_kneighbors(model2, eng2.snapshot(),
+                                              test_x)
+            np.testing.assert_array_equal(d2, after_d)
+            np.testing.assert_array_equal(i2, after_i)
+        finally:
+            eng2.close()
+
+    def test_mid_compaction_writes_survive(self, rng, tmp_path):
+        """Writes landing between seal and swap re-anchor onto the new
+        generation — zero acknowledged writes lost."""
+        model, test_x, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        holder = {"model": model, "version": "v0"}
+        late_row = np.full((1, 5), 9.0, np.float32)
+
+        def swap(new_model, version, rebase_hook):
+            # The race: a write is acknowledged AFTER the seal, BEFORE
+            # the swap (it landed in the fresh epoch the seal opened).
+            eng.apply_insert(late_row, [2], 0)
+            rebase_hook()
+            holder["model"], holder["version"] = new_model, version
+            return "v0"
+
+        comp = Compactor(eng, swap=swap, warm=lambda m: None,
+                         threshold=10_000, interval_s=0.0)
+        eng.apply_insert(rng.integers(0, 4, (2, 5)).astype(np.float32),
+                         [0, 1], 0)
+        comp.run_once(force=True)
+        try:
+            view = eng.snapshot()
+            assert view.count == 1  # the late write lives in the new delta
+            np.testing.assert_array_equal(view.features[0], late_row[0])
+            d, i = merged_oracle_kneighbors(
+                holder["model"], view, np.full((1, 5), 9.0, np.float32))
+            assert i[0, 0] == 202 and d[0, 0] == 0.0
+            eng.close()
+            # And it is durable: reboot from the committed pointer.
+            base_dir, cur = artifact.resolve_mutable_base(root)
+            model2 = load_index(base_dir)
+            eng2 = _engine(model2, root, current=cur, base_dir=base_dir)
+            assert eng2.snapshot().count == 1
+            eng2.close()
+        finally:
+            eng.close()
+
+    def test_failed_compaction_rolls_back(self, rng, tmp_path, obs_on):
+        """A failure before the commit leaves the old generation serving,
+        the sealed epoch's records on disk, and the engine answering with
+        every acknowledged mutation."""
+        model, test_x, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        holder = {"model": model, "version": "v0"}
+
+        def bad_swap(new_model, version, rebase_hook):
+            raise RuntimeError("synthetic swap failure")
+
+        comp = Compactor(eng, swap=bad_swap, warm=lambda m: None,
+                         threshold=10_000, interval_s=0.0)
+        eng.apply_insert(np.full((1, 5), 9.0, np.float32), [2], 0)
+        before = merged_oracle_kneighbors(model, eng.snapshot(), test_x)
+        with pytest.raises(RuntimeError, match="synthetic swap failure"):
+            comp.run_once(force=True)
+        try:
+            assert artifact.read_current(root) is None  # never committed
+            after = merged_oracle_kneighbors(model, eng.snapshot(), test_x)
+            np.testing.assert_array_equal(after[0], before[0])
+            assert eng._last_compaction["outcome"] == "rolled_back"
+            eng.close()
+            eng2 = _engine(model, root, base_dir=root)
+            assert eng2.snapshot().count == 1  # the write survived
+            eng2.close()
+        finally:
+            eng.close()
+
+    def test_ivf_partition_reassigned(self, rng, tmp_path):
+        """Compacting a partitioned index re-runs cell assignment over
+        the folded rows (same seed — deterministic) and persists it."""
+        model, test_x, root = self._artifact_engine(rng, tmp_path,
+                                                    ivf_cells=8)
+        eng = _engine(model, root, base_dir=root)
+        holder = {"model": model, "version": "v0"}
+        comp = self._compactor(eng, holder)
+        try:
+            eng.apply_insert(rng.integers(0, 4, (5, 5)).astype(np.float32),
+                             [0, 1, 2, 3, 0], 0)
+            res = comp.run_once(force=True)
+            new_model = holder["model"]
+            new_ivf = getattr(new_model, "ivf_", None)
+            assert new_ivf is not None and new_ivf.num_cells == 8
+            gen_model = load_index(
+                artifact.generation_path(root, res["generation"]))
+            assert getattr(gen_model, "ivf_", None) is not None
+            assert gen_model.train_.num_instances == 205
+        finally:
+            eng.close()
+
+    def test_one_compaction_at_a_time(self, rng, tmp_path):
+        model, _, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        comp = self._compactor(eng, {"model": model})
+        try:
+            eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+            assert comp._lock.acquire(blocking=False)
+            try:
+                with pytest.raises(CompactionInProgress):
+                    comp.run_once(force=True)
+            finally:
+                comp._lock.release()
+        finally:
+            eng.close()
+
+    def test_nothing_to_fold_is_a_no_op(self, rng, tmp_path):
+        model, _, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        comp = self._compactor(eng, {"model": model})
+        try:
+            res = comp.run_once(force=True)
+            assert res == {"compacted": False, "reason": "nothing to fold"}
+            assert artifact.read_current(root) is None
+        finally:
+            eng.close()
+
+    def test_version_precondition_checked_at_apply_not_admission(
+            self, rng, tmp_path):
+        """The delete version precondition is enforced by the ENGINE under
+        its own lock (the one the compaction rebase holds) — so a
+        precondition naming the pre-compaction version fails AFTER the
+        swap, where a handler-side check-then-enqueue would have raced."""
+        model, _, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root, version="v0")
+        holder = {"model": model, "version": "v0"}
+        comp = self._compactor(eng, holder)
+        try:
+            eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+            eng.apply_delete([3], 0, expect_version="v0")  # match: ok
+            with pytest.raises(MutationConflict,
+                               match="precondition failed"):
+                eng.apply_delete([4], 0, expect_version="stale")
+            res = comp.run_once(force=True)
+            # The rebase moved the engine's version: the old tag now
+            # fails, the new one passes.
+            with pytest.raises(MutationConflict,
+                               match="precondition failed"):
+                eng.apply_delete([5], 0, expect_version="v0")
+            eng.apply_delete([5], 0,
+                             expect_version=res["index_version"])
+        finally:
+            eng.close()
+
+    def test_failed_rebase_restores_old_model_and_engine(
+            self, rng, tmp_path):
+        """A rebase that raises must leave BOTH halves of the pairing
+        untouched: swap_model restores the old (model, version), and the
+        engine — which validates before its first assignment — still
+        answers with the old generation's state."""
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        root = _root(model, tmp_path)
+        eng = _engine(model, root, version="v0")
+        b = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                         index_version="v0", mutable=eng)
+        try:
+            eng.apply_insert(np.ones((2, 5), np.float32), [1, 0], 0)
+            before = eng.snapshot()
+            fold_input = eng.seal()
+            model2 = KNNClassifier(k=3, engine="xla").fit(train)
+            bad_stable = np.array([5, 3, 1], np.int64)  # not ascending
+            with pytest.raises(DataError, match="not strictly ascending"):
+                b.swap_model(model2, "v1",
+                             hook=lambda: eng.rebase(fold_input, model2,
+                                                     bad_stable, 1,
+                                                     version="v1"))
+            assert b._model is model and b._index_version == "v0"
+            after = eng.snapshot()
+            assert after.count == before.count
+            assert after.base_n == before.base_n
+            assert after.generation == before.generation
+            # The old version still satisfies the precondition — the
+            # engine never moved to "v1".
+            eng.apply_delete([0], 0, expect_version="v0")
+        finally:
+            b.close()
+            eng.close()
+
+    def test_ack_version_is_stamped_under_the_engine_lock(
+            self, rng, tmp_path):
+        """A mutation ack's index_version comes from the ENGINE (same
+        lock the rebase holds), so the ack's positional ids and its
+        version tag always name one generation — a post-apply read of
+        the batcher's tag could pair old-space ids with the new tag and
+        let a delete precondition pass against the wrong rows."""
+        model, _, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root, version="v0")
+        holder = {"model": model, "version": "v0"}
+        comp = self._compactor(eng, holder)
+        try:
+            ack = eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+            assert ack["index_version"] == "v0"
+            res = comp.run_once(force=True)
+            ack2 = eng.apply_delete([3], 0)
+            assert ack2["index_version"] == res["index_version"] != "v0"
+        finally:
+            eng.close()
+
+    def test_leftover_repair_tmp_file_does_not_brick_boot(
+            self, rng, tmp_path):
+        """A crash inside repair_epoch's write-then-replace window leaves
+        epoch-N.jsonl.tmp behind; list_epochs must skip it (the original
+        epoch is intact) instead of refusing to boot the artifact."""
+        model, _, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+        eng.close()
+        stale = artifact.epoch_path(root, 1).with_name(
+            "epoch-00000001.jsonl.tmp")
+        stale.write_text('{"seq": 1, "op": "ins')  # torn repair attempt
+        assert [n for n, _ in artifact.list_epochs(root)] == [1]
+        eng2 = _engine(model, root, base_dir=root)
+        try:
+            assert eng2.snapshot().count == 1
+        finally:
+            eng2.close()
+
+    def test_post_swap_commit_failure_is_not_reported_as_rollback(
+            self, rng, tmp_path, monkeypatch):
+        """A failure AFTER the swap (CURRENT.json commit) means the NEW
+        generation is serving — the outcome must say commit_failed, never
+        rolled_back (an operator acting on 'rolled_back' would reason
+        about the wrong generation)."""
+        from knn_tpu.mutable.compact import CompactionCommitFailed
+
+        model, _, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        holder = {"model": model, "version": "v0"}
+        comp = self._compactor(eng, holder)
+        monkeypatch.setattr(
+            artifact, "write_current",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")))
+        try:
+            eng.apply_insert(np.ones((1, 5), np.float32), [1], 0)
+            with pytest.raises(CompactionCommitFailed,
+                               match="pointer commit failed"):
+                comp.run_once(force=True)
+            assert eng._last_compaction["outcome"] == "commit_failed"
+            assert holder["version"] != "v0"  # the swap DID happen
+            # Reboot-safety: no pointer committed, the sealed epoch is
+            # still on disk — the old base + full replay reconstruct
+            # every acknowledged write.
+            assert artifact.read_current(root) is None
+            eng.close()
+            eng2 = _engine(model, root, base_dir=root)
+            assert eng2.snapshot().count == 1
+            eng2.close()
+        finally:
+            eng.close()
+
+    def test_fold_promotes_fractional_regression_targets(self, rng):
+        """A sketch-less regressor base stores targets as int labels
+        (Dataset.targets falls back); folding fractional acked targets
+        through that dtype would silently change answers — fold must
+        promote to raw_targets instead."""
+        train = Dataset(rng.integers(0, 4, (6, 5)).astype(np.float32),
+                        rng.integers(0, 3, 6).astype(np.int32))
+        assert train.raw_targets is None
+        fold_input = {
+            "count": 2,
+            "stable": np.array([6, 7], np.int64),
+            "features": rng.integers(0, 4, (2, 5)).astype(np.float32),
+            "values": np.array([2.7, -3.25], np.float32),
+            "tomb_stable": frozenset(),
+            "seq": 2, "generation": 0,
+        }
+        new_train, _, _ = fold(train, fold_input,
+                               np.arange(6, dtype=np.int64))
+        np.testing.assert_array_equal(
+            new_train.targets[6:], np.array([2.7, -3.25], np.float32))
+        np.testing.assert_array_equal(new_train.targets[:6],
+                                      train.targets)
+
+    def test_threshold_kick_compacts_without_interval_thread(
+            self, rng, tmp_path):
+        """interval_s == 0 (zero-thread mode): crossing the threshold
+        must still compact — the CLI help promises threshold kicks work
+        without the timer thread."""
+        import time as _time
+
+        model, _, root = self._artifact_engine(rng, tmp_path)
+        eng = _engine(model, root, base_dir=root)
+        holder = {"model": model, "version": "v0"}
+        comp = self._compactor(eng, holder, threshold=2, interval_s=0.0)
+        comp.start()  # no-op at interval 0: no thread to consume kicks
+        assert comp._thread is None
+        try:
+            eng.apply_insert(np.ones((2, 5), np.float32), [1, 0], 0)
+            deadline = _time.monotonic() + 30
+            while comp.compactions == 0 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert comp.compactions == 1
+            assert holder["version"] != "v0"
+            assert eng.snapshot().count == 0  # folded
+        finally:
+            comp.stop()
+            eng.close()
+
+
+class TestEmptyViewBitIdentity:
+    """Acceptance pin: mutable-on serving with an empty delta/tombstone
+    set is byte-identical to mutable-off on EVERY rung."""
+
+    def test_empty_view_skips_the_merge_wrapper(self, rng):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        b = MicroBatcher(model, max_batch=8, max_wait_ms=0.0)
+        try:
+            empty = MutableView(
+                features=np.zeros((0, 5), np.float32),
+                values=np.zeros(0, np.float32),
+                stable=np.zeros(0, np.int64), count=0,
+                tomb_pos=frozenset(), tomb_base=np.empty(0, np.int64),
+                tomb_delta_slots=np.empty(0, np.int64), seq=0,
+                base_n=200, generation=0)
+            plain = b._rungs(model)
+            viewed = b._rungs(model, empty)
+            assert [n for n, _ in plain] == [n for n, _ in viewed]
+            # The closures are the plain rungs, never the merge wrapper.
+            for name, fn in viewed:
+                assert "_merged_rung" not in fn.__qualname__, name
+        finally:
+            b.close()
+
+    def test_every_rung_bit_identical_with_empty_view(self, rng, tmp_path):
+        """Every ladder rung (ivf, fast, xla, oracle) answers the same
+        bytes through a mutable-on batcher with no mutations as through
+        a mutable-off one."""
+        from knn_tpu.index.ivf import IVFIndex, IVFServing
+
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.ivf_ = IVFIndex.build(train.features, 8, seed=0)
+        root = save_index(model, tmp_path / "idx", ivf=model.ivf_)
+        model = load_index(root)
+        eng = _engine(model, root, base_dir=root)
+        ivf_serving = IVFServing(4, 8)
+        b_off = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                             ivf=ivf_serving)
+        b_on = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                            ivf=ivf_serving, mutable=eng)
+        try:
+            view = eng.snapshot()
+            assert view.empty
+            rungs_off = b_off._rungs(model)
+            rungs_on = b_on._rungs(model, view)
+            assert [n for n, _ in rungs_off] == [n for n, _ in rungs_on]
+            assert "ivf" in [n for n, _ in rungs_on]
+            for (name, f_off), (_, f_on) in zip(rungs_off, rungs_on):
+                d0, i0 = f_off(test_x)
+                d1, i1 = f_on(test_x)
+                assert np.asarray(d0).tobytes() == \
+                    np.asarray(d1).tobytes(), name
+                assert np.asarray(i0).tobytes() == \
+                    np.asarray(i1).tobytes(), name
+        finally:
+            b_off.close()
+            b_on.close()
+            eng.close()
+
+    def test_served_bytes_identical_end_to_end(self, rng, tmp_path):
+        """Whole-stack: submit through both batchers, compare the served
+        (dists, idx, preds) byte-for-byte."""
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        root = save_index(model, tmp_path / "idx")
+        model = load_index(root)
+        eng = _engine(model, root, base_dir=root)
+        b_off = MicroBatcher(model, max_batch=8, max_wait_ms=0.0)
+        b_on = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                            mutable=eng)
+        try:
+            d0, i0 = b_off.submit(test_x, "kneighbors").result(60)
+            d1, i1 = b_on.submit(test_x, "kneighbors").result(60)
+            assert d0.tobytes() == d1.tobytes()
+            assert i0.tobytes() == i1.tobytes()
+            p0 = b_off.submit(test_x, "predict").result(60)
+            p1 = b_on.submit(test_x, "predict").result(60)
+            assert np.asarray(p0).tobytes() == np.asarray(p1).tobytes()
+        finally:
+            b_off.close()
+            b_on.close()
+            eng.close()
+
+
+class TestShadowScoringLiveView:
+    def test_stale_answer_burns_recall(self, rng, tmp_path):
+        """A served answer that IGNORED the delta tier (staleness) must
+        score recall < 1 against the live view; the honest merged answer
+        scores exactly 1."""
+        from knn_tpu.obs.quality import ShadowScorer
+
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            q = np.full((2, 5), 50.0, np.float32)  # far from the grid
+            # k=3 delta rows AT the query: the live top-k is delta-only,
+            # so a base-only (stale) answer scores recall 0 even with the
+            # grid's distance ties among base rows.
+            eng.apply_insert(np.full((3, 5), 50.0, np.float32),
+                             [1, 1, 1], 0)
+            view = eng.snapshot()
+            stale_d, stale_i = model.kneighbors(
+                Dataset(q, np.zeros(2, np.int32)))  # base-only: stale
+            fresh_d, fresh_i = merged_oracle_kneighbors(model, view, q)
+            sc = ShadowScorer(1.0, queue_cap=8)
+            for d, i in ((stale_d, stale_i), (fresh_d, fresh_i)):
+                sc.offer(features=q, kind="kneighbors", dists=d, idx=i,
+                         preds=None, rung="fast", model=model,
+                         version="v", mview=view)
+            assert sc.drain(30)
+            sc.close()
+            stats = sc.export()["rungs"]["fast"]
+            assert stats["scored"] == 2
+            # stale scored < 1, fresh scored 1 -> mean strictly between.
+            assert 0.0 < stats["recall"] < 1.0
+        finally:
+            eng.close()
+
+    def test_fresh_answer_scores_exactly_one(self, rng, tmp_path):
+        from knn_tpu.obs.quality import ShadowScorer
+
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            eng.apply_insert(rng.integers(0, 4, (3, 5)).astype(np.float32),
+                             [0, 1, 2], 0)
+            eng.apply_delete([0], 0)
+            view = eng.snapshot()
+            d, i = merged_oracle_kneighbors(model, view, test_x)
+            sc = ShadowScorer(1.0, queue_cap=8)
+            sc.offer(features=test_x, kind="kneighbors", dists=d, idx=i,
+                     preds=None, rung="oracle", model=model, version="v",
+                     mview=view)
+            assert sc.drain(30)
+            sc.close()
+            stats = sc.export()["rungs"]["oracle"]
+            assert stats["recall"] == 1.0
+            assert stats["divergence"] == {}
+        finally:
+            eng.close()
+
+
+class TestMutableHTTP:
+    @pytest.fixture
+    def served_mutable(self, rng, obs_on, tmp_path):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        train, test_x = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        root = save_index(model, tmp_path / "idx")
+        model = load_index(root)
+        app = ServeApp(model, max_batch=16, max_wait_ms=1.0,
+                       index_path=str(root), index_version="v0",
+                       mutable=True, delta_cap=8,
+                       compact_threshold=10_000, compact_interval_s=0.0)
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        app.warm((1, 4))
+        try:
+            yield f"http://{host}:{port}", model, test_x, app
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+            thread.join(timeout=10)
+
+    def _post(self, base, path, payload=None):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(payload if payload is not None else {}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def _get(self, base, path):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(base + path, timeout=30) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_read_after_write_with_sequence_point(self, served_mutable):
+        base, model, test_x, app = served_mutable
+        rows = np.full((3, 5), 9.0, np.float32)  # k rows AT the query
+        st, ack = self._post(base, "/insert",
+                             {"rows": rows.tolist(), "labels": [2, 2, 2]})
+        assert st == 200 and ack["ids"] == [200, 201, 202]
+        assert ack["seq"] == 1
+        st, body = self._post(base, "/kneighbors",
+                              {"instances": rows[:1].tolist()})
+        assert st == 200
+        assert body["mutation_seq"] >= 1
+        assert body["indices"][0] == [200, 201, 202]
+        assert body["distances"][0] == [0.0, 0.0, 0.0]
+        st, body = self._post(base, "/predict",
+                              {"instances": rows[:1].tolist()})
+        assert st == 200 and body["predictions"] == [2]
+
+    def test_typed_status_matrix(self, served_mutable):
+        base, model, test_x, app = served_mutable
+        row = test_x[0].tolist()
+        assert self._post(base, "/insert", {"rows": [[1.0]],
+                                            "labels": [0]})[0] == 400
+        assert self._post(base, "/insert", {"rows": [row]})[0] == 400
+        assert self._post(base, "/insert", {"rows": [row],
+                                            "labels": [99]})[0] == 400
+        assert self._post(base, "/delete", {"ids": [99999]})[0] == 409
+        assert self._post(base, "/delete", {})[0] == 400
+        st, body = self._post(base, "/delete",
+                              {"ids": [0], "index_version": "stale"})
+        assert st == 409 and "precondition" in body["error"]
+        for _ in range(8):  # fill the delta tier (cap 8)
+            self._post(base, "/insert", {"rows": [row], "labels": [1]})
+        st, body = self._post(base, "/insert",
+                              {"rows": [row], "labels": [1]})
+        assert st == 429 and "delta tier full" in body["error"]
+
+    def test_compact_swaps_version_and_preserves_answers(
+            self, served_mutable):
+        base, model, test_x, app = served_mutable
+        self._post(base, "/insert",
+                   {"rows": np.full((2, 5), 9.0).tolist(),
+                    "labels": [1, 2]})
+        self._post(base, "/delete", {"ids": [5]})
+        st, before = self._post(base, "/kneighbors",
+                                {"instances": test_x[:4].tolist()})
+        st, res = self._post(base, "/admin/compact")
+        assert st == 200 and res["compacted"]
+        assert res["index_version"] != "v0"
+        assert res["previous_version"] == "v0"
+        assert app.index_version == res["index_version"]
+        st, after = self._post(base, "/kneighbors",
+                               {"instances": test_x[:4].tolist()})
+        assert st == 200
+        assert after["distances"] == before["distances"]
+        assert after["index_version"] == res["index_version"]
+        # Idempotent trigger with nothing pending.
+        st, res2 = self._post(base, "/admin/compact")
+        assert st == 200 and res2["compacted"] is False
+
+    def test_hot_reload_disabled_under_mutable(self, served_mutable):
+        base, *_ = served_mutable
+        st, body = self._post(base, "/admin/reload", {})
+        assert st == 400
+        assert "compact" in body["error"]
+
+    def test_observability_surfaces(self, served_mutable):
+        base, model, test_x, app = served_mutable
+        self._post(base, "/insert",
+                   {"rows": [test_x[0].tolist()], "labels": [1]})
+        st, body = self._get(base, "/healthz")
+        blk = json.loads(body)["mutable"]
+        assert blk["delta_rows"] == 1 and blk["epoch"] == 1
+        assert blk["freshness"]["count"] == 1
+        st, text = self._get(base, "/metrics")
+        for row in ("knn_mutable_delta_rows 1", "knn_mutable_tombstones 0",
+                    "knn_mutable_freshness_ms", "knn_mutable_epoch"):
+            assert row in text, row
+        st, body = self._get(base, "/debug/capacity")
+        assert json.loads(body)["mutable"]["delta_rows"] == 1
+
+    def test_draining_refuses_mutations_503(self, served_mutable):
+        base, model, test_x, app = served_mutable
+        app.draining = True
+        app.batcher.begin_drain()
+        st, body = self._post(base, "/insert",
+                              {"rows": [test_x[0].tolist()],
+                               "labels": [1]})
+        assert st == 503 and "draining" in body["error"]

@@ -395,6 +395,57 @@ class TestArtifact:
         assert summary["baseline"] == "absent"
         assert summary["scores"] is None
 
+    def test_format_matrix_serves_with_mutable_absent(self, rng, tmp_path):
+        """The artifact back-compat matrix under the mutable tier: format
+        1 (pre-sketch), format 2 (pre-IVF), and format 3 (exact AND
+        partitioned) all load, serve identical answers through a default
+        (immutable) ServeApp, and report the DISTINCT ``mutable: absent``
+        state — None in /healthz, no fabricated freshness numbers, zero
+        ``knn_mutable_*`` instruments."""
+        from knn_tpu.index.ivf import IVFIndex
+        from knn_tpu.serve.server import ServeApp
+
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(test)
+
+        def downgrade(out, fmt):
+            mf = out / "manifest.json"
+            doc = json.loads(mf.read_text())
+            doc["format"] = fmt
+            if fmt < 2:
+                doc.pop("drift_sketch", None)
+            mf.write_text(json.dumps(doc))
+            return out
+
+        ivf = IVFIndex.build(train.features, 8, seed=0)
+        cases = {
+            "format1": downgrade(save_index(model, tmp_path / "f1"), 1),
+            "format2": downgrade(save_index(model, tmp_path / "f2"), 2),
+            "format3": save_index(model, tmp_path / "f3"),
+            "format3-ivf": save_index(model, tmp_path / "f3i", ivf=ivf),
+        }
+        for name, out in cases.items():
+            loaded = load_index(out)
+            np.testing.assert_array_equal(loaded.predict(test), want,
+                                          err_msg=name)
+            app = ServeApp(loaded, max_batch=8, max_wait_ms=0.0,
+                           **({"ivf_probes": 8}
+                              if name == "format3-ivf" else {}))
+            try:
+                app.warm((1,))
+                health = app.health()
+                assert health["mutable"] is None, name
+                got = app.batcher.submit(
+                    test.features[:4], "predict").result(60)
+                np.testing.assert_array_equal(got, want[:4], err_msg=name)
+                assert app.mutable is None and app.compactor is None, name
+                assert app.batcher.mutable is None, name
+            finally:
+                app.close()
+        assert not any(i.name.startswith("knn_mutable_")
+                       for i in obs.registry().instruments())
+
     def test_missing_artifact_typed(self, tmp_path):
         with pytest.raises(DataError, match="not found"):
             load_index(tmp_path / "nope")
