@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak capacity-probe bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak capacity-probe replay-gate bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -148,6 +148,20 @@ mutable-soak:
 capacity-probe:
 	JAX_PLATFORMS=cpu python3 scripts/capacity_probe.py --short \
 		--json-out build/capacity-probe-verdict.json
+
+# The workload replay gate (docs/OBSERVABILITY.md §Workload capture &
+# replay): capture a seeded bursty open-loop workload (reads + an
+# insert/delete stream) against a live in-process mutable serving stack,
+# replay it against a pristine byte-identical twin, and assert — zero
+# read/mutation errors, every replayed mutation on its captured
+# mutation_seq, ZERO answer divergences at matching index_version/
+# mutation_seq (bit-identical digests), and the what-if simulator's
+# predicted p50 for the live policy within the documented band of the
+# measured replay p50. The verdict JSON (including a candidate-policy
+# frontier) lands in build/ (CI uploads it as a workflow artifact).
+replay-gate:
+	JAX_PLATFORMS=cpu python3 scripts/replay_gate.py \
+		--json-out build/replay-gate-verdict.json
 
 bench:
 	python3 bench.py

@@ -1180,6 +1180,91 @@ def bench_serving():
     return record
 
 
+def bench_replay():
+    """The workload-replay subsystem's claim, measured
+    (docs/OBSERVABILITY.md §Workload capture & replay): a committed
+    fixture workload (tests/data/replay-workload — 120 seeded bursty
+    reads over ~2 s) re-drives open-loop against an in-process batcher
+    with its original inter-arrival timing, and the what-if simulator's
+    predicted p50 for the captured policy lands near the measured one.
+
+    Digest verification runs in mode 'always' (the fixture's model is
+    REBUILT from the pinned seed, so its version tag cannot match);
+    divergences are a REPORTED number, not a failure — the fixture's
+    digests are environment-pinned like BENCH_GATE_BASELINE.json, and
+    the strict zero-divergence assertion lives in `make replay-gate`,
+    which captures and replays within one process."""
+    from tests import fixtures
+    from knn_tpu.obs import whatif
+    from knn_tpu.obs.capacity import CapacityTracker
+    from knn_tpu.obs.replay import replay_workload
+    from knn_tpu.obs.workload import load_workload
+    from knn_tpu.serve.artifact import warmup
+    from knn_tpu.serve.batcher import MicroBatcher
+
+    wl = load_workload(fixtures.REPLAY_WORKLOAD_DIR)
+    policy = wl.manifest["policy"]
+    model = fixtures.replay_fixture_model()
+    log(f"replay fixture: {wl.manifest['requests']} requests / "
+        f"{wl.manifest['total_rows']} rows over "
+        f"{wl.manifest['duration_ms']:.0f} ms, policy {policy}")
+    warmup(model, batch_sizes=(1, policy["max_batch"]), kinds=("predict",))
+
+    def run(speed):
+        capacity = CapacityTracker(policy["max_batch"])
+        batcher = MicroBatcher(
+            model, max_batch=policy["max_batch"],
+            max_wait_ms=policy["max_wait_ms"],
+            index_version=fixtures.REPLAY_FIXTURE_VERSION,
+            capacity=capacity,
+        )
+        try:
+            v = replay_workload(wl, batcher=batcher, speed=speed,
+                                verify="always")
+        finally:
+            batcher.close()
+        return v, capacity.export()
+
+    paced, cap_doc = run(speed=1.0)
+    fast, _ = run(speed=0.0)
+    m = paced["measured"]
+    fit = cap_doc["dispatch_model"]
+    sim = None
+    if fit["a_ms"] is not None:
+        sim = whatif.simulate(
+            wl.arrivals(), max_batch=policy["max_batch"],
+            max_wait_ms=policy["max_wait_ms"], a_ms=fit["a_ms"],
+            b_ms_per_row=fit["b_ms_per_row"],
+        )
+    record = {
+        "metric": "replay_paced_p50_ms",
+        "value": m["p50_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "requests": m["requests"],
+        "replay_p50_ms": m["p50_ms"],
+        "replay_p99_ms": m["p99_ms"],
+        "replay_qps": m["qps"],
+        "replay_errors": m["errors"],
+        "captured_p50_ms": paced["captured"]["p50_ms"],
+        "unpaced_qps": fast["measured"]["qps"],
+        "verified": paced["verify"]["verified"],
+        "divergences": paced["verify"]["divergences"],
+        "occupancy_mean": cap_doc["occupancy_mean"],
+        "whatif_p50_ms": sim["p50_ms"] if sim else None,
+        "whatif_abs_err_ms": (round(abs(sim["p50_ms"] - m["p50_ms"]), 3)
+                              if sim and m["p50_ms"] is not None else None),
+        "dispatch_fit": fit,
+    }
+    log(f"replay paced: p50 {m['p50_ms']} ms / p99 {m['p99_ms']} ms "
+        f"({m['qps']} q/s) vs captured p50 "
+        f"{record['captured_p50_ms']} ms; unpaced {record['unpaced_qps']} "
+        f"q/s; verified {record['verified']}, divergences "
+        f"{record['divergences']}; what-if p50 {record['whatif_p50_ms']} "
+        f"ms (|err| {record['whatif_abs_err_ms']} ms)")
+    return record
+
+
 def bench_ivf():
     """The IVF index family's claim, measured (docs/INDEXES.md): probed
     approximate retrieval makes the SERVING dispatch sub-linear in index
@@ -1518,6 +1603,7 @@ _SECONDARY_CONFIGS = {
     "sweepk": bench_sweepk,
     "serving": bench_serving,
     "ivf": bench_ivf,
+    "replay": bench_replay,
 }
 
 # Per-config whitelist of summary fields beyond the universal ones. The
@@ -1548,6 +1634,9 @@ _SUMMARY_EXTRA = {
                 "c8_duty_cycle"),
     "ivf": ("large_speedup_at_recall95", "large_recall", "large_nprobe",
             "large_exact_qps", "medium_speedup_at_recall95"),
+    "replay": ("replay_p50_ms", "replay_qps", "captured_p50_ms",
+               "unpaced_qps", "verified", "divergences", "whatif_p50_ms",
+               "whatif_abs_err_ms"),
 }
 
 

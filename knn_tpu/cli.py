@@ -20,7 +20,12 @@ above is untouched):
 - ``save-index`` — parse a train ARFF once into a versioned index
   artifact (``knn_tpu/serve/artifact.py``);
 - ``serve``      — a long-lived micro-batching HTTP server over such an
-  artifact (``knn_tpu/serve/`` — docs/SERVING.md).
+  artifact (``knn_tpu/serve/`` — docs/SERVING.md);
+- ``replay``     — re-drive a captured workload artifact open-loop
+  against a live server or an in-process batcher, verifying answers
+  bit-identical where ``index_version``/``mutation_seq`` match
+  (``knn_tpu/obs/replay.py`` — docs/OBSERVABILITY.md §Workload capture
+  & replay).
 """
 
 from __future__ import annotations
@@ -50,7 +55,7 @@ EXIT_RUNTIME = 1
 
 # Subcommands (`classify` is implied when argv starts with anything else,
 # keeping the reference's positional invocation byte-compatible).
-_SUBCOMMANDS = ("classify", "serve", "save-index")
+_SUBCOMMANDS = ("classify", "serve", "save-index", "replay")
 
 # persona -> (default backend, usage string modeled on the reference's)
 _PERSONAS = {
@@ -74,7 +79,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native KNN: reference-parity batch classify, "
                     "index building, and a micro-batching server",
     )
-    sub = p.add_subparsers(dest="command", metavar="{classify,serve,save-index}")
+    sub = p.add_subparsers(dest="command",
+                           metavar="{classify,serve,save-index,replay}")
     _add_classify_args(sub.add_parser(
         "classify",
         help="one-shot classify (default; bare positional argv implies it)",
@@ -96,7 +102,68 @@ def build_parser() -> argparse.ArgumentParser:
                     "(arrays.npz + manifest.json) that `knn_tpu serve` "
                     "boots from without re-parsing ARFF.",
     ))
+    _add_replay_args(sub.add_parser(
+        "replay",
+        help="re-drive a captured workload against a live server or an "
+             "in-process batcher and verify the answers "
+             "(docs/OBSERVABILITY.md §Workload capture & replay)",
+        description="Replay a workload artifact (serve --capture-dir / "
+                    "POST /admin/capture) open-loop with its original "
+                    "inter-arrival timing, replay mutations in sequence "
+                    "order, verify answers bit-identical wherever "
+                    "index_version/mutation_seq match the capture, and "
+                    "emit a verdict JSON (p50/p99/QPS, divergence "
+                    "counts, captured-vs-replayed comparison).",
+    ))
     return p
+
+
+def _add_replay_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("workload", help="workload artifact directory "
+                   "(manifest.json + queries.npz + events.jsonl)")
+    target = p.add_mutually_exclusive_group(required=True)
+    target.add_argument("--url", default=None, metavar="BASE_URL",
+                        help="replay against a live server (e.g. "
+                        "http://127.0.0.1:8099)")
+    target.add_argument("--index", default=None, metavar="DIR",
+                        help="replay against an in-process micro-batcher "
+                        "over this index artifact (no HTTP overhead — "
+                        "the mode `make replay-gate` uses)")
+    p.add_argument("--speed", type=float, default=1.0,
+                   help="arrival-clock multiplier: 1 = original "
+                   "inter-arrival timing, 2 = twice as fast, 0 = no "
+                   "pacing (fire as fast as the driver runs)")
+    p.add_argument("--verify", choices=["tag", "always", "off"],
+                   default="tag",
+                   help="answer verification: 'tag' (default) requires "
+                   "bit-identical digests wherever index_version and "
+                   "mutation_seq match the capture; 'always' compares "
+                   "every answered pair (for a rebuilt-but-identical "
+                   "index whose version tag necessarily moved); 'off' "
+                   "skips verification")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="in-process batcher policy (default: the "
+                   "workload's captured policy, else 256)")
+    p.add_argument("--max-wait-ms", type=float, default=None,
+                   help="in-process batcher policy (default: the "
+                   "workload's captured policy, else 2.0)")
+    p.add_argument("--mutable", choices=["on", "off"], default="off",
+                   help="in-process mutation replay: 'on' builds a "
+                   "mutable engine over --index and re-applies the "
+                   "captured insert/delete stream (this WRITES epoch "
+                   "records into the artifact directory — replay into a "
+                   "copy). 'off' (default) skips mutations with a "
+                   "warning; reads still replay, their mutation_seq "
+                   "tags simply won't match")
+    p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
+                   help="force a JAX platform (e.g. cpu, tpu) for the "
+                   "in-process mode")
+    p.add_argument("--verdict-out", default=None, metavar="FILE",
+                   help="write the verdict JSON to FILE (stdout always "
+                   "gets the one-line summary + the JSON)")
+    p.add_argument("--fail-on-divergence", action="store_true",
+                   help="exit 1 when any verified answer diverged "
+                   "(CI-gate mode; default: report and exit 0)")
 
 
 def _add_serve_args(p: argparse.ArgumentParser) -> None:
@@ -217,6 +284,41 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    help="background compaction check interval; 0 "
                    "disables the timer thread (threshold kicks and "
                    "/admin/compact still compact)")
+    p.add_argument("--capture-dir", default=None, metavar="DIR",
+                   help="workload capture (docs/OBSERVABILITY.md "
+                   "§Workload capture & replay): finalized capture "
+                   "windows land versioned workload artifacts under DIR "
+                   "that `knn_tpu replay` re-drives. Windows are armed "
+                   "by POST /admin/capture or the burn trigger below. "
+                   "Omitted (default): zero capture machinery")
+    p.add_argument("--capture-rate", type=float, default=1.0,
+                   help="per-request sampling probability while a "
+                   "capture window is armed (mutations are never "
+                   "sampled — replay needs the complete stream)")
+    p.add_argument("--capture-max-requests", type=int, default=65536,
+                   help="a capture window finalizes itself at this many "
+                   "captured events (bounded memory, bounded artifact)")
+    p.add_argument("--capture-queue", type=int, default=1024,
+                   help="bounded capture sample queue: a full queue "
+                   "sheds records (counted), never blocks serving")
+    p.add_argument("--capture-burn-threshold", type=float, default=None,
+                   metavar="BURN",
+                   help="burn-triggered capture: arm a window "
+                   "automatically when the chosen SLO objective's "
+                   "short-window burn rate exceeds BURN (e.g. 2.0 = "
+                   "burning budget at twice the sustainable rate) — "
+                   "incident forensics at workload granularity. Omitted "
+                   "(default): manual/boot arming only")
+    p.add_argument("--capture-burn-objective",
+                   choices=["availability", "latency", "fast_rung",
+                            "quality"],
+                   default="availability",
+                   help="which SLO objective's burn rate arms the "
+                   "burn-triggered capture")
+    p.add_argument("--capture-burn-window-s", type=float, default=60.0,
+                   help="burn-triggered capture windows auto-stop after "
+                   "this many seconds (or at --capture-max-requests, "
+                   "whichever first)")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -518,6 +620,8 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         return _run_serve(args, stdout)
     if args.command == "save-index":
         return _run_save_index(args, stdout)
+    if args.command == "replay":
+        return _run_replay(args, stdout)
     return _run_classify(args, stdout)
 
 
@@ -659,6 +763,24 @@ def _run_serve(args, stdout) -> int:
         (args.compact_interval_s < 0,
          f"--compact-interval-s must be >= 0, got "
          f"{args.compact_interval_s}"),
+        (not 0 < args.capture_rate <= 1,
+         f"--capture-rate must be in (0, 1], got {args.capture_rate}"),
+        (args.capture_max_requests < 1,
+         f"--capture-max-requests must be >= 1, got "
+         f"{args.capture_max_requests}"),
+        (args.capture_queue < 1,
+         f"--capture-queue must be >= 1, got {args.capture_queue}"),
+        (args.capture_burn_threshold is not None
+         and args.capture_burn_threshold <= 0,
+         f"--capture-burn-threshold must be > 0, got "
+         f"{args.capture_burn_threshold}"),
+        (args.capture_burn_window_s <= 0,
+         f"--capture-burn-window-s must be > 0, got "
+         f"{args.capture_burn_window_s}"),
+        (args.capture_burn_threshold is not None
+         and args.capture_dir is None,
+         "--capture-burn-threshold needs --capture-dir (the trigger "
+         "has nowhere to write its artifact)"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -744,9 +866,16 @@ def _run_serve(args, stdout) -> int:
             compact_interval_s=args.compact_interval_s,
             mutable_current=current,
             mutable_base_dir=base_dir if mutable_on else None,
+            capture_dir=args.capture_dir,
+            capture_rate=args.capture_rate,
+            capture_max_requests=args.capture_max_requests,
+            capture_queue=args.capture_queue,
+            capture_burn_threshold=args.capture_burn_threshold,
+            capture_burn_objective=args.capture_burn_objective,
+            capture_burn_window_s=args.capture_burn_window_s,
         )
-    except OSError as e:  # an unwritable --access-log path
-        print(f"error: --access-log {args.access_log}: {e}", file=sys.stderr)
+    except OSError as e:  # an unwritable --access-log / --capture-dir path
+        print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
     except DataError as e:  # --ivf-probes against an exact-only artifact
         print(f"error: {e}", file=sys.stderr)
@@ -790,6 +919,139 @@ def _run_serve(args, stdout) -> int:
         file=stdout, flush=True,
     )
     return serve_forever(server, drain_timeout_s=args.drain_timeout_s)
+
+
+def _run_replay(args, stdout) -> int:
+    """``knn_tpu replay WORKLOAD (--url BASE | --index DIR)``: re-drive a
+    captured workload and print/write the verdict JSON. A bad workload
+    or index artifact exits 2 (typed, before any compute); a replay that
+    cannot run exits 1; a completed replay exits 0 — unless
+    ``--fail-on-divergence`` and a verified answer diverged."""
+    import json
+
+    from knn_tpu.obs.replay import replay_workload
+    from knn_tpu.obs.workload import load_workload
+    from knn_tpu.resilience.errors import DataError
+
+    for bad, msg in (
+        (args.speed < 0, f"--speed must be >= 0, got {args.speed}"),
+        (args.max_batch is not None and args.max_batch < 1,
+         f"--max-batch must be >= 1, got {args.max_batch}"),
+        (args.max_wait_ms is not None and args.max_wait_ms < 0,
+         f"--max-wait-ms must be >= 0, got {args.max_wait_ms}"),
+        (args.url is not None and args.mutable == "on",
+         "--mutable applies to the in-process --index mode only (a live "
+         "server owns its own mutable engine)"),
+    ):
+        if bad:
+            print(f"error: {msg}", file=sys.stderr)
+            return EXIT_USAGE
+    try:
+        wl = load_workload(args.workload)
+    except DataError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    policy = wl.manifest.get("policy") or {}
+    batcher = None
+    workload_has_mutations = wl.manifest.get("mutations", 0) > 0
+    replay_mutations = True
+    engine = None
+    try:
+        if args.index is not None:
+            if args.platform:
+                err = _apply_platform(args.platform)
+                if err is not None:
+                    print(f"error: {err}", file=sys.stderr)
+                    return EXIT_USAGE
+            from knn_tpu.obs.capacity import CapacityTracker
+            from knn_tpu.serve import artifact
+            from knn_tpu.serve.batcher import MicroBatcher
+
+            try:
+                model = artifact.load_index(args.index)
+                manifest = artifact.read_manifest(args.index)
+                version = artifact.index_version(manifest)
+            except DataError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return EXIT_USAGE
+            if model.train_.num_features != wl.manifest["num_features"]:
+                print(f"error: {args.index}: feature width "
+                      f"{model.train_.num_features} does not match the "
+                      f"workload's {wl.manifest['num_features']} — this "
+                      f"workload was captured against a different schema",
+                      file=sys.stderr)
+                return EXIT_USAGE
+            max_batch = args.max_batch or policy.get("max_batch") or 256
+            max_wait = (args.max_wait_ms
+                        if args.max_wait_ms is not None
+                        else policy.get("max_wait_ms", 2.0))
+            if workload_has_mutations and args.mutable != "on":
+                print("warning: the workload carries "
+                      f"{wl.manifest['mutations']} mutations but "
+                      "--mutable is off; skipping them (reads still "
+                      "replay; their mutation_seq tags will not match)",
+                      file=sys.stderr)
+                replay_mutations = False
+            if args.mutable == "on":
+                from knn_tpu.mutable.engine import MutableEngine
+
+                engine = MutableEngine(model, args.index,
+                                       version=version)
+            capacity = CapacityTracker(max_batch)
+            artifact.warmup(model, batch_sizes=(1, max_batch),
+                            kinds=("predict",))
+            batcher = MicroBatcher(
+                model, max_batch=max_batch, max_wait_ms=max_wait,
+                index_version=version, capacity=capacity,
+                mutable=engine,
+            )
+            verdict = replay_workload(
+                wl, batcher=batcher, speed=args.speed,
+                verify=args.verify, replay_mutations=replay_mutations)
+            verdict["capacity"] = capacity.export()
+        else:
+            # A live target owns its mutable engine; mutations replay
+            # over HTTP (an immutable server surfaces them as typed 404
+            # mutation errors in the verdict).
+            verdict = replay_workload(wl, base_url=args.url.rstrip("/"),
+                                      speed=args.speed, verify=args.verify)
+    except (OSError, RuntimeError, ValueError) as e:
+        print(f"error: replay failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return EXIT_RUNTIME
+    finally:
+        if batcher is not None:
+            batcher.close()
+        if engine is not None:
+            engine.close()
+    m, v = verdict["measured"], verdict["verify"]
+    print(
+        f"replayed {m['requests']} requests "
+        f"({verdict['mutations']['fired']} mutations) at speed "
+        f"{args.speed}: p50 {m['p50_ms']} ms / p99 {m['p99_ms']} ms / "
+        f"{m['qps']} q/s; verified {v['verified']}, divergences "
+        f"{v['divergences']}, tag-mismatch skipped "
+        f"{v['skipped_tag_mismatch']}",
+        file=stdout,
+    )
+    doc = json.dumps(verdict)
+    if args.verdict_out:
+        try:
+            from pathlib import Path
+
+            Path(args.verdict_out).parent.mkdir(parents=True,
+                                                exist_ok=True)
+            with open(args.verdict_out, "w", encoding="utf-8") as f:
+                f.write(doc + "\n")
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return EXIT_RUNTIME
+    print(doc, file=stdout)
+    if args.fail_on_divergence and v["divergences"] > 0:
+        print(f"error: {v['divergences']} verified answer(s) diverged "
+              f"from the capture", file=sys.stderr)
+        return EXIT_RUNTIME
+    return 0
 
 
 def _run_classify(args, stdout) -> int:

@@ -26,6 +26,13 @@ from knn_tpu.data.dataset import Attribute, Dataset
 from knn_tpu.data import pyarff
 
 _CACHE_ENV = "KNN_TPU_ARFF_CACHE"
+# Above this size, silently falling back to the pure-Python parser costs
+# real wall time (~15 MB/s vs the native parser's ~270-300 MB/s — the
+# measured ~19x gap, docs/PARITY.md), so the fallback announces itself
+# once per parse instead of letting a pip-only (no-compiler) install eat
+# it wordlessly on every first load (VERDICT.md #8; the .npz cache only
+# helps repeats).
+_PY_PARSER_WARN_BYTES = 10 * 1024 * 1024
 # Bumped when the cached array schema changes (v2: + raw_targets; v3:
 # + Attribute.string_values for interned STRING/DATE columns), so caches
 # written by older code are simply never found rather than silently read
@@ -124,6 +131,21 @@ def _load_arff(path: str, use_native: Optional[bool] = None) -> Dataset:
             if use_native is True:
                 raise
     if ds is None:
+        if use_native is None:  # wanted native, fell back — say so when
+            try:                # the file is big enough to hurt
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            if size > _PY_PARSER_WARN_BYTES:
+                import sys
+
+                print(
+                    f"warning: {path}: parsing {size / 2**20:.0f} MB with "
+                    f"the pure-Python ARFF parser (~15 MB/s; the native "
+                    f"parser measures ~19x faster — build it with "
+                    f"`make native`, docs/PARITY.md)",
+                    file=sys.stderr,
+                )
         ds = pyarff.parse_arff_file(path)
 
     if cache is not None:

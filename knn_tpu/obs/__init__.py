@@ -52,7 +52,23 @@ pair around the KNN loop printed as a single milliseconds number
   :class:`~knn_tpu.obs.slo.SecondRing`), a Little's-law concurrency
   estimate, and the affine dispatch-cost headroom model behind
   ``GET /debug/capacity`` and ``make capacity-probe``
-  (``knn_capacity_*``).
+  (``knn_capacity_*``);
+- :mod:`knn_tpu.obs.workload` — workload capture: the serving traffic
+  itself (arrival timing, kind/class/rows/deadline/outcome/rung,
+  ``index_version``/``mutation_seq``, the acknowledged mutation stream)
+  recorded through the shed-never-block queue into schema-hash-pinned
+  workload artifacts, armed by ``POST /admin/capture`` or an SLO burn
+  trigger (``knn_workload_*``);
+- :mod:`knn_tpu.obs.replay`  — deterministic open-loop replay of a
+  captured workload against a live server or in-process batcher, with
+  bit-identical answer verification at matching
+  ``index_version``/``mutation_seq`` (the ``knn_tpu replay`` CLI,
+  ``make replay-gate``);
+- :mod:`knn_tpu.obs.whatif`  — a discrete-event simulator of the
+  batcher's admission/coalesce policy over a captured arrival process,
+  costed by the capacity model's fitted ``w(r) = a + b·r`` — candidate
+  policy frontiers (max_batch / max_wait_ms / shape buckets) in
+  milliseconds without booting a server.
 
 Everything is OFF by default and zero-cost when off: ``span()`` returns a
 shared no-op context manager and the metric helpers return immediately, so

@@ -150,13 +150,15 @@ class _Request:
     __slots__ = (
         "features", "kind", "rows", "enqueued_ns", "deadline_ns", "event",
         "value", "error", "meta", "trace", "request_class", "accounting",
+        "workload",
     )
 
     def __init__(self, features: np.ndarray, kind: str,
                  deadline_ns: Optional[int],
                  trace: "Optional[reqtrace.RequestTrace]" = None,
                  request_class: Optional[str] = None,
-                 accounting: "Optional[acct.CostAccountant]" = None):
+                 accounting: "Optional[acct.CostAccountant]" = None,
+                 workload=None):
         self.features = features
         self.kind = kind
         self.rows = features.shape[0]
@@ -169,6 +171,7 @@ class _Request:
         self.trace = trace
         self.request_class = request_class
         self.accounting = accounting
+        self.workload = workload
 
     # -- completion (worker side) -----------------------------------------
 
@@ -185,6 +188,14 @@ class _Request:
                 # error): the per-class outcome counter is what makes a
                 # class's 504s visible next to its device spend.
                 self.accounting.note_outcome(self.request_class, outcome)
+            if self.workload is not None:
+                # Workload capture tap (obs/workload.py): one predicate
+                # while no window is armed; during one, a seeded RNG draw
+                # + an O(1) bounded append — shed when full, NEVER blocks
+                # (the ShedQueue contract). Annotates the trace with the
+                # workload record id so access-log lines and timelines
+                # resolve back to the captured record.
+                self.workload.note_request(self, outcome)
             if self.trace is not None:
                 if self.error is not None:
                     self.trace.annotate(
@@ -290,6 +301,15 @@ class MicroBatcher:
                          retrieval. None (the default, and always for
                          partition-less models) keeps the ladder exact
                          with one ``is None`` predicate.
+    ``workload``       — an optional
+                         :class:`~knn_tpu.obs.workload.WorkloadCapture`:
+                         every terminal request outcome (ok, expired,
+                         error, rejected) and every acknowledged
+                         mutation is offered for workload capture under
+                         the same sampled, shed-on-overload contract —
+                         the replayable traffic record behind
+                         ``knn_tpu replay`` (docs/OBSERVABILITY.md
+                         §Workload capture & replay).
     """
 
     def __init__(self, model, *, max_batch: int = 256,
@@ -297,7 +317,7 @@ class MicroBatcher:
                  index_version: Optional[str] = None,
                  recorder: "Optional[reqtrace.FlightRecorder]" = None,
                  quality=None, drift=None, accounting=None, capacity=None,
-                 ivf=None, mutable=None):
+                 ivf=None, mutable=None, workload=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -322,6 +342,12 @@ class MicroBatcher:
         # merge, one `is None` predicate per call site
         # (scripts/check_disabled_overhead.py pins it).
         self.mutable = mutable
+        # Workload capture (obs/workload.py): an optional
+        # WorkloadCapture. None (the default, and always without
+        # --capture-dir) constructs NOTHING — no queue, no consumer
+        # thread, no per-request work; one `is None` predicate per
+        # terminal outcome (scripts/check_disabled_overhead.py pins it).
+        self.workload = workload
         self._mutations: deque = deque()
         # TEST-ONLY corruption hook (scripts/quality_soak.py): when armed
         # (the serve process installs a SIGUSR2 handler only under
@@ -412,7 +438,8 @@ class MicroBatcher:
             trace = self.recorder.new_trace(kind, x.shape[0])
         req = _Request(x, kind, deadline_ns, trace,
                        request_class=request_class,
-                       accounting=self.accounting)
+                       accounting=self.accounting,
+                       workload=self.workload)
         if trace is not None:
             # Embedded callers learn their id from the future's meta (the
             # HTTP layer already knows it — it minted the trace).
@@ -454,6 +481,11 @@ class MicroBatcher:
                 self.accounting.note_outcome(request_class, "rejected")
             if self.capacity is not None:
                 self.capacity.note_arrival(req.rows)
+            if self.workload is not None:
+                # A refused admission is still workload: an incident
+                # capture without its 429s would replay as lighter load
+                # than the incident actually offered.
+                self.workload.note_request(req, "rejected")
             if trace is not None:
                 trace.annotate(error=f"OverloadError: {e}")
                 trace.finish("rejected")
@@ -751,6 +783,15 @@ class MicroBatcher:
                 # own lock, so the ack's ids and tag name one generation
                 # (reading self._index_version after apply would race a
                 # compaction swap).
+                if self.workload is not None:
+                    # Capture the ACKNOWLEDGED mutation stream (never
+                    # sampled — replay needs it complete for
+                    # mutation_seq alignment; obs/workload.py).
+                    self.workload.note_mutation(
+                        mut.op, mut.payload,
+                        out.get("seq") if isinstance(out, dict) else None,
+                        mut.enqueued_ns,
+                    )
                 mut.succeed(out)
             except BaseException as e:  # noqa: BLE001 — per-future
                 if not mut.event.is_set():

@@ -64,6 +64,12 @@ in ``/healthz`` and ``knn_slo_*`` gauges).
   the background, atomically swap; ANY failure rolls back with the old
   index still serving. 409 while another reload is in flight. ``SIGHUP``
   triggers the same reload from the boot index path.
+- ``POST /admin/capture`` body ``{"action": "start"|"stop"}`` → arm /
+  finalize a workload-capture window (``--capture-dir``; 404 while off,
+  409 on a state contradiction); ``stop`` returns the finalized workload
+  artifact's path + counts. ``GET /debug/capture`` → the capture status
+  (armed window, burn trigger, last artifact; always 200, ``enabled:
+  false`` while off). docs/OBSERVABILITY.md §Workload capture & replay.
 
 Admission control maps the resilience taxonomy to status codes:
 :class:`OverloadError` (bounded queue full) → **429** (**503** while
@@ -168,7 +174,14 @@ class ServeApp:
                  compact_threshold: int = 1024,
                  compact_interval_s: float = 30.0,
                  mutable_current: Optional[dict] = None,
-                 mutable_base_dir=None):
+                 mutable_base_dir=None,
+                 capture_dir: Optional[str] = None,
+                 capture_rate: float = 1.0,
+                 capture_max_requests: int = 65536,
+                 capture_queue: int = 1024,
+                 capture_burn_threshold: Optional[float] = None,
+                 capture_burn_objective: str = "availability",
+                 capture_burn_window_s: float = 60.0):
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -285,12 +298,38 @@ class ServeApp:
             )
         else:
             self.mutable = None
+        # Workload capture (obs/workload.py, docs/OBSERVABILITY.md
+        # §Workload capture & replay): --capture-dir opts in to the
+        # replayable traffic recorder — windows armed by POST
+        # /admin/capture or the SLO burn trigger land versioned workload
+        # artifacts `knn_tpu replay` re-drives. No capture_dir (the
+        # default) constructs NOTHING: no queue, no consumer thread, no
+        # knn_workload_* instruments, no per-request work
+        # (scripts/check_disabled_overhead.py pins it).
+        if capture_dir is not None:
+            from knn_tpu.obs.workload import WorkloadCapture
+
+            self.workload = WorkloadCapture(
+                capture_dir, num_features=model.train_.num_features,
+                k=model.k, rate=capture_rate,
+                max_requests=capture_max_requests,
+                queue_cap=capture_queue, slo=self.slo,
+                burn_threshold=capture_burn_threshold,
+                burn_objective=capture_burn_objective,
+                burn_window_s=capture_burn_window_s,
+                policy={"max_batch": max_batch,
+                        "max_wait_ms": max_wait_ms,
+                        "max_queue_rows": max_queue_rows},
+                index_version=index_version,
+            )
+        else:
+            self.workload = None
         self.batcher = MicroBatcher(
             model, max_batch=max_batch, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, index_version=index_version,
             recorder=self.recorder, quality=self.quality, drift=self.drift,
             accounting=self.accounting, capacity=self.capacity,
-            ivf=self.ivf, mutable=self.mutable,
+            ivf=self.ivf, mutable=self.mutable, workload=self.workload,
         )
         if mutable:
             from knn_tpu.mutable.compact import Compactor
@@ -580,6 +619,10 @@ class ServeApp:
         if self.compactor is not None:
             self.compactor.stop()
         self.batcher.close()
+        if self.workload is not None:
+            # Finalizes any still-armed window first: an incident capture
+            # must survive the shutdown the incident may have caused.
+            self.workload.close()
         if self.mutable is not None:
             self.mutable.close()
         if self.quality is not None:
@@ -623,6 +666,11 @@ class ServeApp:
             # fabricated freshness numbers — while --mutable off.
             "mutable": (self.mutable.export()
                         if self.mutable is not None else None),
+            # The workload-capture status (armed window, burn trigger,
+            # last artifact). None — the distinct "capture: absent"
+            # state — while --capture-dir is unset.
+            "workload": (self.workload.export()
+                         if self.workload is not None else None),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
@@ -749,6 +797,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.app.capacity.export()
             if self.app.mutable is not None:
                 self.app.mutable.export()
+            if self.app.workload is not None:
+                # Refreshes knn_workload_capturing AND completes any
+                # deferred auto-stop finalization (a timed window whose
+                # traffic ceased finalizes on the next scrape).
+                self.app.workload.export()
             accept = self.headers.get("Accept", "")
             if "application/openmetrics-text" in accept:
                 self._send_text(
@@ -767,6 +820,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_quality()
         elif route == "/debug/capacity":
             self._do_capacity()
+        elif route == "/debug/capture":
+            self._do_capture_status()
         elif route == "/debug/profile":
             self._do_profile()
         else:
@@ -825,6 +880,19 @@ class _Handler(BaseHTTPRequestHandler):
                         if self.app.mutable is not None else None),
             "index_version": self.app.index_version,
         }
+        # No request_id stamped into a payload about OTHER requests (the
+        # /debug/requests rule; the response header still carries it).
+        self._send(200, payload, tag_request_id=False)
+
+    def _do_capture_status(self):
+        """The workload-capture status page: window state, counts, the
+        burn trigger, the last finalized artifact. Always 200 — a
+        disabled layer reports ``enabled: false`` rather than 404, so
+        dashboards can hard-code the route (the /debug/quality rule)."""
+        w = self.app.workload
+        payload = {"enabled": w is not None,
+                   **(w.export() if w is not None else {}),
+                   "index_version": self.app.index_version}
         # No request_id stamped into a payload about OTHER requests (the
         # /debug/requests rule; the response header still carries it).
         self._send(200, payload, tag_request_id=False)
@@ -948,6 +1016,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/admin/compact":
             self._do_compact()
             return
+        if self.path == "/admin/capture":
+            self._do_capture_admin()
+            return
         if self.path in ("/insert", "/delete"):
             with self.app.track_request():
                 self._do_mutation(self.path[1:])
@@ -1065,6 +1136,63 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, result)
 
+    def _do_capture_admin(self):
+        """``POST /admin/capture`` body ``{"action": "start"|"stop"}``:
+        arm / finalize a workload-capture window (docs/OBSERVABILITY.md
+        §Workload capture & replay). ``start`` takes optional
+        ``max_requests`` and ``window_s``; ``stop`` returns the finalized
+        artifact summary (path, counts). 404 while ``--capture-dir`` is
+        unset (the layer does not exist), 409 on a state contradiction
+        (start while armed / stop while idle)."""
+        if self.app.workload is None:
+            self.close_connection = True
+            self._send(404, {"error": "workload capture is off — boot "
+                                      "with `serve INDEX --capture-dir "
+                                      "DIR`"})
+            return
+        body, err, status = self._read_json_body(required=True)
+        if err is not None:
+            self.close_connection = True
+            self._send(status, {"error": err})
+            return
+        from knn_tpu.obs.workload import CaptureStateError
+
+        action = body.get("action")
+        try:
+            if action == "start":
+                max_requests = body.get("max_requests")
+                window_s = body.get("window_s")
+                if max_requests is not None:
+                    max_requests = int(max_requests)
+                    if max_requests < 1:
+                        raise ValueError(
+                            f"max_requests must be >= 1, got {max_requests}")
+                if window_s is not None:
+                    window_s = float(window_s)
+                    if not math.isfinite(window_s) or window_s <= 0:
+                        raise ValueError(
+                            f"window_s must be > 0, got {window_s}")
+                result = self.app.workload.start(
+                    reason=str(body.get("reason") or "manual")[:64],
+                    max_requests=max_requests, window_s=window_s)
+            elif action == "stop":
+                result = self.app.workload.stop()
+            else:
+                raise ValueError(
+                    f'unknown action {action!r}: want "start" or "stop"')
+        except CaptureStateError as e:
+            self._send(409, {"error": str(e)})
+            return
+        except (TypeError, ValueError) as e:
+            self._send(400, {"error": f"bad request body: {e}"})
+            return
+        except OSError as e:
+            # The artifact write failed (disk full, permissions): the
+            # capture is lost but the server keeps serving.
+            self._send(500, {"error": f"capture write failed: {e}"})
+            return
+        self._send(200, result)
+
     def _do_reload(self):
         body, err, status = self._read_json_body(required=False)
         if err is not None:
@@ -1130,6 +1258,12 @@ class _Handler(BaseHTTPRequestHandler):
                 entry["class"] = req_class
             if trace is not None:
                 tl = trace.to_dict()
+                if "workload_record" in tl:
+                    # Capture linkage: a replayed divergence on workload
+                    # record N resolves to this line's request_id (and
+                    # the flight-recorder timeline, which carries the
+                    # same annotation).
+                    entry["workload_record"] = tl["workload_record"]
                 phases: dict = {}
                 for p in tl["phases"]:
                     phases[p["phase"]] = round(

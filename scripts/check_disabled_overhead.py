@@ -128,6 +128,15 @@ def main() -> int:
                         "disabled")
         if app.batcher.mutable is not None:
             return fail("the batcher holds a mutable engine while disabled")
+        # Workload capture (PR 11): the default (no --capture-dir /
+        # ServeApp's capture_dir=None) must construct NOTHING — no
+        # recorder, no sample queue, no consumer thread, no
+        # knn_workload_* instruments, no per-request capture work (the
+        # batcher pays one `is None` predicate per terminal outcome).
+        if app.workload is not None or app.batcher.workload is not None:
+            return fail("ServeApp built a workload capture layer with no "
+                        "capture_dir — the layer must not exist while "
+                        "disabled")
         if any("_merged_rung" in fn.__qualname__
                for _name, fn in app.batcher._rungs(app.batcher._model)):
             return fail("the serving ladder wrapped a rung with the "
@@ -137,21 +146,23 @@ def main() -> int:
         app.close()
     bad_threads = [t.name for t in threading.enumerate()
                    if t.name.startswith(("knn-quality", "knn-drift",
-                                         "knn-compactor"))]
+                                         "knn-compactor", "knn-workload"))]
     if bad_threads:
-        return fail(f"quality/drift/compactor worker thread(s) alive while "
-                    f"disabled: {bad_threads}")
+        return fail(f"quality/drift/compactor/workload worker thread(s) "
+                    f"alive while disabled: {bad_threads}")
     leaked = [i.name for i in obs.registry().instruments()
               if i.name.startswith(("knn_quality_", "knn_drift_",
                                     "knn_cost_", "knn_capacity_",
-                                    "knn_ivf_", "knn_mutable_"))]
+                                    "knn_ivf_", "knn_mutable_",
+                                    "knn_workload_"))]
     if leaked:
-        return fail(f"quality/drift/cost/capacity/ivf/mutable "
+        return fail(f"quality/drift/cost/capacity/ivf/mutable/workload "
                     f"instrument(s) recorded while disabled: {leaked}")
-    print("disabled-overhead: quality/drift/cost/capacity/ivf/mutable "
-          "off-state ok (no scorer, no monitor, no accountant, no "
-          "tracker, no probe policy, no delta engine, no compactor, no "
-          "worker threads, zero instruments, zero queue activity)")
+    print("disabled-overhead: quality/drift/cost/capacity/ivf/mutable/"
+          "workload off-state ok (no scorer, no monitor, no accountant, "
+          "no tracker, no probe policy, no delta engine, no compactor, "
+          "no capture recorder, no worker threads, zero instruments, "
+          "zero queue activity)")
 
     # -- 1b. the device-side layer (obs/devprof.py) off-state --------------
     # Even with the compile listener having been registered by a PRIOR
@@ -179,19 +190,35 @@ def main() -> int:
           "memory sample, cache tracker all recorded nothing)")
 
     # -- 2. timing: best-of mins under the budget --------------------------
-    # Measured WITH a cost-accounting-enabled ServeApp alive (PR 8): the
-    # accounting/capacity layers live entirely on the serving dispatch
-    # path, so their existence must not move the classify-path predict
-    # budget at all — and the layer must actually construct + attribute
-    # when asked (the on-state sanity half of the satellite).
+    # Measured WITH a cost-accounting-enabled ServeApp alive (PR 8) AND a
+    # workload-capture window armed (PR 11): both layers live entirely on
+    # the serving dispatch path, so their existence must not move the
+    # classify-path predict budget at all — and each must actually
+    # construct + record when asked (the on-state sanity half).
+    import tempfile
+
     budget_ms = float(os.environ.get("KNN_TPU_OVERHEAD_BUDGET_MS", "60"))
+    capture_tmp = tempfile.mkdtemp(prefix="knn-overhead-capture-")
     app_on = ServeApp(model, max_batch=8, max_wait_ms=0.0,
-                      cost_accounting=True)
+                      cost_accounting=True, capture_dir=capture_tmp)
     try:
         if app_on.accounting is None or app_on.capacity is None:
             return fail("ServeApp(cost_accounting=True) did not build the "
                         "accounting/capacity layers")
+        if app_on.workload is None or app_on.batcher.workload is None:
+            return fail("ServeApp(capture_dir=...) did not build the "
+                        "workload capture layer")
+        app_on.workload.start(reason="overhead-gate")
         app_on.batcher.predict(test.features[0], timeout=60)
+        if not app_on.workload.drain(10):
+            return fail("workload capture queue did not drain")
+        cap_stat = app_on.workload.export()
+        if cap_stat["captured_events"] < 1:
+            return fail("workload capture ON recorded nothing for a "
+                        "served request")
+        print(f"disabled-overhead: workload-capture on-state ok "
+              f"({cap_stat['captured_events']} event(s) captured, "
+              f"{cap_stat['shed']} shed)")
         totals = app_on.accounting.export()["totals"]
         if totals["dispatches"] < 1 or totals["dispatch_wall_ms"] <= 0:
             return fail("cost accounting ON attributed nothing for a "
@@ -206,6 +233,9 @@ def main() -> int:
             walls.append((time.monotonic() - t0) * 1e3)
     finally:
         app_on.close()
+        import shutil
+
+        shutil.rmtree(capture_tmp, ignore_errors=True)
     best = min(walls)
     print(f"disabled-overhead: medium-preset predict best-of-{BEST_OF} min "
           f"{best:.2f} ms with cost accounting on (budget "

@@ -1,0 +1,99 @@
+"""Regenerate the committed replay workload fixture.
+
+``tests/data/replay-workload/`` is a small workload artifact
+(docs/OBSERVABILITY.md §Workload capture & replay) captured against the
+deterministic synthetic model in ``tests.fixtures.replay_fixture_model``:
+~120 read events (predict/kneighbors mix, 1-4 query rows each) fired
+open-loop over ~2 s with seeded bursty inter-arrivals. ``bench.py
+--config replay`` re-drives it as a perf record and
+``tests/test_workload.py`` pins replay mechanics on it.
+
+Two determinism tiers, deliberately different:
+
+- the QUERY ROWS and arrival schedule come from pinned Generator seeds
+  and reproduce everywhere (NumPy stream-compatibility policy);
+- the ANSWER DIGESTS are environment-pinned like
+  ``BENCH_GATE_BASELINE.json`` — a different jax/numpy build may order
+  float reductions differently. Consumers therefore treat fixture
+  digest divergences as a REPORTED number, not a failure; the strict
+  zero-divergence assertion lives in ``make replay-gate``, which
+  captures and replays within one process.
+
+Run from the repo root: ``python3 scripts/make_workload_fixture.py``
+(rewrites tests/data/replay-workload in place).
+"""
+
+from __future__ import annotations
+
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READS = 120
+POLICY = {"max_batch": 16, "max_wait_ms": 1.0}
+
+
+def main() -> int:
+    from tests import fixtures
+    from knn_tpu.obs.workload import WorkloadCapture
+    from knn_tpu.serve.artifact import warmup
+    from knn_tpu.serve.batcher import MicroBatcher
+
+    model = fixtures.replay_fixture_model()
+    d = model.train_.num_features
+    warmup(model, batch_sizes=(1, POLICY["max_batch"]), kinds=("predict",))
+    rng = np.random.default_rng(5678)
+    # Bursty open-loop schedule: exponential inter-arrivals with a 3x
+    # rate burst through the middle third — enough structure that the
+    # what-if simulator has real coalescing to model.
+    gaps = []
+    for i in range(READS):
+        mean_ms = 5.0 if READS // 3 <= i < 2 * READS // 3 else 15.0
+        gaps.append(float(rng.exponential(mean_ms)))
+    kinds = ["kneighbors" if rng.random() < 0.2 else "predict"
+             for _ in range(READS)]
+    row_counts = [int(rng.integers(1, 5)) for _ in range(READS)]
+    queries = [rng.normal(0.0, 2.0, (r, d)).astype(np.float32)
+               for r in row_counts]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cap = WorkloadCapture(tmp, num_features=d, k=model.k,
+                              policy=dict(POLICY))
+        batcher = MicroBatcher(
+            model, max_batch=POLICY["max_batch"],
+            max_wait_ms=POLICY["max_wait_ms"],
+            index_version=fixtures.REPLAY_FIXTURE_VERSION,
+            workload=cap,
+        )
+        try:
+            cap.start(reason="fixture")
+            futures = []
+            for gap_ms, kind, q in zip(gaps, kinds, queries):
+                time.sleep(gap_ms / 1e3)
+                futures.append(batcher.submit(q, kind))
+            for f in futures:
+                f.result(timeout=60)
+            cap.drain(30)
+            summary = cap.stop()
+        finally:
+            batcher.close()
+            cap.close()
+        out = fixtures.REPLAY_WORKLOAD_DIR
+        if out.exists():
+            shutil.rmtree(out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copytree(summary["path"], out)
+    print(f"wrote {out}: {summary['requests']} requests over "
+          f"{summary['duration_ms']:.0f} ms (policy {POLICY})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,301 @@
+"""Workload replay gate (`make replay-gate`).
+
+Proves the whole capture → replay → what-if chain end to end, in one
+process (docs/OBSERVABILITY.md §Workload capture & replay):
+
+1. build a small index artifact and boot a MUTABLE in-process serving
+   stack (micro-batcher + delta engine) with workload capture armed;
+2. drive a seeded bursty open-loop mix of reads and inserts/deletes and
+   finalize the capture window into a workload artifact;
+3. replay the artifact against a PRISTINE twin of the serving stack
+   (same artifact bytes copied before any mutation, hence the same
+   ``index_version``) and assert the enforced promises:
+   - zero read errors and zero mutation errors,
+   - every replayed mutation lands on its captured ``mutation_seq``,
+   - **zero answer divergences** wherever ``index_version`` and
+     ``mutation_seq`` match the capture (bit-identical digests), with a
+     non-trivial fraction of reads actually verified (a gate that
+     skipped everything would prove nothing);
+4. fit the replay's dispatch-cost model (obs/capacity.py) and run the
+   what-if simulator (obs/whatif.py) for the LIVE policy over the
+   captured arrival process: the predicted p50 must agree with the
+   measured replay p50 within the documented band
+   ``|predicted - measured| <= max(5 ms, 0.6 x measured)`` — generous
+   because the simulator deliberately omits scheduler jitter and
+   host-side bookkeeping, tight enough that a simulator modeling the
+   wrong policy (or a fit in the wrong units) cannot pass;
+5. record a small candidate-policy frontier in the verdict JSON (what
+   the simulator exists for), reported, not asserted.
+
+Exit 0 on success; 1 with a diagnosis otherwise. Run on CPU jax.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READS = 150
+INSERTS = 16
+DELETES = 8
+POLICY = {"max_batch": 16, "max_wait_ms": 1.0}
+MAX_QUEUE_ROWS = 4096
+#: The documented predicted-vs-measured p50 agreement band.
+BAND_ABS_MS = 5.0
+BAND_REL = 0.6
+
+
+def fail(msg: str) -> int:
+    print(f"replay-gate: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def seed_capacity(capacity, model, max_batch: int) -> None:
+    """The warmup seeding rule (ServeApp._seed_capacity_model): two
+    post-compile timed dispatches give the affine fit its endpoints
+    before replay traffic refines it."""
+    from knn_tpu.data.dataset import Dataset
+
+    train = model.train_
+    for rows in sorted({1, max_batch}):
+        feats = train.features[:rows]
+        ds = Dataset(feats, np.zeros(rows, np.int32))
+        best = None
+        for _ in range(2):
+            t0 = time.monotonic()
+            model.kneighbors(ds)
+            wall = (time.monotonic() - t0) * 1e3
+            best = wall if best is None else min(best, wall)
+        capacity.seed_dispatch_model(rows, best)
+
+
+def drive_capture(batcher, capture, test, rng) -> None:
+    """Seeded bursty open-loop traffic: reads + an interleaved mutation
+    stream (inserts first, deletes only of already-inserted stable ids)."""
+    d = test.features.shape[1]
+    base_rows = batcher._model.train_.num_instances
+    events = []  # ("read", kind, rows) | ("insert", rows, values) | ...
+    inserted = 0
+    deletable = []
+    for i in range(READS):
+        r = int(rng.integers(1, 5))
+        start = int(rng.integers(0, test.features.shape[0] - r))
+        kind = "kneighbors" if rng.random() < 0.25 else "predict"
+        events.append(("read", kind, test.features[start:start + r]))
+        if i % (READS // INSERTS) == 3 and inserted < INSERTS:
+            rows = rng.normal(0.0, 2.0, (1, d)).astype(np.float32)
+            values = [int(rng.integers(0, 4))]
+            events.append(("insert", rows, values))
+            deletable.append(base_rows + inserted)
+            inserted += 1
+        if i % (READS // DELETES) == 7 and deletable and len(deletable) > 2:
+            sid = deletable.pop(0)
+            events.append(("delete", [sid], None))
+    capture.start(reason="gate")
+    futures = []
+    for ev in events:
+        # Bursty pacing: the middle third arrives 3x faster.
+        mean_ms = 4.0 if len(futures) % 3 == 1 else 10.0
+        time.sleep(float(rng.exponential(mean_ms)) / 1e3)
+        if ev[0] == "read":
+            futures.append(batcher.submit(ev[2], ev[1]))
+        elif ev[0] == "insert":
+            futures.append(batcher.submit_mutation(
+                "insert", {"rows": ev[1], "values": ev[2]}))
+        else:
+            futures.append(batcher.submit_mutation(
+                "delete", {"ids": ev[1]}))
+    for f in futures:
+        f.result(timeout=60)
+
+
+def main() -> int:
+    import argparse
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+
+    from tests import fixtures
+    from knn_tpu.models.knn import KNNClassifier
+    from knn_tpu.mutable.engine import MutableEngine
+    from knn_tpu.obs import whatif
+    from knn_tpu.obs.capacity import CapacityTracker
+    from knn_tpu.obs.replay import replay_workload
+    from knn_tpu.obs.workload import WorkloadCapture, load_workload
+    from knn_tpu.serve import artifact
+    from knn_tpu.serve.batcher import MicroBatcher
+
+    train, test = fixtures.load_pair("small")
+    rng = np.random.default_rng(42)
+    verdict: dict = {"policy": dict(POLICY)}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        dir_a, dir_b = tmp / "index-a", tmp / "index-b"
+        artifact.save_index(KNNClassifier(k=3).fit(train), dir_a)
+        shutil.copytree(dir_a, dir_b)  # the pristine twin: same bytes,
+        # same manifest, hence the SAME index_version tag
+
+        # -- capture side ---------------------------------------------------
+        model_a = artifact.load_index(dir_a)
+        version = artifact.index_version(artifact.read_manifest(dir_a))
+        artifact.warmup(model_a, batch_sizes=(1, POLICY["max_batch"]),
+                        kinds=("predict",))
+        engine_a = MutableEngine(model_a, dir_a, version=version)
+        capture = WorkloadCapture(
+            tmp / "captures", num_features=train.num_features, k=3,
+            policy=dict(POLICY), index_version=version,
+        )
+        batcher_a = MicroBatcher(
+            model_a, max_batch=POLICY["max_batch"],
+            max_wait_ms=POLICY["max_wait_ms"],
+            max_queue_rows=MAX_QUEUE_ROWS, index_version=version,
+            workload=capture, mutable=engine_a,
+        )
+        try:
+            drive_capture(batcher_a, capture, test, rng)
+            capture.drain(30)
+            summary = capture.stop()
+        finally:
+            batcher_a.close()
+            engine_a.close()
+            capture.close()
+        print(f"replay-gate: captured {summary['requests']} requests + "
+              f"{summary['mutations']} mutations over "
+              f"{summary['duration_ms']:.0f} ms (shed {summary['shed']})")
+        if summary["requests"] < READS:
+            return fail(f"capture lost reads: {summary['requests']} < "
+                        f"{READS}")
+        if summary["mutations"] < INSERTS:
+            return fail(f"capture lost mutations: {summary['mutations']}")
+        wl = load_workload(summary["path"])
+        verdict["captured"] = {
+            "requests": summary["requests"],
+            "mutations": summary["mutations"],
+            "duration_ms": summary["duration_ms"],
+            **wl.captured_latency_summary(),
+        }
+
+        # -- replay side (the pristine twin) --------------------------------
+        model_b = artifact.load_index(dir_b)
+        version_b = artifact.index_version(artifact.read_manifest(dir_b))
+        if version_b != version:
+            return fail(f"twin artifact version {version_b} != {version} — "
+                        f"the copy is not byte-faithful")
+        artifact.warmup(model_b, batch_sizes=(1, POLICY["max_batch"]),
+                        kinds=("predict",))
+        engine_b = MutableEngine(model_b, dir_b, version=version_b)
+        capacity = CapacityTracker(POLICY["max_batch"])
+        seed_capacity(capacity, model_b, POLICY["max_batch"])
+        batcher_b = MicroBatcher(
+            model_b, max_batch=POLICY["max_batch"],
+            max_wait_ms=POLICY["max_wait_ms"],
+            max_queue_rows=MAX_QUEUE_ROWS, index_version=version_b,
+            capacity=capacity, mutable=engine_b,
+        )
+        try:
+            rv = replay_workload(wl, batcher=batcher_b, speed=1.0,
+                                 verify="tag")
+        finally:
+            batcher_b.close()
+            engine_b.close()
+        cap_doc = capacity.export()
+        verdict["replay"] = rv
+        verdict["replay_capacity"] = {
+            k: cap_doc[k] for k in
+            ("occupancy_mean", "padded_row_waste_ratio", "duty_cycle",
+             "dispatch_model")
+        }
+        m, v, mu = rv["measured"], rv["verify"], rv["mutations"]
+        print(f"replay-gate: replayed {m['requests']} reads p50 "
+              f"{m['p50_ms']} ms / p99 {m['p99_ms']} ms; verified "
+              f"{v['verified']}, divergences {v['divergences']}, "
+              f"tag-skipped {v['skipped_tag_mismatch']}; mutations "
+              f"{mu['ok']}/{mu['fired']} ok, {mu['seq_aligned']} "
+              f"seq-aligned")
+        if m["errors"] != 0:
+            return fail(f"{m['errors']} replayed reads errored: "
+                        f"{rv['error_samples']}")
+        if mu["ok"] != mu["fired"] or mu["fired"] != summary["mutations"]:
+            return fail(f"mutation replay incomplete: {mu}")
+        if mu["seq_aligned"] != mu["fired"]:
+            return fail(f"replayed mutations landed off their captured "
+                        f"mutation_seq: {mu['seq_aligned']}/{mu['fired']} "
+                        f"aligned — ordering broke")
+        if v["divergences"] != 0:
+            return fail(f"{v['divergences']} answer(s) diverged at "
+                        f"matching index_version/mutation_seq: "
+                        f"{v['divergence_samples']}")
+        if v["verified"] < m["requests"] // 2:
+            return fail(f"only {v['verified']}/{m['requests']} reads were "
+                        f"verifiable at matching tags — the replay "
+                        f"drifted too far off the captured mutation "
+                        f"timeline to prove anything")
+
+        # -- what-if prediction vs the measured replay ----------------------
+        fit = cap_doc["dispatch_model"]
+        if fit["a_ms"] is None:
+            return fail(f"no dispatch-cost fit after replay: {fit}")
+        sim = whatif.simulate(
+            wl.arrivals(), max_batch=POLICY["max_batch"],
+            max_wait_ms=POLICY["max_wait_ms"],
+            a_ms=fit["a_ms"], b_ms_per_row=fit["b_ms_per_row"],
+        )
+        band = max(BAND_ABS_MS, BAND_REL * m["p50_ms"])
+        delta = abs(sim["p50_ms"] - m["p50_ms"])
+        verdict["whatif"] = {
+            "predicted": sim,
+            "measured_p50_ms": m["p50_ms"],
+            "delta_ms": round(delta, 3),
+            "band_ms": round(band, 3),
+            "band_rule": f"max({BAND_ABS_MS} ms, {BAND_REL} x measured)",
+            "dispatch_model": fit,
+        }
+        print(f"replay-gate: what-if predicted p50 {sim['p50_ms']} ms vs "
+              f"measured {m['p50_ms']} ms (delta {delta:.2f} ms, band "
+              f"{band:.2f} ms, fit {fit['source']}: a={fit['a_ms']} "
+              f"b={fit['b_ms_per_row']})")
+        if delta > band:
+            return fail(f"what-if p50 {sim['p50_ms']} ms disagrees with "
+                        f"the measured replay p50 {m['p50_ms']} ms beyond "
+                        f"the {band:.2f} ms band")
+
+        # -- candidate frontier (reported, not asserted) --------------------
+        candidates = [
+            dict(POLICY),
+            {"max_batch": POLICY["max_batch"],
+             "max_wait_ms": POLICY["max_wait_ms"],
+             "buckets": [1, 2, 4, 8, 16]},
+            {"max_batch": 64, "max_wait_ms": 5.0},
+            {"max_batch": 1, "max_wait_ms": 0.0},
+        ]
+        verdict["frontier"] = whatif.frontier(
+            wl.arrivals(), candidates, a_ms=fit["a_ms"],
+            b_ms_per_row=fit["b_ms_per_row"],
+        )
+
+    verdict["pass"] = True
+    if args.json_out:
+        out = Path(args.json_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(verdict, indent=2) + "\n")
+        print(f"replay-gate: verdict written to {out}")
+    print("replay-gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.exit(rc)

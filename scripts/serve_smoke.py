@@ -85,13 +85,18 @@ def main() -> int:
             return fail(f"save-index rc={build.returncode}: {build.stderr}")
         print(f"serve-smoke: {build.stdout.strip()}")
 
+        captures_dir = os.path.join(tmp, "captures")
         proc = subprocess.Popen(
             [sys.executable, "-m", "knn_tpu.cli", "serve", index,
              "--port", "0", "--max-batch", "16", "--max-wait-ms", "1",
              # Quality observability on (PR 7): every request shadow-scored
              # + drift-sketched so the /debug/quality probe sees real data.
              "--shadow-rate", "1", "--drift-rate", "1",
-             "--quality-queue", "4096"],
+             "--quality-queue", "4096",
+             # Workload capture (PR 11): /admin/capture + /debug/capture
+             # probed below; the finalized smoke workload is saved to
+             # build/ as a CI artifact.
+             "--capture-dir", captures_dir],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
@@ -347,6 +352,71 @@ def main() -> int:
                   f"({len(trace['traceEvents'])} events, "
                   f"source={trace['otherData'].get('source')}, serve spans "
                   f"{serve_spans[:3]}, saved to {out.name})")
+
+            # Workload capture (PR 11, docs/OBSERVABILITY.md §Workload
+            # capture & replay): /debug/capture reports the idle layer,
+            # /admin/capture start arms a window, captured requests land
+            # in a loadable workload artifact on stop, and the artifact
+            # is saved to build/ for the CI upload.
+            st, body, _ = request(base, "/debug/capture")
+            cdoc = json.loads(body)
+            if st != 200 or cdoc.get("enabled") is not True \
+                    or cdoc.get("capturing") is not False:
+                return fail(f"/debug/capture idle state wrong: {st} "
+                            f"{body[:200]}", proc)
+            st, body, _ = request(base, "/admin/capture",
+                                  {"action": "start", "reason": "smoke"})
+            if st != 200 or not json.loads(body).get("capturing"):
+                return fail(f"/admin/capture start: {st} {body[:200]}", proc)
+            st, body, _ = request(base, "/admin/capture",
+                                  {"action": "start"})
+            if st != 409:
+                return fail(f"double capture start: want 409, got {st}",
+                            proc)
+            cap_rid = "smoke-capture-0001"
+            for i in range(3):
+                hdrs = {"x-request-id": cap_rid} if i == 0 else None
+                st, body, _ = request(base, "/predict",
+                                      {"instances": rows[:2].tolist()},
+                                      headers=hdrs)
+                if st != 200:
+                    return fail(f"/predict during capture: {st}", proc)
+            st, body, _ = request(base, "/admin/capture",
+                                  {"action": "stop"})
+            cstop = json.loads(body)
+            if st != 200 or cstop.get("requests", 0) < 3:
+                return fail(f"/admin/capture stop: {st} {body[:300]}", proc)
+            st, body, _ = request(base, "/debug/capture")
+            cdoc = json.loads(body)
+            if (cdoc.get("capturing") is not False
+                    or (cdoc.get("last") or {}).get("requests", 0) < 3):
+                return fail(f"/debug/capture after stop: {body[:300]}",
+                            proc)
+            from knn_tpu.obs.workload import load_workload
+
+            wl = load_workload(cstop["path"])
+            captured_ids = {e.get("request_id")
+                            for e in wl.read_events}
+            if cap_rid not in captured_ids:
+                return fail(f"captured workload lost the request_id "
+                            f"linkage: {sorted(captured_ids)[:5]}", proc)
+            # The access-log/flight-recorder linkage rides the timeline:
+            # the captured request's trace must carry workload_record.
+            st, body, _ = request(base, f"/debug/requests?id={cap_rid}")
+            tl = json.loads(body)["requests"][0] if st == 200 else {}
+            if "workload_record" not in tl:
+                return fail(f"flight-recorder timeline for {cap_rid} "
+                            f"lacks workload_record: {body[:300]}", proc)
+            import shutil
+
+            smoke_out = REPO / "build" / "smoke-workload"
+            if smoke_out.exists():
+                shutil.rmtree(smoke_out)
+            smoke_out.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copytree(cstop["path"], smoke_out)
+            print(f"serve-smoke: capture ok ({cstop['requests']} requests "
+                  f"captured, request_id + workload_record linkage "
+                  f"verified, artifact saved to {smoke_out.name}/)")
 
             # Oversized x-request-id: 400, never a traceback.
             st, body, _ = request(base, "/predict",
