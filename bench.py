@@ -922,7 +922,22 @@ def _load_medium():
     )
 
 
+#: The bucket ladder the serving bench (and the bench gate's serving
+#: trials) dispatch under: single-row closed-loop clients form small
+#: batches, so the ladder starts low — the shape an operator would pick
+#: after reading /debug/capacity's waste numbers for this traffic
+#: (docs/SERVING.md §Tuning the bucket ladder).
+SERVE_BENCH_BUCKETS = (4, 8, 16, 32, 64)
+
+
 def bench_serving():
+    from knn_tpu.models.knn import query_bucket_ladder
+
+    with query_bucket_ladder(SERVE_BENCH_BUCKETS):
+        return _bench_serving_body()
+
+
+def _bench_serving_body():
     """The serving subsystem's claim, measured (docs/SERVING.md): under
     concurrent closed-loop load, the micro-batcher's coalesced dispatch
     beats naive sequential per-call dispatch on per-request p50 latency
@@ -953,10 +968,12 @@ def bench_serving():
     train, test = _load_medium()
     q = test.num_instances
     model = KNNClassifier(k=K, engine="auto").fit(train)
-    # One warm executable serves every batch size <= the query pad quantum
-    # (rows pad to one dispatch shape), so warmup at 1 covers the sweep.
+    # Bucketed serving: every ladder bucket is its own compiled
+    # executable, so warmup sweeps the whole ladder (the serve boot's
+    # rule) — trials then measure dispatch, never compilation.
     log(f"serving preset: {train.num_instances} train rows x "
-        f"{train.num_features} features; warm {warmup(model, (1, 64))}")
+        f"{train.num_features} features; buckets {SERVE_BENCH_BUCKETS}; "
+        f"warm {warmup(model, (1,) + SERVE_BENCH_BUCKETS)}")
 
     MAX_BATCH, MAX_WAIT_MS, REQS = 64, 2.0, 30
     levels = (1, 4, 8, 16)
@@ -1014,6 +1031,7 @@ def bench_serving():
         "train_rows": train.num_instances,
         "max_batch": MAX_BATCH,
         "max_wait_ms": MAX_WAIT_MS,
+        "batch_buckets": list(SERVE_BENCH_BUCKETS),
         "requests_per_client": REQS,
         "levels": {},
     }
@@ -1021,7 +1039,8 @@ def bench_serving():
     for conc in levels:
         total = conc * REQS
         batcher = MicroBatcher(model, max_batch=MAX_BATCH,
-                               max_wait_ms=MAX_WAIT_MS)
+                               max_wait_ms=MAX_WAIT_MS,
+                               buckets=SERVE_BENCH_BUCKETS)
         try:
             before = (batch_hist().count, batch_hist().sum)
             b_lats, b_wall, b_err = closed_loop(
@@ -1078,7 +1097,8 @@ def bench_serving():
 
     rec = FlightRecorder(capacity=1024, slowest_k=16)
     traced = MicroBatcher(model, max_batch=MAX_BATCH,
-                          max_wait_ms=MAX_WAIT_MS, recorder=rec)
+                          max_wait_ms=MAX_WAIT_MS, recorder=rec,
+                          buckets=SERVE_BENCH_BUCKETS)
     try:
         t_lats, t_wall, t_err = closed_loop(
             8, lambda row: traced.predict(row, timeout=120))
@@ -1103,7 +1123,8 @@ def bench_serving():
 
     shadow = ShadowScorer(0.1, queue_cap=1024, seed=0)
     shadowed = MicroBatcher(model, max_batch=MAX_BATCH,
-                            max_wait_ms=MAX_WAIT_MS, quality=shadow)
+                            max_wait_ms=MAX_WAIT_MS, quality=shadow,
+                            buckets=SERVE_BENCH_BUCKETS)
     try:
         sh_lats, sh_wall, sh_err = closed_loop(
             8, lambda row: shadowed.predict(row, timeout=120))
@@ -1139,7 +1160,7 @@ def bench_serving():
     capacity = CapacityTracker(MAX_BATCH, window_s=300)
     costed = MicroBatcher(model, max_batch=MAX_BATCH,
                           max_wait_ms=MAX_WAIT_MS, accounting=accountant,
-                          capacity=capacity)
+                          capacity=capacity, buckets=SERVE_BENCH_BUCKETS)
     try:
         cc_lats, cc_wall, cc_err = closed_loop(
             8, lambda row: costed.predict(row, timeout=120))
@@ -1468,42 +1489,52 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
             return round(float(np.percentile(lats, 50)), 3)
         return None
 
+    from knn_tpu.models.knn import query_bucket_ladder
+    from knn_tpu.serve.artifact import warmup as _serve_warmup
+
     serve_trials = []
     occ_trials, duty_trials, waste_trials = [], [], []
-    for _ in range(serving_trials):
-        # Batching-efficiency telemetry rides the gate record as
-        # REPORT-ONLY metrics (absent from the committed baseline ->
-        # regress.compare_records lists them under new_metrics, never
-        # gates): occupancy/duty/waste at this fixed load are visibility,
-        # not a pass/fail surface yet.
-        capacity = CapacityTracker(64, window_s=120)
-        p50 = closed_loop_p50(MicroBatcher(model, max_batch=64,
-                                           max_wait_ms=2.0,
-                                           capacity=capacity))
-        if p50 is not None:
-            serve_trials.append(p50)
-        cap_doc = capacity.export()
-        occ_trials.append(cap_doc["occupancy_mean"])
-        duty_trials.append(cap_doc["duty_cycle"])
-        waste_trials.append(cap_doc["padded_row_waste_ratio"])
-    log(f"gate serving c8 p50: {serve_trials} ms (occupancy {occ_trials}, "
-        f"duty {duty_trials}, padded-row waste {waste_trials})")
+    with query_bucket_ladder(SERVE_BENCH_BUCKETS):
+        # The serving trials dispatch under the bench bucket ladder (the
+        # tuned policy the serving docs teach for this single-row
+        # closed-loop traffic); occupancy/duty/waste are ARMED gate
+        # metrics since PR 10 — the PR 12 baseline refresh holds waste
+        # and occupancy at the bucketed values, so a regression back to
+        # the 0.955 single-quantum waste fails the gate.
+        _serve_warmup(model, batch_sizes=(1,) + SERVE_BENCH_BUCKETS,
+                      kinds=("predict",))
+        for _ in range(serving_trials):
+            capacity = CapacityTracker(64, window_s=120)
+            p50 = closed_loop_p50(MicroBatcher(model, max_batch=64,
+                                               max_wait_ms=2.0,
+                                               capacity=capacity,
+                                               buckets=SERVE_BENCH_BUCKETS))
+            if p50 is not None:
+                serve_trials.append(p50)
+            cap_doc = capacity.export()
+            occ_trials.append(cap_doc["occupancy_mean"])
+            duty_trials.append(cap_doc["duty_cycle"])
+            waste_trials.append(cap_doc["padded_row_waste_ratio"])
+        log(f"gate serving c8 p50: {serve_trials} ms (occupancy "
+            f"{occ_trials}, duty {duty_trials}, padded-row waste "
+            f"{waste_trials})")
 
-    # The costed serving p50 (PR 8's c8_cost_p50_ms, gate-shaped): the
-    # same closed-loop load with the accounting + capacity layers
-    # attached, one p50 per trial — so a cost-attribution overhead
-    # regression gates once a baseline entry carries it.
-    from knn_tpu.obs.accounting import CostAccountant
+        # The costed serving p50 (PR 8's c8_cost_p50_ms, gate-shaped):
+        # the same closed-loop load with the accounting + capacity layers
+        # attached, one p50 per trial — so a cost-attribution overhead
+        # regression gates once a baseline entry carries it.
+        from knn_tpu.obs.accounting import CostAccountant
 
-    cost_trials = []
-    for _ in range(serving_trials):
-        p50 = closed_loop_p50(MicroBatcher(
-            model, max_batch=64, max_wait_ms=2.0,
-            accounting=CostAccountant(),
-            capacity=CapacityTracker(64, window_s=120)))
-        if p50 is not None:
-            cost_trials.append(p50)
-    log(f"gate serving c8 costed p50: {cost_trials} ms")
+        cost_trials = []
+        for _ in range(serving_trials):
+            p50 = closed_loop_p50(MicroBatcher(
+                model, max_batch=64, max_wait_ms=2.0,
+                accounting=CostAccountant(),
+                capacity=CapacityTracker(64, window_s=120),
+                buckets=SERVE_BENCH_BUCKETS))
+            if p50 is not None:
+                cost_trials.append(p50)
+        log(f"gate serving c8 costed p50: {cost_trials} ms")
 
     d = Path(__file__).parent / "build" / "fixtures"
     ref = Path("/root/reference/datasets")
@@ -1556,6 +1587,7 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
         "value": round(min(predict_trials), 3),
         "unit": "ms",
         "vs_baseline": None,
+        "batch_buckets": list(SERVE_BENCH_BUCKETS),
         "env": {
             "platform": jax.default_backend(),
             "device_kind": dev.device_kind,

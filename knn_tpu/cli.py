@@ -191,7 +191,27 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    "fail 504), then exit 0 (docs/SERVING.md ops runbook)")
     p.add_argument("--warmup-batches", default=None, metavar="B1,B2,...",
                    help="batch shapes to compile before reporting ready "
-                   "(default: 1 and --max-batch)")
+                   "(default: 1, --max-batch, and every --batch-buckets "
+                   "bucket)")
+    p.add_argument("--batch-buckets", default="auto",
+                   metavar="B1,B2,...|auto|off",
+                   help="compiled-shape bucket ladder (docs/SERVING.md "
+                   "§Tuning the bucket ladder): each dispatched batch "
+                   "pads to the smallest bucket >= its rows instead of "
+                   "the single 128-row quantum, every bucket pre-compiles "
+                   "at warmup, and continuous batching tops a closed "
+                   "batch up to its bucket boundary for free. 'auto' "
+                   "(default): a geometric ladder 16,32,... capped at "
+                   "--max-batch; 'off': the legacy single-quantum pad")
+    p.add_argument("--result-cache-rows", type=int, default=0,
+                   metavar="ROWS",
+                   help="exact-match result cache capacity in cached "
+                   "query rows (docs/SERVING.md): identical query rows "
+                   "at the same (index_version, mutation_seq) point are "
+                   "answered without a dispatch — bit-identical by "
+                   "construction, invalidated by reload/compaction, "
+                   "knn_cache_* counters. 0 (default) constructs "
+                   "nothing; leave it off for high-entropy query streams")
     p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
                    help="force a JAX platform (e.g. cpu, tpu) before model "
                    "warmup")
@@ -781,6 +801,9 @@ def _run_serve(args, stdout) -> int:
          and args.capture_dir is None,
          "--capture-burn-threshold needs --capture-dir (the trigger "
          "has nowhere to write its artifact)"),
+        (args.result_cache_rows < 0,
+         f"--result-cache-rows must be >= 0, got "
+         f"{args.result_cache_rows}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -809,6 +832,35 @@ def _run_serve(args, stdout) -> int:
             print(f"error: --warmup-batches wants positive integers, got "
                   f"{args.warmup_batches!r}", file=sys.stderr)
             return EXIT_USAGE
+    # The compiled-shape bucket ladder (docs/SERVING.md §Tuning the
+    # bucket ladder). Always topped by --max-batch so every admissible
+    # batch pads onto a shape warmup compiled; buckets past --max-batch
+    # are a contradiction (no batch can ever fill them), refused exit 2.
+    batch_buckets = None
+    if args.batch_buckets != "off":
+        if args.batch_buckets == "auto":
+            from knn_tpu.models.knn import DEFAULT_BATCH_BUCKETS
+
+            batch_buckets = tuple(sorted(
+                {b for b in DEFAULT_BATCH_BUCKETS if b < args.max_batch}
+                | {args.max_batch}))
+        else:
+            try:
+                parsed = sorted(
+                    {int(s) for s in args.batch_buckets.split(",") if s})
+                if not parsed or parsed[0] < 1:
+                    raise ValueError
+            except ValueError:
+                print(f"error: --batch-buckets wants positive integers "
+                      f"(or 'auto' / 'off'), got {args.batch_buckets!r}",
+                      file=sys.stderr)
+                return EXIT_USAGE
+            if parsed[-1] > args.max_batch:
+                print(f"error: --batch-buckets {parsed[-1]} exceeds "
+                      f"--max-batch {args.max_batch}; no batch can ever "
+                      f"fill that bucket", file=sys.stderr)
+                return EXIT_USAGE
+            batch_buckets = tuple(sorted({*parsed, args.max_batch}))
     if args.platform:
         err = _apply_platform(args.platform)
         if err is not None:
@@ -834,6 +886,13 @@ def _run_serve(args, stdout) -> int:
     except DataError as e:
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
+    if batch_buckets is not None:
+        # Install the ladder BEFORE warmup: the pad, the executable-cache
+        # key, and padded-row accounting all resolve from this one
+        # definition, and warm() compiles one executable per bucket.
+        from knn_tpu.models.knn import set_query_buckets
+
+        set_query_buckets(batch_buckets)
     # The /metrics endpoint is this process's observability artifact;
     # serving without it would be flying blind.
     obs.enable()
@@ -873,6 +932,8 @@ def _run_serve(args, stdout) -> int:
             capture_burn_threshold=args.capture_burn_threshold,
             capture_burn_objective=args.capture_burn_objective,
             capture_burn_window_s=args.capture_burn_window_s,
+            batch_buckets=batch_buckets,
+            result_cache_rows=args.result_cache_rows,
         )
     except OSError as e:  # an unwritable --access-log / --capture-dir path
         print(f"error: {e}", file=sys.stderr)
@@ -910,11 +971,16 @@ def _run_serve(args, stdout) -> int:
                         f"epoch={m['epoch']}, "
                         f"replayed_delta={m['delta_slots']}, "
                         f"delta_cap={args.delta_cap})")
+    bucket_note = ""
+    if batch_buckets is not None:
+        bucket_note = f", buckets={'/'.join(str(b) for b in batch_buckets)}"
+    if args.result_cache_rows > 0:
+        bucket_note += f", result_cache_rows={args.result_cache_rows}"
     print(
         f"knn-tpu serve: ready on http://{host}:{port} "
         f"(family={app.family}, k={model.k}, "
         f"train_rows={model.train_.num_instances}, "
-        f"index_version={version}{ivf_note}{mutable_note}, "
+        f"index_version={version}{ivf_note}{mutable_note}{bucket_note}, "
         f"warmed={sorted(warmed)})",
         file=stdout, flush=True,
     )

@@ -7,6 +7,8 @@ equivalent: fit/predict/score with a pluggable execution backend.
 
 from __future__ import annotations
 
+import contextlib
+import math
 from typing import Optional
 
 import numpy as np
@@ -16,12 +18,87 @@ from knn_tpu.backends import get_backend
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.utils.evaluate import confusion_matrix, accuracy
 
-#: Query rows pad to this quantum on the XLA retrieval path (one warm
-#: executable then serves every batch size up to it). The ONE definition:
-#: the executable-cache key below and the cost layer's padded-row
-#: accounting (obs/accounting.py) both resolve from here, so they can
-#: never silently diverge from the pad that really happens.
+#: Query rows pad to this quantum on the XLA retrieval path when no
+#: bucket ladder is configured (one warm executable then serves every
+#: batch size up to it). The ONE definition: :func:`query_padded_rows`
+#: below is what the pad, the executable-cache key, and the cost layer's
+#: padded-row accounting (obs/accounting.py) all resolve from, so they
+#: can never silently diverge from the pad that really happens.
 QUERY_PAD_QUANTUM = 128
+
+#: The serving default for ``serve --batch-buckets auto`` (a geometric
+#: ladder — each bucket is one compiled executable; a batch pads to the
+#: smallest bucket >= its rows, so the measured padded-row waste tracks
+#: the batch the traffic actually formed instead of the single
+#: pad-to-quantum shape). docs/SERVING.md §Tuning the bucket ladder.
+DEFAULT_BATCH_BUCKETS = (16, 32, 64, 128, 256)
+
+#: Process-wide compiled-shape bucket ladder for XLA query padding.
+#: ``None`` (the default, and always outside a bucketed serve) keeps the
+#: legacy pad-to-``QUERY_PAD_QUANTUM`` behavior byte-identical.
+_QUERY_BUCKETS: "tuple[int, ...] | None" = None
+
+
+def normalize_buckets(buckets) -> "tuple[int, ...]":
+    """Validate + canonicalize a bucket ladder: positive ints, sorted,
+    deduplicated. Raises ``ValueError`` on anything else."""
+    try:
+        out = tuple(sorted({int(b) for b in buckets}))
+    except (TypeError, ValueError):
+        raise ValueError(f"batch buckets must be integers, got {buckets!r}")
+    if not out or out[0] < 1:
+        raise ValueError(f"batch buckets must be positive, got {buckets!r}")
+    return out
+
+
+def set_query_buckets(buckets) -> "tuple[int, ...] | None":
+    """Install (or with ``None`` clear) the process-wide compiled-shape
+    bucket ladder. Padding NEVER changes answers (padded query rows are
+    sliced off every output — the bit-identity contract), only which
+    executable shapes exist; the serving boot sets this once from
+    ``--batch-buckets`` BEFORE warmup so every bucket pre-compiles.
+    Returns the normalized ladder (or None)."""
+    global _QUERY_BUCKETS
+    _QUERY_BUCKETS = None if buckets is None else normalize_buckets(buckets)
+    return _QUERY_BUCKETS
+
+
+def query_buckets() -> "tuple[int, ...] | None":
+    """The active compiled-shape bucket ladder (None = legacy quantum)."""
+    return _QUERY_BUCKETS
+
+
+@contextlib.contextmanager
+def query_bucket_ladder(buckets):
+    """Scoped :func:`set_query_buckets` — tests and bench configs install
+    a ladder for one block and are guaranteed the previous state back."""
+    previous = _QUERY_BUCKETS
+    set_query_buckets(buckets)
+    try:
+        yield _QUERY_BUCKETS
+    finally:
+        set_query_buckets(previous)
+
+
+def query_padded_rows(rows: int) -> int:
+    """THE compiled-shape query-row count for an XLA retrieval dispatch
+    of ``rows`` actual rows — the one definition shared by the pad below,
+    the executable-cache key, and ``obs/accounting.padded_query_rows``
+    (the PR-8 hardening contract). With a bucket ladder installed: the
+    smallest bucket >= rows, and past the top bucket the next multiple of
+    it (so oversized one-shot calls still hit a bounded shape set);
+    without one: the next multiple of :data:`QUERY_PAD_QUANTUM`."""
+    rows = int(rows)
+    if rows <= 0:
+        return 0
+    b = _QUERY_BUCKETS
+    if b:
+        for size in b:
+            if rows <= size:
+                return size
+        top = b[-1]
+        return -(-rows // top) * top
+    return -(-rows // QUERY_PAD_QUANTUM) * QUERY_PAD_QUANTUM
 
 
 def _kneighbors_arrays(
@@ -32,11 +109,21 @@ def _kneighbors_arrays(
     engine: str = "auto",
     cache: "dict | None" = None,
     deferred: bool = False,
+    prefetched_queries=None,
 ):
     """Shared retrieval core for both model families: ``(dists [Q,k],
     indices [Q,k])`` sorted by (distance, train index). Pure geometry — no
     label semantics, so the regressor can use it with negative/float targets
     that the classifier's label validation would reject.
+
+    ``prefetched_queries`` (the serving batcher's double-buffered upload,
+    ``serve/batcher.py``): an already-on-device array of the PADDED query
+    block — shape ``[query_padded_rows(Q), D]``, rows ``[:Q]`` equal to
+    ``test_x`` and the tail zero, exactly what the pad below would build.
+    The XLA path consumes it instead of re-staging + re-uploading, so
+    batch N+1's host→device transfer can overlap batch N's compute; a
+    shape/dtype mismatch (or the stripe engine, which pads inside its own
+    entry) silently falls back to the normal pad — never wrong data.
 
     ``engine`` mirrors the backend knob (VERDICT r1 #6): ``auto`` hands exact
     euclidean narrow-feature problems on a real TPU to the lane-striped
@@ -56,7 +143,7 @@ def _kneighbors_arrays(
     from knn_tpu.backends.tpu import knn_forward_candidates
     from knn_tpu.ops.distance import resolve_form
     from knn_tpu.ops.pallas_knn import stripe_auto_eligible
-    from knn_tpu.utils.padding import pad_axis_to_multiple
+    from knn_tpu.utils.padding import pad_axis_to_multiple, pad_axis_to_size
 
     if engine not in ("auto", "stripe", "xla"):
         raise ValueError(
@@ -88,8 +175,7 @@ def _kneighbors_arrays(
                 engine,
                 -(-train_x.shape[0] // n_tile) * n_tile, train_x.shape[1],
                 train_x.dtype.str,
-                -(-test_x.shape[0] // QUERY_PAD_QUANTUM)
-                * QUERY_PAD_QUANTUM,
+                query_padded_rows(test_x.shape[0]),
                 k, form,
             )
         devprof.record_executable_lookup("retrieval", sig)
@@ -147,19 +233,40 @@ def _kneighbors_arrays(
         txj, tyj = guarded_call("device.put", lambda: memo_device(
             cache, ("xla_candidates_train", train_tile), make
         ))
-        qx, _ = pad_axis_to_multiple(test_x, QUERY_PAD_QUANTUM, axis=0)
+        q_target = query_padded_rows(q)
+        qx = None
+        if prefetched_queries is not None:
+            # The batcher's double-buffered upload: consume only when the
+            # prefetched block really is this dispatch's padded shape (the
+            # batcher staged it from the same request rows through the
+            # same query_padded_rows definition, so a match means same
+            # content + zero tail by construction).
+            pq_shape = getattr(prefetched_queries, "shape", None)
+            pq_dtype = getattr(prefetched_queries, "dtype", None)
+            if (pq_shape == (q_target, test_x.shape[1])
+                    and str(pq_dtype) == str(test_x.dtype)):
+                qx = prefetched_queries
+        if qx is None:
+            qx = pad_axis_to_size(test_x, q_target, axis=0)
     import jax
 
     # The fused distance + running-top-k dispatch (one executable; the two
     # logical phases are inseparable on the XLA path — docs/OBSERVABILITY.md).
-    # rows vs padded_rows: the 128-row query pad is dispatch cost this span
-    # owns up to (docs/OBSERVABILITY.md §Cost & capacity).
+    # rows vs padded_rows: the bucket/quantum query pad is dispatch cost
+    # this span owns up to (docs/OBSERVABILITY.md §Cost & capacity).
+    # Query tile: the kernel sweeps queries in static tiles, so the tile
+    # must divide the padded shape. The legacy 128-quantum pad keeps the
+    # 128-row tile; a bucket below it IS its own (single) tile, and a
+    # non-dividing bucket falls back to the largest common tile — every
+    # bucket stays one compiled executable either way.
+    query_tile = q_target if q_target < 128 else math.gcd(q_target, 128)
     with obs.span("distance", engine="xla", note="fused distance+top-k",
                   rows=q, padded_rows=qx.shape[0]):
         d, i, _ = guarded_call("backend.compile", lambda: knn_forward_candidates(
             txj, tyj, jnp.asarray(qx),
             jnp.asarray(n, jnp.int32),
             k=k, train_tile=train_tile, precision=form,
+            query_tile=query_tile,
         ))
         for leaf in (d, i):
             if hasattr(leaf, "copy_to_host_async"):

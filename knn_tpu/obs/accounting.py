@@ -27,9 +27,11 @@ tests/test_accounting.py):
   rode — never the rung that answered after it was already failed;
 - **padding is waste, measured** — ``knn_cost_padded_rows_total`` counts
   the rows the compiled shape forced beyond the batch's actual rows
-  (XLA pads queries to 128, the stripe kernel to its block grid): the
-  direct measurement of what ROADMAP #2's shape-bucketed batching would
-  save.
+  (XLA pads queries to the dispatched bucket — the installed
+  ``--batch-buckets`` ladder, or the 128-row quantum without one — and
+  the stripe kernel to its block grid): the price of the compiled batch
+  shapes, and the number shape-bucketed batching shrank from the 0.955
+  single-quantum baseline.
 
 Like every obs layer, the accountant is **absent by default** (the
 ``--cost-accounting`` serve flag constructs it): call sites pay one
@@ -71,15 +73,20 @@ def padded_query_rows(engine: str, rows: int, num_features: int = 1,
                       k: int = 5) -> int:
     """Compiled-shape query rows for ONE engine dispatch of ``rows`` actual
     rows — the rows the device really sweeps. XLA pads queries to the
-    128-row quantum (``models/knn.py``), the stripe kernel to its resolved
-    ``block_q`` grid; host engines (oracle/native) pad nothing."""
+    installed bucket ladder's smallest bucket >= rows (or the 128-row
+    quantum while no ladder is set) — resolved from
+    ``models/knn.query_padded_rows``, THE definition the pad and the
+    executable-cache key also use, so waste metrics reflect the real
+    dispatched bucket and can never silently diverge (the PR-8 hardening
+    contract); the stripe kernel pads to its resolved ``block_q`` grid;
+    host engines (oracle/native) pad nothing."""
     rows = int(rows)
     if rows <= 0:
         return 0
     if engine == "xla":
-        from knn_tpu.models.knn import QUERY_PAD_QUANTUM
+        from knn_tpu.models.knn import query_padded_rows
 
-        return -(-rows // QUERY_PAD_QUANTUM) * QUERY_PAD_QUANTUM
+        return query_padded_rows(rows)
     if engine == "stripe":
         from knn_tpu.ops.pallas_knn import stripe_block_sizes
 

@@ -11,9 +11,12 @@ the batcher already knows —
   spent inside a dispatch vs idle in the coalescing window: the most
   direct "how busy is this replica" scalar (1.0 = the worker never waits,
   the queue is the buffer);
-- **batch occupancy** — ``rows / max_batch`` per dispatch
-  (``knn_capacity_batch_occupancy`` histogram): how full the compiled
-  batch shape runs, the coalescing-efficiency signal;
+- **batch occupancy** — ``rows / compiled-shape rows`` per dispatch
+  (``knn_capacity_batch_occupancy`` histogram): how full the dispatched
+  bucket runs — under a ``--batch-buckets`` ladder the denominator is
+  the bucket the batch padded to, so the signal prices the shapes the
+  device really swept, per-batch at the live (possibly OOM-halved)
+  policy snapshot;
 - **an affine dispatch-cost model** ``w(r) ≈ a + b·r`` (ms per dispatch of
   ``r`` rows) fitted by least squares over the window's observed
   ``(rows, wall)`` pairs, seeded at warmup with two post-compile timed
@@ -105,13 +108,20 @@ class CapacityTracker:
         self._served.add(1, int(rows), float(request_ms))
 
     def note_dispatch(self, wall_ms: float, rows: int, padded_rows: int,
-                      max_batch: int) -> None:
+                      max_batch: int, compiled: bool = True) -> None:
         """One completed worker dispatch: its wall (the duty-cycle busy
         time), actual and compiled-shape rows, and the ``max_batch`` in
-        force (OOM recovery shrinks it — occupancy must track the live
-        policy, not the boot value)."""
+        force — the LIVE per-batch snapshot (OOM recovery shrinks it
+        mid-run; occupancy must track the policy each batch really
+        dispatched under, never the boot value, so the metric can
+        neither read > 1 nor understate after a halving).
+        ``compiled=False`` marks a HOST-rung dispatch (ivf/oracle): no
+        compiled shape exists there, so occupancy keeps the
+        coalescing-efficiency meaning ``rows / max_batch`` instead of
+        reading a vacuous 1.0 from ``padded == rows``."""
         self.max_batch = max(1, int(max_batch))
         rows = int(rows)
+        padded_rows = int(padded_rows)
         # When an OOM halves max_batch MID-batch, the re-dispatch arrives
         # here as one (rows > new max_batch) record covering several
         # chunked device calls. Each chunk ran full, so the honest
@@ -119,8 +129,21 @@ class CapacityTracker:
         # excluded from the dispatch-cost fit: its wall paid the model's
         # intercept once PER CHUNK, which w(r) = a + b·r cannot express.
         chunked = rows > self.max_batch
-        occ = min(1.0, rows / self.max_batch)
-        self._dispatches.add(1, float(wall_ms), rows, int(padded_rows),
+        # Occupancy = how full the COMPILED batch shape ran (the
+        # docs/OBSERVABILITY.md definition): rows over the dispatched
+        # bucket's compiled-shape rows. Under a bucket ladder the
+        # denominator is the bucket the batch actually padded to; under
+        # the legacy single quantum it is the padded quantum shape —
+        # either way the shape the device swept, clamped so a
+        # denominator surprise can never read past 1.0.
+        if compiled and padded_rows >= rows > 0:
+            denom = padded_rows
+        else:
+            denom = self.max_batch
+        occ = min(1.0, rows / max(1, denom))
+        if chunked:
+            occ = 1.0
+        self._dispatches.add(1, float(wall_ms), rows, padded_rows,
                              0 if chunked else rows * rows,
                              0.0 if chunked else rows * float(wall_ms),
                              occ,
@@ -130,8 +153,8 @@ class CapacityTracker:
         obs.histogram_observe(
             "knn_capacity_batch_occupancy", occ,
             buckets=OCCUPANCY_BUCKETS,
-            help="rows / max_batch per dispatched micro-batch (how full "
-                 "the compiled batch shape runs)",
+            help="rows / compiled-shape rows per dispatched micro-batch "
+                 "(how full the dispatched bucket runs)",
         )
 
     def seed_dispatch_model(self, rows: int, wall_ms: float) -> None:
@@ -281,7 +304,8 @@ class CapacityTracker:
             ("knn_capacity_served_rows_per_s", served_rows_per_s,
              "answered query rows/s over the observation window"),
             ("knn_capacity_occupancy_mean", occupancy_mean,
-             "mean rows/max_batch per dispatch over the window"),
+             "mean rows / compiled-shape rows per dispatch over the "
+             "window"),
             ("knn_capacity_padded_row_waste_ratio", waste,
              "fraction of compiled-shape rows that were padding over the "
              "window"),

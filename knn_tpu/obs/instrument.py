@@ -146,6 +146,18 @@ def record_serve_queue_wait(ms: float, kind: str) -> None:
     )
 
 
+def record_serve_topup(rows: int) -> None:
+    """Continuous batching admitted ``rows`` into an already-closed batch
+    below its bucket boundary (free rows — the compiled shape the batch
+    pads to is unchanged; serve/batcher.py::_admit_topup)."""
+    obs.counter_add(
+        "knn_serve_topup_rows_total", int(rows),
+        help="query rows admitted into a closed batch up to its bucket "
+             "boundary (continuous batching; they paid no extra wait "
+             "window and no extra compiled rows)",
+    )
+
+
 def record_serve_batch(requests: int, rows: int, dispatch_ms: float,
                        padded_rows: "int | None" = None) -> None:
     """Record one dispatched micro-batch. ``knn_serve_batch_size`` counts
@@ -164,12 +176,24 @@ def record_serve_batch(requests: int, rows: int, dispatch_ms: float,
         help="query rows per dispatched micro-batch",
     )
     if padded_rows is not None:
+        # The histogram stays UNLABELED (pre-ladder dashboards keep
+        # reading the same series); the per-bucket dispatch counts live
+        # on a dedicated counter whose `bucket` label names the compiled
+        # shape — cardinality bounded by the ladder length plus the
+        # (rare) chunked-dispatch sums.
         obs.histogram_observe(
             "knn_serve_batch_padded_rows", padded_rows,
             buckets=SERVE_BATCH_BUCKETS,
             help="compiled-shape query rows per dispatched micro-batch "
-                 "(actual rows + the padding the engine's shape quantum "
-                 "forced)",
+                 "(actual rows + the padding the dispatched bucket or "
+                 "shape quantum forced)",
+        )
+        obs.counter_add(
+            "knn_serve_bucket_dispatch_total", 1,
+            help="micro-batch dispatches per compiled bucket shape "
+                 "(which --batch-buckets rungs the traffic actually "
+                 "exercises)",
+            bucket=int(padded_rows),
         )
     obs.histogram_observe(
         "knn_serve_dispatch_ms", dispatch_ms, buckets=SERVE_MS_BUCKETS,

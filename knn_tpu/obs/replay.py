@@ -12,10 +12,14 @@ over HTTP, and checks the answers:
   records its wall and answer digest;
 - **mutations** replay in ``mutation_seq`` order ON THE DRIVER THREAD,
   each acknowledged before any later event fires: a mutation is a
-  sequence point, so replaying it as a barrier is what keeps later
-  reads' ``mutation_seq`` tags aligned with the capture (an insert
-  overtaking its delete would diverge every read after it); the driver
-  clock absorbs the ack wait and ``late_fires`` counts any slip;
+  sequence point, so replaying it as a TWO-SIDED barrier — every
+  outstanding read drained first (a mutation applies between dispatches
+  and would otherwise jump still-queued reads, serving them at a later
+  ``mutation_seq`` than the capture recorded), then the mutation applied
+  and acknowledged — is what keeps later reads' ``mutation_seq`` tags
+  aligned with the capture (an insert overtaking its delete would
+  diverge every read after it); the driver clock absorbs both waits and
+  ``late_fires`` counts any slip;
 - **verification**: wherever a replayed answer's
   ``(index_version, mutation_seq)`` matches the recorded one, the answer
   digests must match BIT-IDENTICALLY (the canonical float64 digest of
@@ -223,6 +227,7 @@ def replay_workload(workload: Workload, *, batcher=None,
     t_start = time.monotonic()
     with ThreadPoolExecutor(max_workers=pool_size,
                             thread_name_prefix="knn-replay") as pool:
+        outstanding: list = []
         for ev in events:
             if speed > 0:
                 target = t_start + (ev["t_ms"] / 1e3) / speed
@@ -233,6 +238,15 @@ def replay_workload(workload: Workload, *, batcher=None,
                     late_fires += 1
             if "op" in ev:
                 if replay_mutations:
+                    # A sequence-point barrier is two-sided: drain every
+                    # outstanding read FIRST (a mutation applies between
+                    # dispatches and would otherwise jump reads still
+                    # queued, serving them at a later mutation_seq than
+                    # the capture recorded — the flake this closes), then
+                    # apply and wait for the ack.
+                    for f in outstanding:
+                        f.result()
+                    outstanding.clear()
                     _fire_mutation(ev, workload, batcher, base_url,
                                    results, timeout_s)
                 continue
@@ -251,11 +265,11 @@ def replay_workload(workload: Workload, *, batcher=None,
                         "error": f"{type(e).__name__}: {e}", "ms": 0.0,
                     })
                     continue
-                pool.submit(_resolve_inproc, ev, handle, t0, results,
-                            timeout_s)
+                outstanding.append(pool.submit(
+                    _resolve_inproc, ev, handle, t0, results, timeout_s))
             else:
-                pool.submit(_http_read, ev, rows, base_url, results,
-                            timeout_s)
+                outstanding.append(pool.submit(
+                    _http_read, ev, rows, base_url, results, timeout_s))
     wall_s = max(time.monotonic() - t_start, 1e-9)
 
     # -- verdict -------------------------------------------------------------

@@ -134,7 +134,18 @@ def simulate(arrivals: Sequence, *, max_batch: int, max_wait_ms: float,
         busy += wall
         total_rows += rows_b
         total_padded += pad
-        occ_sum += min(1.0, rows_b / max_batch)
+        # Occupancy mirrors the live tracker (obs/capacity.py) FOR
+        # BUCKET POLICIES: rows over the compiled shape the dispatch
+        # padded to — the definition the whatif-vs-live parity test
+        # holds the two to. Bucket-less candidates keep the
+        # rows/max_batch meaning (the sim does not know the engine's
+        # legacy pad quantum, so their occupancy is NOT comparable to a
+        # live quantum-padded serve's — compare bucketed to bucketed).
+        if buckets:
+            occ_sum += min(1.0, rows_b / max(1, pad if pad >= rows_b
+                                             else max_batch))
+        else:
+            occ_sum += min(1.0, rows_b / max_batch)
         dispatches += 1
         t_free = finish
     span_ms = max(t_free - arrivals[0][0], 1e-9)

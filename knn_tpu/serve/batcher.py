@@ -83,7 +83,13 @@ import numpy as np
 
 from knn_tpu import obs
 from knn_tpu.data.dataset import Dataset
-from knn_tpu.models.knn import AsyncResult, KNNClassifier, _kneighbors_arrays
+from knn_tpu.models.knn import (
+    AsyncResult,
+    KNNClassifier,
+    _kneighbors_arrays,
+    normalize_buckets,
+    query_padded_rows,
+)
 from knn_tpu.obs import accounting as acct
 from knn_tpu.obs import instrument, reqtrace
 from knn_tpu.resilience import faults
@@ -233,6 +239,109 @@ class _Request:
         return AsyncResult(finish, meta=self.meta)
 
 
+class _UploadStager:
+    """Per-bucket pinned staging + double-buffered device upload.
+
+    The dispatch worker is single-threaded, so without help batch N+1's
+    host→device transfer cannot start until batch N's result is back.
+    This stager closes that gap: while batch N's device compute is in
+    flight (the fast rung dispatches *deferred* — device work launched,
+    host sync postponed), the worker peeks the queue, stages the rows
+    that will form batch N+1 into a per-bucket host buffer, and starts
+    their upload (``jax.device_put`` returns immediately; the copy
+    proceeds while N computes). At dispatch N+1 the padded block is
+    already resident and the retrieval core consumes it instead of
+    re-padding + re-uploading (``models/knn._kneighbors_arrays``'s
+    ``prefetched_queries``).
+
+    Buffers are **pinned per (bucket, parity)**: each compiled bucket
+    shape owns two ping-pong host arrays reused for every batch — batch
+    N's block stays untouched while N+1 stages into the other parity, and
+    the engine sees the same buffers dispatch after dispatch instead of a
+    fresh allocation each time (the donate-friendly discipline; on CPU
+    jax this is also what lets ``device_put`` alias instead of copy).
+
+    Correctness is by *identity*: a prefetched block is consumed only
+    when the next batch is EXACTLY the request list it was staged from
+    (same objects, same order) — any divergence (new arrivals reshaping
+    the batch, a deadline expiry, a drained queue) silently drops the
+    prefetch and the dispatch re-stages from scratch. Padded shape and
+    zero tail come from the same ``query_padded_rows`` definition the
+    engine pads with, so a consumed block is bit-identical to the pad the
+    engine would have built.
+    """
+
+    __slots__ = ("_num_features", "_buffers", "_flip", "_pending")
+
+    def __init__(self, num_features: int):
+        self._num_features = int(num_features)
+        self._buffers: dict = {}
+        self._flip = 0
+        self._pending = None  # (request id tuple, host rows view, device)
+
+    def _buffer(self, bucket: int) -> np.ndarray:
+        key = (bucket, self._flip)
+        buf = self._buffers.get(key)
+        if buf is None:
+            buf = self._buffers[key] = np.zeros(
+                (bucket, self._num_features), np.float32)
+        return buf
+
+    def prefetch(self, batcher: "MicroBatcher") -> None:
+        """Peek the queue, stage the batch it would form next, and start
+        its device upload. Called by the fast rung BETWEEN dispatching
+        batch N and resolving it — the overlap window. Never raises: a
+        failed prefetch costs only the lost overlap."""
+        try:
+            reqs, rows = [], 0
+            with batcher._cond:
+                for r in batcher._queue:
+                    if rows + r.rows > batcher.max_batch:
+                        break
+                    reqs.append(r)
+                    rows += r.rows
+                    if rows >= batcher.max_batch:
+                        break
+            if not reqs:
+                self._pending = None
+                return
+            bucket = query_padded_rows(rows)
+            if bucket < rows:
+                self._pending = None
+                return
+            import jax
+
+            self._flip ^= 1
+            buf = self._buffer(bucket)
+            off = 0
+            for r in reqs:
+                buf[off:off + r.rows] = r.features
+                off += r.rows
+            buf[off:] = 0.0  # the pad contract: zero tail
+            dev = jax.device_put(buf)
+            # STRONG references to the request objects, matched by `is`
+            # at take(): a bare id() tuple would false-match when a
+            # pending prefetch outlives its (completed, collected)
+            # requests and the allocator hands a later request the same
+            # address — which would serve it the OLD queries' answers.
+            self._pending = (tuple(reqs), buf[:rows], dev)
+        except Exception:  # noqa: BLE001 — prefetch is advisory only
+            self._pending = None
+
+    def take(self, live: "list[_Request]"):
+        """``(host_rows, device_block)`` iff the prefetch was staged from
+        exactly this request list (object identity, in order); else None
+        (and the prefetch is dropped either way — single use)."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        reqs, host, dev = pending
+        if len(reqs) != len(live) or any(a is not b
+                                         for a, b in zip(reqs, live)):
+            return None
+        return host, dev
+
+
 class MicroBatcher:
     """Thread-safe dynamic micro-batching front door for a fitted model.
 
@@ -310,6 +419,22 @@ class MicroBatcher:
                          the replayable traffic record behind
                          ``knn_tpu replay`` (docs/OBSERVABILITY.md
                          §Workload capture & replay).
+    ``buckets``        — the compiled-shape bucket ladder the serving
+                         boot installed (``models/knn.set_query_buckets``
+                         from ``serve --batch-buckets``): enables the
+                         per-bucket double-buffered upload stager and is
+                         reported in the policy blocks. None (the
+                         embedded default) keeps the legacy
+                         pad-to-quantum dispatch byte-identical.
+    ``result_cache_rows`` — capacity (in cached query rows) of the
+                         exact-match result cache
+                         (:mod:`knn_tpu.serve.cache`): identical query
+                         rows at the same ``(index_version,
+                         mutation_seq)`` sequence point are answered
+                         without a dispatch, bit-identical by
+                         construction; invalidated outright by
+                         :meth:`swap_model`. 0 (the default) constructs
+                         nothing.
     """
 
     def __init__(self, model, *, max_batch: int = 256,
@@ -317,7 +442,8 @@ class MicroBatcher:
                  index_version: Optional[str] = None,
                  recorder: "Optional[reqtrace.FlightRecorder]" = None,
                  quality=None, drift=None, accounting=None, capacity=None,
-                 ivf=None, mutable=None, workload=None):
+                 ivf=None, mutable=None, workload=None, buckets=None,
+                 result_cache_rows: int = 0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -327,7 +453,53 @@ class MicroBatcher:
                 f"max_queue_rows ({max_queue_rows}) must be >= max_batch "
                 f"({max_batch}) or full batches could never form"
             )
+        if result_cache_rows < 0:
+            raise ValueError(
+                f"result_cache_rows must be >= 0, got {result_cache_rows}")
         model.train_  # raises RuntimeError before fit — fail at build time
+        # Shape-bucketed dispatch (docs/SERVING.md §Tuning the bucket
+        # ladder): ``buckets`` names the compiled-shape ladder the serving
+        # boot installed via models/knn.set_query_buckets — the batcher
+        # reads the ONE definition (query_padded_rows) for its bucket
+        # boundaries, and uses the ladder here only to (a) report the
+        # policy and (b) construct the upload stager. None (the embedded
+        # default) keeps the legacy single-quantum pad byte-identical and
+        # constructs no stager.
+        self.buckets = None if buckets is None else normalize_buckets(
+            buckets)
+        if self.buckets is not None:
+            from knn_tpu.models.knn import query_buckets
+
+            if query_buckets() != self.buckets:
+                # The pad is process-global; a batcher reporting one
+                # ladder while the engine pads with another would make
+                # every waste metric (and the warmed-executable set) lie.
+                # ServeApp installs the ladder it is handed; direct
+                # embedders must set_query_buckets / query_bucket_ladder
+                # first.
+                raise ValueError(
+                    f"buckets {self.buckets} do not match the installed "
+                    f"query bucket ladder {query_buckets()}; call "
+                    f"models.knn.set_query_buckets(...) first (the serve "
+                    f"boot and ServeApp do this for you)"
+                )
+        self._stager = (
+            _UploadStager(model.train_.num_features)
+            if self.buckets is not None else None
+        )
+        # Worker-confined: set once per _dispatch so a chunked ladder
+        # walk prefetches the next batch exactly once (see fast()).
+        self._prefetched_this_dispatch = False
+        # Exact-match result cache (knn_tpu/serve/cache.py): 0 (the
+        # default) constructs NOTHING — no LRU, no hashing, no
+        # knn_cache_* instruments; one `is None` predicate per dispatch
+        # (scripts/check_disabled_overhead.py pins it).
+        if result_cache_rows > 0:
+            from knn_tpu.serve.cache import ResultCache
+
+            self.cache = ResultCache(result_cache_rows)
+        else:
+            self.cache = None
         self._model = model
         self._index_version = index_version
         self.recorder = recorder
@@ -581,6 +753,13 @@ class MicroBatcher:
                     self._model = previous_model
                     self._index_version = previous
                     raise
+        if self.cache is not None:
+            # The swap/rebase invalidation: every cached answer is keyed
+            # on the OLD version tag and would never hit again — drop the
+            # memory now. (A dispatch that snapshotted the old model
+            # before this swap may still insert old-keyed entries after
+            # the clear; they are unreachable and age out of the LRU.)
+            self.cache.clear()
         return previous
 
     def begin_drain(self) -> None:
@@ -814,31 +993,57 @@ class MicroBatcher:
         """
         train = model.train_
         k, metric = model.k, model.metric
-
-        def fast(feats):
-            return model.kneighbors(
-                Dataset(feats, np.zeros(feats.shape[0], np.int32))
-            )
-
-        def xla(feats):
-            return _kneighbors_arrays(
-                train.features, feats, k, metric=metric, engine="xla",
-                cache=train.device_cache,
-            )
-
-        def oracle(feats):
-            from knn_tpu.backends.oracle import oracle_kneighbors
-
-            return oracle_kneighbors(train.features, feats, k, metric)
-
         if isinstance(model, KNNClassifier):
             engine = model._retrieval_engine()
         else:
             engine = model.engine
+
+        def fast(feats, prefetched=None):
+            if self._stager is not None:
+                # Bucketed serving: dispatch DEFERRED (device work +
+                # result copies in flight when _kneighbors_arrays
+                # returns), start the NEXT batch's host→device upload in
+                # the gap, then resolve — batch N+1's transfer overlaps
+                # batch N's compute. Identical arrays to
+                # model.kneighbors: same retrieval core, same engine
+                # selection, same device cache (submit already validated
+                # the feature width the Dataset path re-checks). ONE
+                # prefetch per dispatch: a post-OOM chunked dispatch
+                # calls this rung once per chunk, and re-staging the
+                # same queue head N times would be pure wasted host
+                # copies + uploads on the already-degraded path.
+                resolve = _kneighbors_arrays(
+                    train.features, feats, k, metric=metric, engine=engine,
+                    cache=train.device_cache, deferred=True,
+                    prefetched_queries=prefetched,
+                )
+                if not self._prefetched_this_dispatch:
+                    self._prefetched_this_dispatch = True
+                    self._stager.prefetch(self)
+                return resolve()
+            return model.kneighbors(
+                Dataset(feats, np.zeros(feats.shape[0], np.int32))
+            )
+
+        def xla(feats, prefetched=None):
+            return _kneighbors_arrays(
+                train.features, feats, k, metric=metric, engine="xla",
+                cache=train.device_cache, prefetched_queries=prefetched,
+            )
+
+        def oracle(feats, prefetched=None):
+            from knn_tpu.backends.oracle import oracle_kneighbors
+
+            return oracle_kneighbors(train.features, np.asarray(feats), k,
+                                     metric)
+
         rungs = []
         if self.ivf is not None and getattr(model, "ivf_", None) is not None:
-            rungs.append(("ivf",
-                          lambda feats: self.ivf.kneighbors(model, feats)))
+            rungs.append((
+                "ivf",
+                lambda feats, prefetched=None:
+                    self.ivf.kneighbors(model, np.asarray(feats)),
+            ))
         rungs.append(("fast", fast))
         if engine != "xla":  # "auto" may resolve to stripe on real TPU
             rungs.append(("xla", xla))
@@ -873,24 +1078,27 @@ class MicroBatcher:
                 return oracle_kneighbors(model.train_.features, feats,
                                          k_wide, model.metric)
 
-        def merged(feats):
-            d, i = fn(feats)
+        def merged(feats, prefetched=None):
+            d, i = fn(feats, prefetched)
             return mstate.merge_candidates(mview, feats, d, i, k,
                                            model.metric, wide)
 
         return merged
 
-    def _call_rung(self, fn, feats):
+    def _call_rung(self, fn, feats, prefetched=None):
         """Dispatch ``feats`` through one rung, chunked to the CURRENT
         ``max_batch`` (which OOM recovery may have shrunk below this
-        batch's row count). Row independence makes the chunked result
+        batch's row count — each chunk re-pads to ITS bucket through the
+        one query_padded_rows definition, so a halved cap re-clamps onto
+        already-compiled ladder shapes instead of dispatching a
+        never-compiled one). Row independence makes the chunked result
         identical to the one-shot dispatch."""
         cap = self.max_batch
         if feats.shape[0] <= cap:
-            return fn(feats)
+            return fn(feats, prefetched)
         dists, idx = [], []
         for s in range(0, feats.shape[0], cap):
-            d, i = fn(feats[s:s + cap])
+            d, i = fn(feats[s:s + cap], None)
             dists.append(d)
             idx.append(i)
         return np.concatenate(dists), np.concatenate(idx)
@@ -962,13 +1170,18 @@ class MicroBatcher:
             )
         return pad
 
-    def _retrieve(self, model, live: "list[_Request]", mview=None):
+    def _retrieve(self, model, live: "list[_Request]", mview=None,
+                  prefetch=None):
         """Candidate retrieval for the coalesced batch, through the
         breaker + ladder. Returns ``(live, dists, idx, rung,
         padded_rows)`` — ``live`` may have shrunk (mid-fallback deadline
         expiries, already failed typed); ``padded_rows`` is the answering
         dispatch's compiled-shape row count (None when nothing consumes
-        it). Raises the last typed error when every rung fails.
+        it). ``prefetch`` is the stager's ``(host_rows, device_block)``
+        double-buffered upload for exactly this batch — consumed while
+        ``live`` is unshrunk (the staged content stops matching once a
+        deadline expiry rebuilds the feature block). Raises the last
+        typed error when every rung fails.
 
         Cost attribution happens HERE, per rung attempt: each attempt's
         measured wall is split across the requests live for it (a failed
@@ -995,12 +1208,21 @@ class MicroBatcher:
         last_err: Optional[Exception] = None
         pos = start
         feats = None  # rebuilt only when `live` shrinks, not per attempt
+        # The double-buffered upload (one per batch, staged by the
+        # PREVIOUS dispatch's overlap window): host rows + resident
+        # device block. Valid for every attempt until `live` shrinks —
+        # the device rungs share one padded shape, so a fast→xla
+        # fallback still rides the same upload.
+        dev_block = None
+        if prefetch is not None:
+            feats, dev_block = prefetch
         with reqtrace.activate(traced):
             while pos < len(rungs):
                 if last_err is not None:
                     kept = self._expire_now(live)
                     if len(kept) != len(live):
                         feats = None
+                        dev_block = None
                         traced[:] = [r.trace for r in kept
                                      if r.trace is not None]
                     live = kept
@@ -1019,13 +1241,13 @@ class MicroBatcher:
                             with obs.span("breaker.probe",
                                           breaker=self.breaker.name):
                                 faults.fault_point("serve.dispatch")
-                                out = self._call_rung(fn, feats)
+                                out = self._call_rung(fn, feats, dev_block)
                         else:
                             faults.fault_point("serve.dispatch")
-                            out = self._call_rung(fn, feats)
+                            out = self._call_rung(fn, feats, dev_block)
                         self.breaker.record_success()
                     else:
-                        out = self._call_rung(fn, feats)
+                        out = self._call_rung(fn, feats, dev_block)
                         self._degraded_rung = pos
                     self._last_rung = name
                     pad = self._account_attempt(model, live, traced, name,
@@ -1093,7 +1315,96 @@ class MicroBatcher:
 
     # -- dispatch ----------------------------------------------------------
 
+    def _admit_topup(self, batch: "list[_Request]") -> None:
+        """Continuous batching: top the closed batch up with requests
+        that arrived AFTER the coalescing window closed but before this
+        dispatch starts, up to the batch's current bucket boundary —
+        those rows ride for free (the compiled shape the batch pads to
+        does not change), so waiting a whole fresh window + dispatch
+        would be pure added latency. The spec is the what-if simulator's
+        bucket policy model (obs/whatif.py): a dispatch of ``rows`` pays
+        for ``query_padded_rows(rows)`` compiled rows either way.
+        Bucketed batchers only: without a ladder the free-rows premise
+        belongs to the legacy pad quantum, not the policy the operator
+        chose — and the embedded default's dispatch composition stays
+        byte-identical to pre-ladder behavior, as documented."""
+        if self.buckets is None:
+            return
+        rows = sum(r.rows for r in batch)
+        boundary = min(query_padded_rows(rows), self.max_batch)
+        if rows >= boundary:
+            return
+        with self._cond:
+            while self._queue and rows + self._queue[0].rows <= boundary:
+                nxt = self._queue.popleft()
+                self._queued_rows -= nxt.rows
+                batch.append(nxt)
+                rows += nxt.rows
+                instrument.record_serve_topup(nxt.rows)
+
+    def _finish_served(self, req: "_Request", d, i, model, version, mview,
+                       merged: bool, rung: str,
+                       cache_hit: bool = False) -> None:
+        """Complete ONE request from its retrieval slice — the tail every
+        served request shares, whether its candidates came from this
+        batch's dispatch or the result cache: meta tags, the per-kind
+        value (vote/aggregate on host), future signal, capacity/quality/
+        drift taps."""
+        req.meta["index_version"] = version
+        req.meta["rung"] = rung
+        if cache_hit:
+            req.meta["cache"] = "hit"
+        if mview is not None:
+            # The read's sequence point: which acknowledged mutations
+            # this answer reflects (the anchor the mutable soak's oracle
+            # replay verifies against).
+            req.meta["mutation_seq"] = mview.seq
+        if req.trace is not None:
+            req.trace.annotate(index_version=version, rung=rung)
+            if cache_hit:
+                req.trace.annotate(cache="hit")
+        if req.kind == "kneighbors":
+            # A cache hit's arrays are the FROZEN shared copies; hand
+            # the caller writable private ones so hit and miss behave
+            # identically for in-process consumers that mutate results.
+            value = (d.copy(), i.copy()) if cache_hit else (d, i)
+        elif merged:
+            # Candidate ids span base+delta: labels/targets must be
+            # gathered across BOTH spaces (a clamped base lookup would
+            # vote with the wrong label).
+            from knn_tpu.mutable.state import predict_from_view
+
+            value = predict_from_view(model, mview, d, i)
+        elif isinstance(model, KNNClassifier):
+            value = model.predict_from_candidates(d, i)
+        else:
+            value = model._predict_from((d, i))
+        req.succeed(value)
+        if self.capacity is not None:
+            self.capacity.note_served(
+                req.rows,
+                (time.monotonic_ns() - req.enqueued_ns) / 1e6,
+            )
+        # Quality tap, AFTER the future is signaled: one RNG draw + an
+        # O(1) append per layer, shed when full — the response is
+        # already on its way to the client.
+        if self.quality is not None:
+            self.quality.offer(
+                features=req.features, kind=req.kind, dists=d, idx=i,
+                preds=(value if req.kind == "predict" else None),
+                rung=rung, model=model, version=version, mview=mview,
+            )
+        if self.drift is not None:
+            self.drift.offer(req.features)
+
     def _dispatch(self, batch: "list[_Request]") -> None:
+        # Continuous-batching top-up BEFORE the snapshot: a topped-up
+        # request was submitted after every mutation this worker has
+        # acknowledged so far, so the snapshot taken below (which
+        # reflects all of them) preserves read-your-writes — the other
+        # order could serve a fresh request at a sequence point older
+        # than state it already observed.
+        self._admit_topup(batch)
         with self._cond:
             # One snapshot per batch: swap_model can never split a batch
             # across two indexes — and the mutable view snapshots in the
@@ -1126,17 +1437,52 @@ class MicroBatcher:
             live.append(req)
         if not live:
             return
+        merged_view = mview is not None and not mview.empty
+        ivf_active = (self.ivf is not None
+                      and getattr(model, "ivf_", None) is not None)
+        miss_keys: "Optional[dict]" = None
+        if self.cache is not None:
+            # Exact-match result cache (knn_tpu/serve/cache.py): keyed on
+            # the snapshot's (version, sequence point) plus the live ivf
+            # operating point, so a hit is bit-identical to what a fresh
+            # dispatch under this snapshot would return. Hits complete
+            # HERE — no dispatch, no device time, no occupancy entry.
+            seq = mview.seq if mview is not None else None
+            nprobe = self.ivf.policy.current() if ivf_active else None
+            misses: "list[_Request]" = []
+            miss_keys = {}
+            for req in live:
+                key = self.cache.key(version, seq, nprobe, req.features)
+                ent = self.cache.get(key)
+                if ent is not None:
+                    hit_d, hit_i, hit_rung = ent
+                    self._finish_served(req, hit_d, hit_i, model, version,
+                                        mview, merged_view, hit_rung,
+                                        cache_hit=True)
+                else:
+                    miss_keys[id(req)] = key
+                    misses.append(req)
+            live = misses
+            if not live:
+                return
         rows = sum(r.rows for r in live)
         for req in live:
             if req.trace is not None:
                 req.trace.phase_start("dispatch")
                 req.trace.annotate(batch_requests=len(live), batch_rows=rows)
+        # The double-buffered upload staged during the PREVIOUS dispatch:
+        # consumed only when it was built from exactly this request list
+        # (identity-matched — cache hits, expiries, or new arrivals
+        # between staging and now silently drop it).
+        prefetch = (self._stager.take(live)
+                    if self._stager is not None else None)
+        self._prefetched_this_dispatch = False
         t0 = time.monotonic()
         try:
             with obs.span("serve.dispatch", requests=len(live),
                           rows=rows) as dispatch_span:
                 live, dists, idx, rung, padded = self._retrieve(
-                    model, live, mview)
+                    model, live, mview, prefetch=prefetch)
                 if not live:
                     # Every request expired mid-fallback — but the failed
                     # rung attempts were real worker busy time the duty
@@ -1146,7 +1492,7 @@ class MicroBatcher:
                     if self.capacity is not None:
                         self.capacity.note_dispatch(
                             (time.monotonic() - t0) * 1e3, rows, rows,
-                            self.max_batch,
+                            self.max_batch, compiled=False,
                         )
                     return
                 if padded is not None and hasattr(dispatch_span, "attrs"):
@@ -1159,63 +1505,40 @@ class MicroBatcher:
                     # Test-only (see __init__): every served neighbor is
                     # off by one train row while distances stay plausible.
                     idx = (idx + 1) % model.train_.num_instances
-                merged = mview is not None and not mview.empty
+                primary = "ivf" if ivf_active else "fast"
+                cacheable = (
+                    self.cache is not None and miss_keys is not None
+                    and rung == primary and not self.corrupt_serving
+                )
                 off = 0
                 for req in live:
                     d = dists[off:off + req.rows]
                     i = idx[off:off + req.rows]
                     off += req.rows
-                    req.meta["index_version"] = version
-                    req.meta["rung"] = rung
-                    if mview is not None:
-                        # The read's sequence point: which acknowledged
-                        # mutations this answer reflects (the anchor the
-                        # mutable soak's oracle replay verifies against).
-                        req.meta["mutation_seq"] = mview.seq
-                    if req.trace is not None:
-                        req.trace.annotate(index_version=version, rung=rung)
-                    if req.kind == "kneighbors":
-                        value = (d, i)
-                    elif merged:
-                        # Candidate ids span base+delta: labels/targets
-                        # must be gathered across BOTH spaces (a clamped
-                        # base lookup would vote with the wrong label).
-                        from knn_tpu.mutable.state import predict_from_view
-
-                        value = predict_from_view(model, mview, d, i)
-                    elif isinstance(model, KNNClassifier):
-                        value = model.predict_from_candidates(d, i)
-                    else:
-                        value = model._predict_from((d, i))
-                    req.succeed(value)
-                    if self.capacity is not None:
-                        self.capacity.note_served(
-                            req.rows,
-                            (time.monotonic_ns() - req.enqueued_ns) / 1e6,
-                        )
-                    # Quality tap, AFTER the future is signaled: one RNG
-                    # draw + an O(1) append per layer, shed when full —
-                    # the response is already on its way to the client.
-                    if self.quality is not None:
-                        self.quality.offer(
-                            features=req.features, kind=req.kind,
-                            dists=d, idx=i,
-                            preds=(value if req.kind == "predict"
-                                   else None),
-                            rung=rung, model=model, version=version,
-                            mview=mview,
-                        )
-                    if self.drift is not None:
-                        self.drift.offer(req.features)
+                    if cacheable:
+                        key = miss_keys.get(id(req))
+                        if key is not None:
+                            # Copies, frozen: the cached arrays outlive
+                            # this batch's buffers and are handed to
+                            # every later hit — nobody may mutate them.
+                            cd, ci = d.copy(), i.copy()
+                            cd.flags.writeable = False
+                            ci.flags.writeable = False
+                            self.cache.put(key, cd, ci, rung)
+                    self._finish_served(req, d, i, model, version, mview,
+                                        merged_view, rung)
             batch_ms = (time.monotonic() - t0) * 1e3
             served_rows = sum(r.rows for r in live)
             instrument.record_serve_batch(
                 len(live), served_rows, batch_ms, padded_rows=padded,
             )
             if self.capacity is not None:
+                # Host rungs (ivf/oracle) have no compiled shape:
+                # occupancy keeps its rows/max_batch coalescing meaning
+                # there instead of a vacuous 1.0 from padded == rows.
                 self.capacity.note_dispatch(
                     batch_ms, served_rows, padded or served_rows,
-                    self.max_batch,
+                    self.max_batch, compiled=rung in ("fast", "xla"),
                 )
         except Exception as e:  # noqa: BLE001 — delivered per-future
             obs.counter_add(
@@ -1232,6 +1555,7 @@ class MicroBatcher:
                     (time.monotonic() - t0) * 1e3,
                     sum(r.rows for r in live),
                     sum(r.rows for r in live), self.max_batch,
+                    compiled=False,
                 )
             for req in live:
                 if not req.event.is_set():

@@ -181,7 +181,26 @@ class ServeApp:
                  capture_queue: int = 1024,
                  capture_burn_threshold: Optional[float] = None,
                  capture_burn_objective: str = "availability",
-                 capture_burn_window_s: float = 60.0):
+                 capture_burn_window_s: float = 60.0,
+                 batch_buckets=None, result_cache_rows: int = 0):
+        self._previous_buckets = None
+        self._installed_buckets = False
+        if batch_buckets is not None:
+            # Make the param REAL for embedders: the compiled-shape pad,
+            # the executable-cache key, and padded-row accounting all
+            # resolve from the process-wide ladder
+            # (models/knn.query_padded_rows) — a ServeApp handed a
+            # ladder must install it, or /healthz would report a policy
+            # that is not in effect. (The CLI installs the same ladder
+            # earlier, before load; set_query_buckets is idempotent.)
+            # close() restores the previous ladder so a later
+            # non-bucketed app (or direct model call) in the same
+            # process is not padded by a policy nothing reports.
+            from knn_tpu.models.knn import query_buckets, set_query_buckets
+
+            self._previous_buckets = query_buckets()
+            set_query_buckets(batch_buckets)
+            self._installed_buckets = True
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -330,6 +349,7 @@ class ServeApp:
             recorder=self.recorder, quality=self.quality, drift=self.drift,
             accounting=self.accounting, capacity=self.capacity,
             ivf=self.ivf, mutable=self.mutable, workload=self.workload,
+            buckets=batch_buckets, result_cache_rows=result_cache_rows,
         )
         if mutable:
             from knn_tpu.mutable.compact import Compactor
@@ -365,9 +385,15 @@ class ServeApp:
         One kind suffices: predict warmup runs the retrieval executable
         (kneighbors) plus a host-side vote that compiles nothing, so a
         separate kneighbors pass would re-dispatch the identical
-        executable for zero extra compilation."""
+        executable for zero extra compilation. Under a ``--batch-buckets``
+        ladder EVERY bucket pre-compiles here (one warmup row count per
+        bucket pads to exactly that bucket's shape), so no user request
+        ever pays a first-dispatch compile whatever batch the traffic
+        forms."""
         if batch_sizes is None:
-            batch_sizes = (1, self.batcher.max_batch)
+            buckets = self.batcher.buckets or ()
+            batch_sizes = tuple(sorted(
+                {1, self.batcher.max_batch, *buckets}))
         self._warm_sizes = tuple(batch_sizes)
         self.warmup_ms = artifact.warmup(
             self.model, batch_sizes=batch_sizes, kinds=("predict",)
@@ -619,6 +645,14 @@ class ServeApp:
         if self.compactor is not None:
             self.compactor.stop()
         self.batcher.close()
+        if self._installed_buckets:
+            # Restore the process-global ladder this app installed (see
+            # __init__) — AFTER the batcher worker has drained, so no
+            # dispatch pads under a half-restored policy.
+            from knn_tpu.models.knn import set_query_buckets
+
+            set_query_buckets(self._previous_buckets)
+            self._installed_buckets = False
         if self.workload is not None:
             # Finalizes any still-armed window first: an incident capture
             # must survive the shutdown the incident may have caused.
@@ -647,6 +681,17 @@ class ServeApp:
             "num_features": self.model.train_.num_features,
             "uptime_s": round(time.time() - self.started_unix, 1),
             "warmup_ms": self.warmup_ms,
+            # The dispatch-shape policy: the compiled bucket ladder (None
+            # = legacy single pad quantum) and the exact-match result
+            # cache's live counters (None — the distinct "cache absent"
+            # state — while --result-cache-rows 0).
+            "batching": {
+                "buckets": (list(self.batcher.buckets)
+                            if self.batcher.buckets else None),
+                "result_cache": (self.batcher.cache.stats()
+                                 if self.batcher.cache is not None
+                                 else None),
+            },
             # export() also refreshes the knn_slo_* gauges, so a /healthz
             # poller keeps them current between /metrics scrapes.
             "slo": self.slo.export(),
@@ -872,6 +917,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "max_batch": b.max_batch,
                 "max_wait_ms": b.max_wait_ms,
                 "max_queue_rows": b.max_queue_rows,
+                # The compiled-shape ladder + result-cache counters: what
+                # an operator tunes after reading the waste numbers above
+                # (docs/SERVING.md §Tuning the bucket ladder).
+                "batch_buckets": list(b.buckets) if b.buckets else None,
+                "result_cache": (b.cache.stats()
+                                 if b.cache is not None else None),
             },
             # Compaction debt is capacity debt: the delta ratio prices
             # the extra per-dispatch merge work, so it belongs on the

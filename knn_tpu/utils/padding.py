@@ -27,3 +27,21 @@ def pad_axis_to_multiple(
     widths = [(0, 0)] * arr.ndim
     widths[axis] = (0, target - n)
     return np.pad(arr, widths, constant_values=value), n
+
+
+def pad_axis_to_size(
+    arr: np.ndarray, size: int, axis: int = 0, value: float = 0.0
+) -> np.ndarray:
+    """Pad ``arr`` along ``axis`` up to an EXACT target size (the
+    bucket-ladder pad, ``models/knn.query_padded_rows``): unlike
+    :func:`pad_axis_to_multiple` the target is a resolved shape, not a
+    quantum. ``size`` below the current extent raises — truncation would
+    silently drop query rows."""
+    n = arr.shape[axis]
+    if size < n:
+        raise ValueError(f"pad target {size} below current size {n}")
+    if size == n:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, size - n)
+    return np.pad(arr, widths, constant_values=value)

@@ -141,9 +141,44 @@ def main() -> int:
                for _name, fn in app.batcher._rungs(app.batcher._model)):
             return fail("the serving ladder wrapped a rung with the "
                         "mutable merge while disabled")
+        # Shape buckets + result cache (PR 12): the embedded defaults
+        # (buckets=None, result_cache_rows=0) must construct NOTHING —
+        # no bucket ladder state, no upload stager, no ResultCache, no
+        # knn_cache_* instruments, and the process-global pad stays the
+        # legacy single quantum.
+        if app.batcher.buckets is not None or app.batcher._stager is not None:
+            return fail("the batcher built a bucket ladder / upload "
+                        "stager with no --batch-buckets configured")
+        if app.batcher.cache is not None:
+            return fail("the batcher built a result cache with "
+                        "result_cache_rows 0 — the layer must not exist "
+                        "while disabled")
+        from knn_tpu.models import knn as knn_mod
+
+        if knn_mod.query_buckets() is not None:
+            return fail("a process-global query bucket ladder is "
+                        "installed with no serve --batch-buckets — the "
+                        "legacy pad quantum must be untouched")
         app.batcher.predict(test.features[0], timeout=60)
     finally:
         app.close()
+    # A SINGLE-bucket ladder with the cache off must construct nothing
+    # NEW either: the one bucket is one compiled shape exactly like the
+    # legacy quantum — no ResultCache, zero knn_cache_* instruments.
+    with knn_mod.query_bucket_ladder((8,)):
+        app_1b = ServeApp(model, max_batch=8, max_wait_ms=0.0,
+                          batch_buckets=(8,), result_cache_rows=0)
+        try:
+            if app_1b.batcher.cache is not None:
+                return fail("a single-bucket ladder with "
+                            "--result-cache-rows 0 built a result cache")
+            app_1b.batcher.predict(test.features[0], timeout=60)
+        finally:
+            app_1b.close()
+    if any(i.name.startswith("knn_cache_")
+           for i in obs.registry().instruments()):
+        return fail("knn_cache_* instrument(s) recorded with the result "
+                    "cache disabled")
     bad_threads = [t.name for t in threading.enumerate()
                    if t.name.startswith(("knn-quality", "knn-drift",
                                          "knn-compactor", "knn-workload"))]
@@ -154,7 +189,7 @@ def main() -> int:
               if i.name.startswith(("knn_quality_", "knn_drift_",
                                     "knn_cost_", "knn_capacity_",
                                     "knn_ivf_", "knn_mutable_",
-                                    "knn_workload_"))]
+                                    "knn_workload_", "knn_cache_"))]
     if leaked:
         return fail(f"quality/drift/cost/capacity/ivf/mutable/workload "
                     f"instrument(s) recorded while disabled: {leaked}")

@@ -94,9 +94,18 @@ def http(base: str, path: str, payload=None, timeout=60):
 
 
 def boot(index: str, env: dict, extra_flags):
+    # --batch-buckets off on BOTH sides: this soak is a controlled
+    # comparison of the INDEX FAMILY (probed approximate vs exact
+    # retrieval) at one fixed dispatch-shape policy — the PR-10
+    # conditions its >= min-speedup bar was measured under. The bucket
+    # ladder (PR 12) cuts the exact rung's query-pad compute so much on
+    # this CI-sized fixture that it would mask the train-side sub-linear
+    # effect being asserted; bucketed-vs-bucketed at production index
+    # sizes is bench.py --config ivf's surface, not this gate's.
     proc = subprocess.Popen(
         [sys.executable, "-m", "knn_tpu.cli", "serve", index,
          "--port", "0", "--max-batch", "32", "--max-wait-ms", "1",
+         "--batch-buckets", "off",
          *extra_flags],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, cwd=REPO,

@@ -48,6 +48,12 @@ READS = 150
 INSERTS = 16
 DELETES = 8
 POLICY = {"max_batch": 16, "max_wait_ms": 1.0}
+#: The replay side runs SHAPE-BUCKETED with the result cache armed: the
+#: gate's zero-divergence assertion then pins that bucketed dispatch,
+#: continuous batching, and cache hits stay bit-identical to the
+#: capture-side answers through the live mutable tier.
+BUCKETS = (2, 4, 8, 16)
+RESULT_CACHE_ROWS = 512
 MAX_QUEUE_ROWS = 4096
 #: The documented predicted-vs-measured p50 agreement band.
 BAND_ABS_MS = 5.0
@@ -194,23 +200,37 @@ def main() -> int:
         if version_b != version:
             return fail(f"twin artifact version {version_b} != {version} — "
                         f"the copy is not byte-faithful")
-        artifact.warmup(model_b, batch_sizes=(1, POLICY["max_batch"]),
-                        kinds=("predict",))
         engine_b = MutableEngine(model_b, dir_b, version=version_b)
         capacity = CapacityTracker(POLICY["max_batch"])
-        seed_capacity(capacity, model_b, POLICY["max_batch"])
-        batcher_b = MicroBatcher(
-            model_b, max_batch=POLICY["max_batch"],
-            max_wait_ms=POLICY["max_wait_ms"],
-            max_queue_rows=MAX_QUEUE_ROWS, index_version=version_b,
-            capacity=capacity, mutable=engine_b,
-        )
-        try:
-            rv = replay_workload(wl, batcher=batcher_b, speed=1.0,
-                                 verify="tag")
-        finally:
-            batcher_b.close()
-            engine_b.close()
+        from knn_tpu.models.knn import query_bucket_ladder
+
+        with query_bucket_ladder(BUCKETS):
+            # Warm EVERY bucket before the clock starts (the serve boot's
+            # rule): a cold bucket's first-dispatch compile would land in
+            # the measured replay AND poison the dispatch-cost fit the
+            # what-if check rides.
+            artifact.warmup(model_b, batch_sizes=(1,) + BUCKETS,
+                            kinds=("predict",))
+            seed_capacity(capacity, model_b, POLICY["max_batch"])
+            batcher_b = MicroBatcher(
+                model_b, max_batch=POLICY["max_batch"],
+                max_wait_ms=POLICY["max_wait_ms"],
+                max_queue_rows=MAX_QUEUE_ROWS, index_version=version_b,
+                capacity=capacity, mutable=engine_b,
+                buckets=BUCKETS, result_cache_rows=RESULT_CACHE_ROWS,
+            )
+            try:
+                rv = replay_workload(wl, batcher=batcher_b, speed=1.0,
+                                     verify="tag")
+            finally:
+                batcher_b.close()
+                engine_b.close()
+        cache_stats = batcher_b.cache.stats()
+        verdict["result_cache"] = cache_stats
+        print(f"replay-gate: bucketed replay (ladder {BUCKETS}) with "
+              f"result cache: {cache_stats['hits']} hits / "
+              f"{cache_stats['misses']} misses / "
+              f"{cache_stats['evictions']} evictions")
         cap_doc = capacity.export()
         verdict["replay"] = rv
         verdict["replay_capacity"] = {
@@ -252,6 +272,7 @@ def main() -> int:
             wl.arrivals(), max_batch=POLICY["max_batch"],
             max_wait_ms=POLICY["max_wait_ms"],
             a_ms=fit["a_ms"], b_ms_per_row=fit["b_ms_per_row"],
+            buckets=BUCKETS,
         )
         band = max(BAND_ABS_MS, BAND_REL * m["p50_ms"])
         delta = abs(sim["p50_ms"] - m["p50_ms"])

@@ -375,7 +375,9 @@ class TestCapacityMath:
         assert out["arrival_qps"] == pytest.approx(0.5)
         assert out["arrival_rows_per_s"] == pytest.approx(1.0)
         assert out["served_qps"] == pytest.approx(0.4)
-        assert out["occupancy_mean"] == pytest.approx(2 / 8)
+        # Occupancy is rows over the COMPILED shape the dispatch padded
+        # to (the bucket-ladder definition): 2 rows in a 128-row shape.
+        assert out["occupancy_mean"] == pytest.approx(2 / 128, abs=1e-4)
         assert out["padded_row_waste_ratio"] == pytest.approx(
             (4 * 128 - 8) / (4 * 128), abs=1e-4)
         assert out["dispatch_rows_per_s"] == pytest.approx(8 / 2.0)

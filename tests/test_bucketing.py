@@ -1,0 +1,513 @@
+"""Shape-bucketed compiled batches, continuous batching, and the
+exact-match result cache (the PR 12 serving hot-path rebuild).
+
+Pinned contracts:
+
+- ``models/knn.query_padded_rows`` is THE one definition (pad,
+  executable-cache key, accounting) and resolves buckets exactly;
+- bucketed dispatch is **bit-identical** to the unbucketed path across
+  rungs x kinds x mutable view on/off x cache hit/miss vs cold;
+- continuous batching tops a closed batch up to its bucket boundary,
+  never past it;
+- the result cache is correct by construction between version/sequence
+  points: a hot swap clears it, a mutation's sequence-point move makes
+  every stale key unreachable;
+- an OOM-halved ``max_batch`` re-clamps onto already-compiled ladder
+  shapes (never a never-compiled one);
+- the what-if simulator's occupancy/waste for a bucket policy match the
+  live bucketed batcher on the committed replay fixture.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models import knn as knn_mod
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor
+from knn_tpu.obs.accounting import padded_query_rows
+from knn_tpu.resilience import faults
+from knn_tpu.serve.batcher import MicroBatcher
+from knn_tpu.serve.cache import ResultCache, query_digest
+from knn_tpu.utils.padding import pad_axis_to_size
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+def _problem(rng, n=160, d=6, q=12, classes=4):
+    train = Dataset(
+        rng.normal(0.0, 2.0, (n, d)).astype(np.float32),
+        rng.integers(0, classes, n).astype(np.int32),
+    )
+    test = rng.normal(0.0, 2.0, (q, d)).astype(np.float32)
+    return train, test
+
+
+# ---------------------------------------------------------------------------
+# The one padded-shape definition
+
+
+class TestQueryBucketLadder:
+    def test_legacy_quantum_without_ladder(self):
+        assert knn_mod.query_buckets() is None
+        assert knn_mod.query_padded_rows(1) == 128
+        assert knn_mod.query_padded_rows(128) == 128
+        assert knn_mod.query_padded_rows(129) == 256
+        assert knn_mod.query_padded_rows(0) == 0
+
+    def test_ladder_pads_to_smallest_bucket(self):
+        with knn_mod.query_bucket_ladder((16, 32, 64)):
+            assert knn_mod.query_padded_rows(1) == 16
+            assert knn_mod.query_padded_rows(16) == 16
+            assert knn_mod.query_padded_rows(17) == 32
+            assert knn_mod.query_padded_rows(64) == 64
+            # Past the top bucket: multiples of it (bounded shape set).
+            assert knn_mod.query_padded_rows(65) == 128
+            assert knn_mod.query_padded_rows(129) == 192
+
+    def test_context_manager_restores_even_nested(self):
+        with knn_mod.query_bucket_ladder((8,)):
+            assert knn_mod.query_padded_rows(3) == 8
+            with knn_mod.query_bucket_ladder((4,)):
+                assert knn_mod.query_padded_rows(3) == 4
+            assert knn_mod.query_padded_rows(3) == 8
+        assert knn_mod.query_buckets() is None
+
+    def test_normalize_validation(self):
+        assert knn_mod.normalize_buckets([32, 8, 8, 16]) == (8, 16, 32)
+        for bad in ([], [0, 8], [-1], ["x"], None):
+            with pytest.raises(ValueError):
+                knn_mod.normalize_buckets(bad)
+
+    def test_accounting_shares_the_definition(self):
+        # The PR-8 hardening contract: padded-row accounting resolves
+        # from the same helper as the pad and the executable-cache key.
+        with knn_mod.query_bucket_ladder((8, 32)):
+            assert padded_query_rows("xla", 3) == 8
+            assert padded_query_rows("xla", 9) == 32
+            assert padded_query_rows("oracle", 9) == 9
+        assert padded_query_rows("xla", 3) == 128
+
+    def test_pad_axis_to_size(self):
+        a = np.ones((3, 2), np.float32)
+        out = pad_axis_to_size(a, 5)
+        assert out.shape == (5, 2) and out[3:].sum() == 0
+        assert pad_axis_to_size(a, 3) is a
+        with pytest.raises(ValueError):
+            pad_axis_to_size(a, 2)
+
+    def test_retrieval_executable_keys_on_bucket(self, rng, obs_on):
+        # Two batch sizes inside one bucket share one executable; a size
+        # in the next bucket is a fresh compile — the cache counters see
+        # exactly that.
+        from knn_tpu.obs import devprof
+
+        train, test = _problem(rng, q=12)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        with knn_mod.query_bucket_ladder((4, 8, 16)):
+            devprof.reset_state()
+            model.kneighbors(Dataset(test[:2], np.zeros(2, np.int32)))
+            model.kneighbors(Dataset(test[:3], np.zeros(3, np.int32)))
+            model.kneighbors(Dataset(test[:7], np.zeros(7, np.int32)))
+            c = devprof.executable_cache_summary()
+        assert c["misses"] == 2  # bucket 4 once, bucket 8 once
+        assert c["hits"] == 1    # 3 rows re-rides the 4-row executable
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: buckets x kinds x rungs x mutable x cache
+
+
+class TestBucketedBitIdentity:
+    @pytest.mark.parametrize("family", ["classifier", "regressor"])
+    def test_bucketed_matches_unbucketed_both_kinds(self, rng, family):
+        train, test = _problem(rng)
+        if family == "classifier":
+            model = KNNClassifier(k=3).fit(train)
+        else:
+            model = KNNRegressor(k=3).fit(train)
+        plain = MicroBatcher(model, max_batch=16, max_wait_ms=0.0)
+        try:
+            want_k = plain.kneighbors(test, timeout=60)
+            want_p = plain.predict(test, timeout=60)
+            want_p1 = plain.predict(test[0], timeout=60)
+        finally:
+            plain.close()
+        with knn_mod.query_bucket_ladder((4, 8, 16)):
+            b = MicroBatcher(model, max_batch=16, max_wait_ms=0.0,
+                             buckets=(4, 8, 16), result_cache_rows=128)
+            try:
+                for _ in range(2):  # second pass = cache hits
+                    got_k = b.kneighbors(test, timeout=60)
+                    np.testing.assert_array_equal(got_k[0], want_k[0])
+                    np.testing.assert_array_equal(got_k[1], want_k[1])
+                    np.testing.assert_array_equal(
+                        b.predict(test, timeout=60), want_p)
+                    np.testing.assert_array_equal(
+                        b.predict(test[0], timeout=60), want_p1)
+                assert b.cache.stats()["hits"] > 0
+            finally:
+                b.close()
+
+    def test_degraded_rungs_stay_bit_identical_bucketed(self, rng):
+        # Every-rung coverage: a persistent fast-rung fault walks the
+        # ladder (fast -> xla -> oracle); each degraded answer must equal
+        # the healthy one, bucketed, with the cache on (cold + hit).
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="auto").fit(train)
+        want = model.predict(Dataset(test, np.zeros(len(test), np.int32)))
+        with knn_mod.query_bucket_ladder((4, 8, 16)):
+            b = MicroBatcher(model, max_batch=16, max_wait_ms=0.0,
+                             buckets=(4, 8, 16), result_cache_rows=128)
+            try:
+                with faults.inject("serve.dispatch=always"):
+                    got = b.predict(test, timeout=60)
+                np.testing.assert_array_equal(got, want)
+                # Degraded answers are NOT cached (rung != primary): the
+                # next healthy dispatch is a fresh primary-rung answer.
+                assert b.cache.stats()["entries"] == 0
+                np.testing.assert_array_equal(
+                    b.predict(test, timeout=60), want)
+                assert b.cache.stats()["entries"] == 1
+                h = b.submit(test, "predict")
+                np.testing.assert_array_equal(h.result(timeout=60), want)
+                assert h.meta.get("cache") == "hit"
+            finally:
+                b.close()
+
+    def test_mutable_view_bucketed_matches_unbucketed(self, rng, tmp_path):
+        # Two byte-identical artifact stacks, identical mutations; the
+        # bucketed+cached one must answer bit-identically to the plain
+        # one at every sequence point.
+        import shutil
+
+        from knn_tpu.mutable.engine import MutableEngine
+        from knn_tpu.serve import artifact
+
+        train, test = _problem(rng, n=80, q=6)
+        model = KNNClassifier(k=3).fit(train)
+        artifact.save_index(model, tmp_path / "a")
+        shutil.copytree(tmp_path / "a", tmp_path / "b")
+
+        def build(d, bucketed):
+            m = artifact.load_index(d)
+            eng = MutableEngine(m, d, version="v1")
+            kw = dict(max_batch=8, max_wait_ms=0.0, mutable=eng,
+                      index_version="v1")
+            if bucketed:
+                kw.update(buckets=(2, 4, 8), result_cache_rows=64)
+            return MicroBatcher(m, **kw), eng
+
+        plain, eng_a = build(tmp_path / "a", False)
+        with knn_mod.query_bucket_ladder((2, 4, 8)):
+            bucketed, eng_b = build(tmp_path / "b", True)
+            try:
+                ins = rng.normal(0.0, 2.0, (2, test.shape[1])).astype(
+                    np.float32)
+                for bat in (plain, bucketed):
+                    bat.submit_mutation(
+                        "insert", {"rows": ins, "values": [1, 2]}
+                    ).result(timeout=60)
+                    bat.submit_mutation(
+                        "delete", {"ids": [0]}).result(timeout=60)
+                for _ in range(2):  # pass 2 = cache hits on the bucketed side
+                    hk_p = plain.submit(test, "kneighbors")
+                    hk_b = bucketed.submit(test, "kneighbors")
+                    wk, gk = hk_p.result(timeout=60), hk_b.result(timeout=60)
+                    assert hk_p.meta["mutation_seq"] == hk_b.meta[
+                        "mutation_seq"]
+                    np.testing.assert_array_equal(gk[0], wk[0])
+                    np.testing.assert_array_equal(gk[1], wk[1])
+                    np.testing.assert_array_equal(
+                        bucketed.predict(test, timeout=60),
+                        plain.predict(test, timeout=60))
+                assert bucketed.cache.stats()["hits"] > 0
+            finally:
+                plain.close()
+                bucketed.close()
+                eng_a.close()
+                eng_b.close()
+
+    def test_ivf_rung_bucketed_matches_unbucketed(self, rng, tmp_path):
+        from knn_tpu.index.ivf import IVFIndex, IVFServing
+
+        train, test = _problem(rng, n=240, q=8)
+        model = KNNClassifier(k=3).fit(train)
+        model.ivf_ = IVFIndex.build(train.features, 8, seed=0)
+
+        def serving():
+            return IVFServing(2, 8)
+
+        plain = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                             ivf=serving())
+        try:
+            want = plain.kneighbors(test, timeout=60)
+        finally:
+            plain.close()
+        with knn_mod.query_bucket_ladder((2, 4, 8)):
+            b = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                             ivf=serving(), buckets=(2, 4, 8),
+                             result_cache_rows=64)
+            try:
+                for _ in range(2):
+                    got = b.kneighbors(test, timeout=60)
+                    np.testing.assert_array_equal(got[0], want[0])
+                    np.testing.assert_array_equal(got[1], want[1])
+                assert b.cache.stats()["hits"] > 0
+            finally:
+                b.close()
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching
+
+
+class _HeldBatcher(MicroBatcher):
+    """A batcher whose worker never runs — tests drive _collect/_dispatch
+    deterministically on the test thread."""
+
+    def _supervise(self):  # pragma: no cover — intentionally inert
+        return
+
+
+class TestContinuousBatching:
+    def test_topup_admits_up_to_bucket_boundary(self, rng, obs_on):
+        train, test = _problem(rng, q=8)
+        model = KNNClassifier(k=3).fit(train)
+        want = model.predict(Dataset(test, np.zeros(len(test), np.int32)))
+        with knn_mod.query_bucket_ladder((4, 8)):
+            b = _HeldBatcher(model, max_batch=8, max_wait_ms=0.0,
+                             buckets=(4, 8))
+            handles = [b.submit(test[0], "predict")]
+            batch = b._collect()
+            assert len(batch) == 1
+            # Arrivals AFTER the window closed, BEFORE dispatch: the
+            # batch's bucket is 4, so exactly 3 more single-row requests
+            # ride free — the rest stay queued for the next window.
+            handles += [b.submit(test[i], "predict") for i in range(1, 6)]
+            b._dispatch(batch)
+            for i in range(4):
+                np.testing.assert_array_equal(
+                    handles[i].result(timeout=5), want[i])
+            assert b.pending_rows() == 2  # 2 requests past the boundary
+            for h in handles[4:]:
+                assert h.meta.get("rung") is None  # untouched, still queued
+            assert obs_on.counter(
+                "knn_serve_topup_rows_total").value == 3
+            b.close(timeout=0.1)
+
+    def test_no_topup_without_room(self, rng):
+        from knn_tpu.resilience.errors import DeadlineExceededError
+
+        train, test = _problem(rng, q=8)
+        model = KNNClassifier(k=3).fit(train)
+        with knn_mod.query_bucket_ladder((4, 8)):
+            b = _HeldBatcher(model, max_batch=8, max_wait_ms=0.0,
+                             buckets=(4, 8))
+            h1 = b.submit(test[:4], "predict")  # exactly bucket 4
+            batch = b._collect()
+            h2 = b.submit(test[4], "predict")
+            b._dispatch(batch)
+            h1.result(timeout=5)
+            assert b.pending_rows() == 1  # no free slot below the boundary
+            with pytest.raises(DeadlineExceededError):
+                h2.result(timeout=0.05)
+            b.close(timeout=0.1)
+
+
+# ---------------------------------------------------------------------------
+# The result cache
+
+
+class TestResultCache:
+    def test_lru_evicts_by_rows(self):
+        c = ResultCache(4)
+        mk = lambda rows: (np.zeros((rows, 3)), np.zeros((rows, 3), np.int32))
+        for n, rows in (("a", 2), ("b", 2)):
+            d, i = mk(rows)
+            c.put((n,), d, i, "fast")
+        assert c.stats()["rows"] == 4
+        d, i = mk(2)
+        c.put(("c",), d, i, "fast")  # evicts the LRU entry "a"
+        assert c.get(("a",)) is None
+        assert c.get(("c",)) is not None
+        s = c.stats()
+        assert s["rows"] == 4 and s["evictions"] == 1
+
+    def test_oversized_entry_not_cached(self):
+        c = ResultCache(2)
+        c.put(("big",), np.zeros((3, 3)), np.zeros((3, 3), np.int32), "fast")
+        assert c.stats()["entries"] == 0
+
+    def test_digest_is_bit_exact(self):
+        a = np.array([[1.0, -0.0]], np.float32)
+        b = np.array([[1.0, 0.0]], np.float32)
+        assert query_digest(a) != query_digest(b)  # -0.0 is a different row
+        assert query_digest(a) == query_digest(a.copy())
+
+    def test_swap_model_clears_cache(self, rng):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        b = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                         index_version="v1", result_cache_rows=64)
+        try:
+            b.predict(test[0], timeout=60)
+            assert b.cache.stats()["entries"] == 1
+            b.swap_model(model, "v2")  # the hot-reload path
+            assert b.cache.stats()["entries"] == 0
+            h = b.submit(test[0], "predict")
+            h.result(timeout=60)
+            # Fresh version, fresh key: a miss, never a stale v1 answer.
+            assert h.meta.get("cache") != "hit"
+            assert h.meta["index_version"] == "v2"
+        finally:
+            b.close()
+
+    def test_mutation_seq_invalidates_by_key(self, rng, tmp_path):
+        from knn_tpu.mutable.engine import MutableEngine
+        from knn_tpu.serve import artifact
+
+        train, test = _problem(rng, n=60, q=4)
+        model = KNNClassifier(k=3).fit(train)
+        artifact.save_index(model, tmp_path / "idx")
+        m = artifact.load_index(tmp_path / "idx")
+        eng = MutableEngine(m, tmp_path / "idx", version="v1")
+        b = MicroBatcher(m, max_batch=8, max_wait_ms=0.0, mutable=eng,
+                         index_version="v1", result_cache_rows=64)
+        try:
+            q0 = test[0]
+            h0 = b.submit(q0, "kneighbors")
+            d0, i0 = h0.result(timeout=60)
+            seq0 = h0.meta["mutation_seq"]
+            # Insert the query row itself: the new delta row becomes the
+            # exact-match nearest neighbor — a stale cached answer would
+            # be visibly wrong.
+            b.submit_mutation("insert", {"rows": q0[None, :],
+                                         "values": [1]}).result(timeout=60)
+            h1 = b.submit(q0, "kneighbors")
+            d1, i1 = h1.result(timeout=60)
+            assert h1.meta["mutation_seq"] == seq0 + 1
+            assert h1.meta.get("cache") != "hit"  # new seq point = new key
+            assert d1[0, 0] == 0.0  # the freshly inserted exact match won
+            assert not np.array_equal(i1, i0)
+            # Same seq point again: NOW it hits, with the merged answer.
+            h2 = b.submit(q0, "kneighbors")
+            d2, i2 = h2.result(timeout=60)
+            assert h2.meta.get("cache") == "hit"
+            np.testing.assert_array_equal(d2, d1)
+            np.testing.assert_array_equal(i2, i1)
+        finally:
+            b.close()
+            eng.close()
+
+    def test_cache_counters_exported(self, rng, obs_on):
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        b = MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                         result_cache_rows=64)
+        try:
+            b.predict(test[0], timeout=60)
+            b.predict(test[0], timeout=60)
+        finally:
+            b.close()
+        assert obs_on.counter("knn_cache_hits_total").value == 1
+        assert obs_on.counter("knn_cache_misses_total").value == 1
+
+
+# ---------------------------------------------------------------------------
+# OOM halving x bucket ladder
+
+
+class TestOOMHalvingReclamp:
+    def test_halved_cap_redispatches_on_compiled_buckets(self, rng, obs_on):
+        train, test = _problem(rng, q=8)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        want = model.predict(Dataset(test, np.zeros(len(test), np.int32)))
+        with knn_mod.query_bucket_ladder((2, 4, 8)):
+            from knn_tpu.serve.artifact import warmup
+
+            warmup(model, batch_sizes=(2, 4, 8), kinds=("predict",))
+            b = MicroBatcher(model, max_batch=8, max_wait_ms=1.0,
+                             buckets=(2, 4, 8))
+            try:
+                with faults.inject("serve.dispatch=once:oom"):
+                    got = b.predict(test, timeout=60)
+                assert b.max_batch == 4  # halved in place
+                np.testing.assert_array_equal(got, want)
+                # The chunked re-dispatch re-clamped onto LADDER shapes:
+                # 8 rows at cap 4 = two 4-row chunks, each padding to the
+                # already-compiled 4-row bucket — padded accounting says
+                # exactly that (2 chunks x 4 compiled rows).
+                from knn_tpu.obs.accounting import dispatch_padded_rows
+
+                assert dispatch_padded_rows(model, "fast", 8,
+                                            b.max_batch) == 8
+                got2 = b.predict(test, timeout=60)  # post-halve steady state
+                np.testing.assert_array_equal(got2, want)
+            finally:
+                b.close()
+
+
+# ---------------------------------------------------------------------------
+# What-if simulator <-> live bucketed batcher parity
+
+
+class TestWhatifLiveParity:
+    @pytest.mark.slow
+    def test_simulator_matches_live_occupancy_and_waste(self):
+        """Replay the committed fixture through the REAL bucketed batcher
+        and hold the simulator's predicted occupancy/waste for the same
+        policy to the measured values (the replay-gate agreement
+        contract, here for the two shape metrics the bucket ladder
+        exists to move)."""
+        from tests import fixtures
+        from knn_tpu.obs import whatif
+        from knn_tpu.obs.capacity import CapacityTracker
+        from knn_tpu.obs.replay import replay_workload
+        from knn_tpu.obs.workload import load_workload
+        from knn_tpu.serve.artifact import warmup
+
+        wl = load_workload(fixtures.REPLAY_WORKLOAD_DIR)
+        policy = wl.manifest["policy"]
+        buckets = (2, 4, 8, 16)
+        model = fixtures.replay_fixture_model()
+        with knn_mod.query_bucket_ladder(buckets):
+            warmup(model, batch_sizes=(1,) + buckets, kinds=("predict",))
+            capacity = CapacityTracker(policy["max_batch"])
+            b = MicroBatcher(
+                model, max_batch=policy["max_batch"],
+                max_wait_ms=policy["max_wait_ms"],
+                index_version=fixtures.REPLAY_FIXTURE_VERSION,
+                capacity=capacity, buckets=buckets,
+            )
+            try:
+                v = replay_workload(wl, batcher=b, speed=1.0,
+                                    verify="off")
+            finally:
+                b.close()
+            cap = capacity.export()
+        assert v["measured"]["errors"] == 0
+        fit = cap["dispatch_model"]
+        sim = whatif.simulate(
+            wl.arrivals(), max_batch=policy["max_batch"],
+            max_wait_ms=policy["max_wait_ms"],
+            a_ms=fit["a_ms"] or 1.0, b_ms_per_row=fit["b_ms_per_row"] or 0.0,
+            buckets=buckets,
+        )
+        # The same definition on both sides (rows / compiled bucket), so
+        # the agreement band is about batch-formation timing jitter, not
+        # semantics.
+        assert abs(sim["occupancy_mean"] - cap["occupancy_mean"]) <= 0.25
+        assert abs(sim["padded_row_waste_ratio"]
+                   - cap["padded_row_waste_ratio"]) <= 0.2

@@ -314,7 +314,12 @@ class TestServeExitCodes:
                       # The mutable-tier knobs (PR 10) keep it too.
                       ["--delta-cap", "0"],
                       ["--compact-threshold", "0"],
-                      ["--compact-interval-s", "-1"]):
+                      ["--compact-interval-s", "-1"],
+                      # The bucket-ladder / result-cache knobs (PR 12).
+                      ["--batch-buckets", "a,b"],
+                      ["--batch-buckets", "0"],
+                      ["--batch-buckets", "16,512"],  # > --max-batch 256
+                      ["--result-cache-rows", "-1"]):
             assert run(["serve", "/irrelevant/index", *extra]) == 2, extra
             assert "error:" in self._err(capsys)
 

@@ -641,8 +641,12 @@ class TestServer:
         from knn_tpu.serve.server import ServeApp, make_server
 
         train, test = _problem(rng)
+        # A LONG coalesce window: the parked row must still be queued
+        # when the overflow probe lands, even on a contended CI box (the
+        # filler request below closes the batch early at max_batch, so
+        # the test never actually waits the window out).
         app = ServeApp(KNNClassifier(k=3, engine="xla").fit(train),
-                       max_batch=2, max_queue_rows=2, max_wait_ms=2000.0)
+                       max_batch=2, max_queue_rows=2, max_wait_ms=20000.0)
         server = make_server(app)
         host, port = server.server_address[:2]
         threading.Thread(target=server.serve_forever, daemon=True).start()
@@ -668,6 +672,11 @@ class TestServer:
                 time.sleep(0.01)
             assert st == 429, (st, body)
             assert "error" in body
+            # Close the parked batch NOW (1+1 rows = max_batch) instead
+            # of riding out the coalesce window.
+            st_fill, _ = _post(base, "/predict", {
+                "instances": [test.features[3].tolist()]})
+            assert st_fill == 200
             t.join(timeout=30)
             assert first["resp"][0] == 200  # the parked request still served
         finally:
