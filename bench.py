@@ -1351,6 +1351,48 @@ def bench_ivf():
         t0 = time.monotonic()
         model.kneighbors(test)
         batch_qps = round(q / (time.monotonic() - t0), 1)
+
+        # Host vs device candidate scorer (PR 13, ROADMAP item 2): the
+        # SAME coverage/probe set scored by the numpy gather+einsum vs
+        # the fused device segment kernel + exact re-rank — one-shot
+        # full-test-set dispatch at a mid-sweep nprobe, best-of walls
+        # after a warm pass (compiles excluded), with Gdist/s =
+        # candidate distances evaluated per second.
+        scorer_np = min(8, cells)
+        d_feat = train.num_features
+
+        def scorer_wall(mode, reps=3):
+            ivf.search(train.features, test.features, K, scorer_np,
+                       scorer=mode)  # warm (compile + operand upload)
+            best, stats = None, None
+            for _ in range(reps):
+                t0 = time.monotonic()
+                _, _, stats = ivf.search(
+                    train.features, test.features, K, scorer_np,
+                    scorer=mode)
+                wall = time.monotonic() - t0
+                best = wall if best is None else min(best, wall)
+            return best, stats
+
+        host_wall, host_stats = scorer_wall("host")
+        dev_wall, dev_stats = scorer_wall("device")
+        scorer_row = {
+            "nprobe": scorer_np,
+            "host_wall_ms": round(host_wall * 1e3, 2),
+            "device_wall_ms": round(dev_wall * 1e3, 2),
+            "host_gdist_s": round(
+                host_stats.candidate_rows * d_feat / host_wall / 1e9, 4),
+            "device_gdist_s": round(
+                dev_stats.candidate_rows * d_feat / dev_wall / 1e9, 4),
+            "device_speedup": round(host_wall / dev_wall, 2),
+            "device_padded_candidate_rows":
+                dev_stats.padded_candidate_rows,
+        }
+        log(f"ivf[{name}] scorer host {scorer_row['host_wall_ms']} ms "
+            f"({scorer_row['host_gdist_s']} Gdist/s) vs device "
+            f"{scorer_row['device_wall_ms']} ms "
+            f"({scorer_row['device_gdist_s']} Gdist/s) — "
+            f"{scorer_row['device_speedup']}x")
         row = {
             "train_rows": train.num_instances,
             "queries": q,
@@ -1359,6 +1401,7 @@ def bench_ivf():
             "cell_imbalance": ivf.imbalance(),
             "exact_qps": exact_qps,
             "exact_batch_qps": batch_qps,
+            "scorer": scorer_row,
             "sweep": {},
         }
         speedup_at_floor = recall_at_floor = None
@@ -1399,6 +1442,9 @@ def bench_ivf():
         large_exact_qps=lg["exact_qps"],
         medium_speedup_at_recall95=(
             record["fixtures"]["medium"]["speedup_at_recall95"]),
+        large_device_scorer_speedup=lg["scorer"]["device_speedup"],
+        large_device_gdist_s=lg["scorer"]["device_gdist_s"],
+        large_host_gdist_s=lg["scorer"]["host_gdist_s"],
     )
     return record
 
@@ -1564,18 +1610,40 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
 
     exact_d, exact_i = model.kneighbors(test)
     ivf = IVFIndex.build(train.features, 64, seed=0)
-    ivf.search(train.features, test.features[:8], K, 8)  # warm caches
+    # scorer pinned per metric: the armed ivf_kneighbors_wall_ms keeps
+    # the HOST scorer its baseline was measured on (auto would silently
+    # route this fixture to the device kernel and the two metrics would
+    # measure the same thing); the device metric below owns that path.
+    ivf.search(train.features, test.features[:8], K, 8,
+               scorer="host")  # warm caches
     ivf_trials = []
     for _ in range(predict_reps):
         t0 = time.monotonic()
         ivf_d, ivf_i, _stats = ivf.search(
-            train.features, test.features, K, 8)
+            train.features, test.features, K, 8, scorer="host")
         ivf_trials.append(round((time.monotonic() - t0) * 1e3, 3))
     ivf_recall = round(float(recall_at_k(
         ivf_i, exact_i, exact_d.astype(np.float64),
         ivf_d.astype(np.float64)).mean()), 4)
     log(f"gate ivf (64 cells, nprobe 8): best {min(ivf_trials)} ms vs "
         f"exact kneighbors {min(kn_trials)} ms, recall {ivf_recall}")
+    # PR 13 device scorer: the same probed search forced through the
+    # fused gather+score kernel + exact re-rank. Bit-identity to the
+    # host trials above is pinned by tests; here only the wall gates.
+    ivf.search(train.features, test.features, K, 8,
+               scorer="device")  # warm: compile + operand upload
+    ivf_dev_trials = []
+    for _ in range(predict_reps):
+        t0 = time.monotonic()
+        dev_d, dev_i, _stats = ivf.search(
+            train.features, test.features, K, 8, scorer="device")
+        ivf_dev_trials.append(round((time.monotonic() - t0) * 1e3, 3))
+    if not (np.array_equal(dev_i, ivf_i)
+            and np.array_equal(dev_d, ivf_d)):
+        raise AssertionError(
+            "gate: device ivf scorer diverged from the host scorer")
+    log(f"gate ivf device scorer: best {min(ivf_dev_trials)} ms vs host "
+        f"{min(ivf_trials)} ms")
 
     import os
 
@@ -1621,6 +1689,11 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
                                        "direction": "lower", "unit": "ms"},
             "ivf_recall_at_k": {"trials": [ivf_recall],
                                 "direction": "higher", "unit": "ratio"},
+            # PR 13 device-path telemetry: report-only until a baseline
+            # refresh carries it (the same arming rule as above).
+            "ivf_device_kneighbors_wall_ms": {"trials": ivf_dev_trials,
+                                              "direction": "lower",
+                                              "unit": "ms"},
         },
     }
 
@@ -1665,7 +1738,8 @@ _SUMMARY_EXTRA = {
                 "c8_occupancy_mean", "c8_padded_row_waste_ratio",
                 "c8_duty_cycle"),
     "ivf": ("large_speedup_at_recall95", "large_recall", "large_nprobe",
-            "large_exact_qps", "medium_speedup_at_recall95"),
+            "large_exact_qps", "medium_speedup_at_recall95",
+            "large_device_scorer_speedup", "large_device_gdist_s"),
     "replay": ("replay_p50_ms", "replay_qps", "captured_p50_ms",
                "unpaced_qps", "verified", "divergences", "whatif_p50_ms",
                "whatif_abs_err_ms"),

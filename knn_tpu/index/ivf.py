@@ -37,6 +37,7 @@ candidate rows scanned, and cell imbalance (docs/OBSERVABILITY.md).
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -55,6 +56,44 @@ IVF_ATTR = "ivf_"
 #: both the gathered rows and the diff tensor.
 _CHUNK_ELEMS = int(2e7)
 
+#: ``scorer="auto"`` routes a search to the device gather+score kernel
+#: (``ops/segment_score.py``) once the batch's candidate work
+#: (queries x padded-candidates x features) crosses this bound — below
+#: it the host scorer wins outright (a jit dispatch costs more than the
+#: whole numpy scan). ``KNN_TPU_IVF_SCORER=host|device`` overrides the
+#: auto rule process-wide (docs/INDEXES.md §On-device scoring).
+DEVICE_SCORER_MIN_ELEMS = int(4e6)
+
+#: Cell counts at or above this rank centroids with ``lax.approx_max_k``
+#: (the TPU's hardware-binned approximate selection) instead of an exact
+#: host argsort — at ~10k cells the O(Q·C·log C) exact ranking starts to
+#: rival the probed scan it is meant to shortcut. Recall stays held to
+#: the configured floor by the shadow-scorer ``approx_floors`` machinery
+#: exactly as the probed approximation is. KNN_TPU_IVF_APPROX_CELLS
+#: overrides (tests force it low to exercise the rung).
+APPROX_RANK_MIN_CELLS = 10_000
+
+
+def _approx_rank_threshold() -> int:
+    try:
+        return int(os.environ.get("KNN_TPU_IVF_APPROX_CELLS",
+                                  APPROX_RANK_MIN_CELLS))
+    except ValueError:
+        return APPROX_RANK_MIN_CELLS
+
+
+def _scorer_mode(requested: str) -> str:
+    """Resolve the effective scorer mode: an explicit caller choice wins,
+    then the KNN_TPU_IVF_SCORER env override, then auto."""
+    if requested not in ("auto", "host", "device"):
+        raise ValueError(
+            f"unknown scorer {requested!r}; choose 'auto', 'host', or "
+            f"'device'")
+    if requested != "auto":
+        return requested
+    env = os.environ.get("KNN_TPU_IVF_SCORER", "auto")
+    return env if env in ("host", "device") else "auto"
+
 
 class IVFSearchStats(NamedTuple):
     """What one :meth:`IVFIndex.search` call actually did."""
@@ -64,6 +103,11 @@ class IVFSearchStats(NamedTuple):
     forced_widenings: int  # doubling rounds forced by k-coverage
     candidate_rows: int    # total train rows scored across the batch
     cells_probed: int      # queries x nprobe
+    scorer: str = "host"   # which scorer answered (host | device)
+    ranking: str = "exact"  # centroid ranking (exact | approx)
+    dead_rows: int = 0     # tombstoned rows occupying probed cells
+    padded_candidate_rows: int = 0  # compiled-shape candidate waste
+    merged_delta: bool = False      # delta tail fused into this dispatch
 
 
 class IVFIndex:
@@ -76,7 +120,7 @@ class IVFIndex:
     the index never copies them.
     """
 
-    __slots__ = ("centroids", "row_perm", "cell_offsets", "meta")
+    __slots__ = ("centroids", "row_perm", "cell_offsets", "meta", "_cache")
 
     def __init__(self, centroids: np.ndarray, row_perm: np.ndarray,
                  cell_offsets: np.ndarray, meta: Optional[dict] = None):
@@ -84,6 +128,14 @@ class IVFIndex:
         self.row_perm = np.ascontiguousarray(row_perm, np.int64)
         self.cell_offsets = np.ascontiguousarray(cell_offsets, np.int64)
         self.meta = dict(meta or {})
+        # Per-index memo for derived layouts: the device-resident
+        # permuted-train operands (built on the first device-scored
+        # search, keyed on the train array's identity with a strong ref
+        # so the id can never be recycled) and the host inverse
+        # permutation the delete-aware accounting reads. The index and
+        # its train set are immutable for a generation, so one entry
+        # each suffices; compaction swaps in a fresh index+train pair.
+        self._cache: dict = {}
 
     # -- construction ------------------------------------------------------
 
@@ -115,6 +167,35 @@ class IVFIndex:
             "empty_cells": int(info["empty_cells"]),
             "metric": "euclidean",
         })
+
+    @classmethod
+    def assign_to(cls, features: np.ndarray,
+                  previous: "IVFIndex") -> "IVFIndex":
+        """Incremental rebuild: assign ``features`` to the PREVIOUS
+        generation's centroids — one deterministic jitted assignment
+        step, no Lloyd's — and rebuild the inverted file around them.
+        The compaction fast path (``mutable/compact.py``): folding a few
+        thousand delta rows into a million-row partition does not move
+        the centroid field enough to justify re-clustering; when it
+        eventually does, the imbalance check there falls back to a full
+        :meth:`build`. Cells are Voronoi regions either way, so
+        correctness is untouched — assignment quality only moves
+        recall-per-probe."""
+        from knn_tpu.index.kmeans import assign_cells
+
+        features = np.asarray(features, np.float32)
+        assign = assign_cells(features, previous.centroids)
+        num_cells = previous.num_cells
+        row_perm = np.argsort(assign, kind="stable").astype(np.int64)
+        counts = np.bincount(assign, minlength=num_cells)
+        cell_offsets = np.zeros(num_cells + 1, np.int64)
+        np.cumsum(counts, out=cell_offsets[1:])
+        meta = dict(previous.meta)
+        meta.update(
+            empty_cells=int((counts == 0).sum()),
+            incremental=True,
+        )
+        return cls(previous.centroids, row_perm, cell_offsets, meta=meta)
 
     # -- introspection -----------------------------------------------------
 
@@ -229,18 +310,196 @@ class IVFIndex:
         cand[qof, pos - qstart[qof]] = self.row_perm[src]
         return cand
 
+    def _device_operands(self, train_x: np.ndarray):
+        """The device-resident permuted-train pair for the segment
+        scorer, memoized per train array identity (a strong ref keeps
+        the id stable)."""
+        from knn_tpu.ops import segment_score
+
+        hit = self._cache.get("device")
+        if hit is not None and hit[0] is train_x:
+            return hit[1], hit[2]
+        perm_rows, perm_ids = segment_score.device_operands(
+            train_x, self.row_perm)
+        self._cache["device"] = (train_x, perm_rows, perm_ids)
+        return perm_rows, perm_ids
+
+    def _inverse_perm(self) -> np.ndarray:
+        inv = self._cache.get("inv_perm")
+        if inv is None:
+            inv = np.empty(self.num_rows, np.int64)
+            inv[self.row_perm] = np.arange(self.num_rows)
+            self._cache["inv_perm"] = inv
+        return inv
+
+    def dead_rows_per_cell(self, tomb_base: np.ndarray) -> np.ndarray:
+        """``[C]`` tombstoned-but-not-yet-compacted base rows per cell —
+        what the delete-aware k-coverage widening subtracts from raw cell
+        sizes (a probed cell full of dead rows must not satisfy coverage)
+        and the ``knn_ivf_dead_candidate_rows_total`` counter reads."""
+        tomb_base = np.asarray(tomb_base, np.int64)
+        if tomb_base.size == 0:
+            return np.zeros(self.num_cells, np.int64)
+        pos = self._inverse_perm()[tomb_base]
+        cells = np.searchsorted(self.cell_offsets, pos, side="right") - 1
+        return np.bincount(cells, minlength=self.num_cells)
+
+    def _rank_cells(self, queries: np.ndarray, need: int):
+        """Top-``need`` cells per query: ``(sel [Q, need], ranking)``.
+
+        Exact (the default): centroid distances in the oracle's diff
+        form + a stable argsort, so equal centroid distances probe the
+        lower cell id first — deterministic probe order. Approx (at or
+        past the APPROX_RANK_MIN_CELLS threshold, and never at full
+        probe): ``lax.approx_max_k`` over matmul-form distances on the
+        device — ranking only, candidates are still scored exactly, so
+        the cost is recall (held to the floor by the shadow scorer),
+        never wrong distances."""
+        c = self.num_cells
+        if c >= _approx_rank_threshold() and need < c:
+            try:
+                from knn_tpu.ops import segment_score
+
+                cents = self._cache.get("centroids_dev")
+                if cents is None:
+                    import jax.numpy as jnp
+
+                    cents = jnp.asarray(self.centroids)
+                    self._cache["centroids_dev"] = cents
+                return segment_score.rank_cells_approx(
+                    queries, cents, need), "approx"
+            except Exception:  # noqa: BLE001 — ranking must never fail a
+                pass           # query; the exact path below always works
+        order = self._cache.get("last_order")
+        if order is None or order[0] is not queries:
+            diff = queries[:, None, :] - self.centroids[None, :, :]
+            cd = np.einsum("qcd,qcd->qc", diff, diff, dtype=np.float32)
+            np.nan_to_num(cd, copy=False, nan=np.inf)
+            order = (queries, np.argsort(cd, axis=1, kind="stable"))
+            # Memoized for the widening loop only (same queries object);
+            # the next search overwrites it.
+            self._cache["last_order"] = order
+        return order[1][:, :need], "exact"
+
+    def _coverage(self, queries: np.ndarray, k: int, nprobe: int,
+                  dead_per_cell: Optional[np.ndarray]):
+        """Rank + k-coverage widening. Returns ``(sel, counts, nprobe,
+        forced, ranking, dead_rows)`` where ``counts`` is RAW candidate
+        rows per query (the gather shape) and coverage is checked on
+        LIVE rows (raw minus tombstoned — the delete-aware rule: a
+        tombstoned row still occupies its probed cell until compaction,
+        so it cannot count toward k)."""
+        c = self.num_cells
+        sizes = self.cell_sizes
+        live_sizes = (sizes - dead_per_cell if dead_per_cell is not None
+                      else sizes)
+        forced = 0
+        while True:
+            sel, ranking = self._rank_cells(queries, nprobe)
+            if not sel.size:  # zero queries: nothing to cover
+                break
+            live = live_sizes[sel].sum(axis=1)
+            if int(live.min()) >= k or nprobe >= c:
+                break
+            nprobe = min(c, nprobe * 2)
+            forced += 1
+        counts = sizes[sel].sum(axis=1)
+        dead_rows = (int(dead_per_cell[sel].sum())
+                     if dead_per_cell is not None else 0)
+        return sel, counts, nprobe, forced, ranking, dead_rows
+
+    def _exact_rerank(self, train_x: np.ndarray, queries: np.ndarray,
+                      cand: np.ndarray, k: int):
+        """Host exact re-rank of the device scorer's survivors: the
+        oracle einsum form (per-pair values are shape-invariant, so
+        these are bit-identical to the host scorer's distances) +
+        ``lexicographic_topk`` — the one tie contract."""
+        n = self.num_rows
+        gathered = train_x[np.minimum(cand, n - 1)]
+        gdiff = queries[:, None, :] - gathered
+        d = np.einsum("qmd,qmd->qm", gdiff, gdiff, dtype=np.float32)
+        np.nan_to_num(d, copy=False, nan=np.inf)
+        d[cand >= n] = np.inf
+        return lexicographic_topk(d, cand, k)
+
+    def _score_host(self, train_x: np.ndarray, queries: np.ndarray,
+                    k: int, sel: np.ndarray, counts: np.ndarray):
+        n, q = train_x.shape[0], queries.shape[0]
+        sizes = self.cell_sizes
+        dists_out = np.empty((q, k), np.float32)
+        idx_out = np.empty((q, k), np.int64)
+        d_feat = max(train_x.shape[1], 1)
+        m_global = int(counts.max()) if q else 0
+        chunk = max(1, min(q or 1,
+                           _CHUNK_ELEMS // max(m_global * d_feat, 1)))
+        for s in range(0, q, chunk):
+            e = min(q, s + chunk)
+            # Pad slots carry candidate index n (sorts after every
+            # real index, so a real +inf-distance candidate still
+            # wins the tie) and distance +inf.
+            cand = self._gather_candidates(sel[s:e], sizes, counts[s:e])
+            gathered = train_x[np.minimum(cand, n - 1)]
+            gdiff = queries[s:e][:, None, :] - gathered
+            d = np.einsum("qmd,qmd->qm", gdiff, gdiff,
+                          dtype=np.float32)
+            np.nan_to_num(d, copy=False, nan=np.inf)
+            d[cand == n] = np.inf
+            dists_out[s:e], idx_out[s:e] = lexicographic_topk(
+                d, cand, k)
+        return dists_out, idx_out
+
+    def _score_device(self, train_x: np.ndarray, queries: np.ndarray,
+                      k: int, sel: np.ndarray, counts: np.ndarray,
+                      tail=None, view=None, metric: str = "euclidean"):
+        """The device gather+score path (``ops/segment_score.py``): one
+        fused dispatch selects top-(k+margin) survivors by device
+        distances, the host re-rank restores exact bit-identical
+        values/order. ``tail``/``view`` fuse the mutable delta block
+        into the same dispatch. Returns ``(dists, idx,
+        padded_candidate_rows)``."""
+        from knn_tpu.models.knn import candidate_padded_rows
+        from knn_tpu.ops import segment_score
+
+        q = queries.shape[0]
+        perm_rows, perm_ids = self._device_operands(train_x)
+        starts = self.cell_offsets[:-1][sel].astype(np.int32)
+        lens = self.cell_sizes[sel].astype(np.int32)
+        m_actual = int(counts.max()) if q else 0
+        d_dev, cand = segment_score.segment_topk(
+            perm_rows, perm_ids, queries, starts, lens, m_actual, k,
+            tail=tail)
+        waste = q * candidate_padded_rows(m_actual) - int(counts.sum())
+        if tail is None:
+            d, i = self._exact_rerank(train_x, queries, cand, k)
+        else:
+            from knn_tpu.mutable.device_tail import rerank_merged
+
+            d, i = rerank_merged(view, train_x, queries, cand, k, metric)
+        return d, i, max(waste, 0)
+
     def search(self, train_x: np.ndarray, queries: np.ndarray, k: int,
-               nprobe: int):
+               nprobe: int, *, scorer: str = "auto",
+               dead_per_cell: Optional[np.ndarray] = None):
         """Probed retrieval: ``(dists [Q,k] f32, indices [Q,k] int64,
         stats)`` under the shared (distance, index) tie order.
 
         Distances of the probed candidates are EXACT — computed with the
-        oracle backend's einsum form on the same float32 operands, which
-        is what makes the full-probe path bit-identical to
+        oracle backend's einsum form on the same float32 operands (the
+        device scorer selects survivors on device and re-ranks them
+        through the same einsum, so both scorers return identical bits),
+        which is what makes the full-probe path bit-identical to
         ``oracle_kneighbors`` and keeps the shadow scorer's
         distance-divergence check silent on this rung. Queries with NaN
         features follow the framework NaN → +inf policy.
+
+        ``scorer``: ``"auto"`` (device once the candidate work crosses
+        :data:`DEVICE_SCORER_MIN_ELEMS`, host below — overridable via
+        ``KNN_TPU_IVF_SCORER``), ``"host"``, or ``"device"``.
+        ``dead_per_cell``: per-cell live-tombstone counts
+        (:meth:`dead_rows_per_cell`) — k-coverage widening then counts
+        only LIVE rows toward coverage.
         """
+        mode = _scorer_mode(scorer)
         train_x = np.asarray(train_x, np.float32)
         queries = np.asarray(queries, np.float32)
         n, q = train_x.shape[0], queries.shape[0]
@@ -251,53 +510,106 @@ class IVFIndex:
         c = self.num_cells
         k = min(int(k), n)
         requested = min(max(1, int(nprobe)), c)
-        nprobe = requested
         with obs.span("ivf.search", rows=q, nprobe=requested, k=k):
-            # Rank cells per query (fast matmul form would do — ranking
-            # only — but C is small, so keep the oracle's diff form and
-            # one less code path).
-            diff = queries[:, None, :] - self.centroids[None, :, :]
-            cd = np.einsum("qcd,qcd->qc", diff, diff, dtype=np.float32)
-            np.nan_to_num(cd, copy=False, nan=np.inf)
-            # Stable argsort: equal centroid distances probe the lower
-            # cell id first — deterministic probe order.
-            order = np.argsort(cd, axis=1, kind="stable")
-            sizes = self.cell_sizes
-            # k-coverage widening: never return short.
-            forced = 0
-            while True:
-                counts = sizes[order[:, :nprobe]].sum(axis=1)
-                if int(counts.min()) >= k or nprobe >= c:
-                    break
-                nprobe = min(c, nprobe * 2)
-                forced += 1
-            sel = order[:, :nprobe]
-            dists_out = np.empty((q, k), np.float32)
-            idx_out = np.empty((q, k), np.int64)
+            sel, counts, nprobe, forced, ranking, dead_rows = \
+                self._coverage(queries, k, requested, dead_per_cell)
             d_feat = max(train_x.shape[1], 1)
             m_global = int(counts.max()) if q else 0
-            chunk = max(1, min(q or 1,
-                               _CHUNK_ELEMS // max(m_global * d_feat, 1)))
-            for s in range(0, q, chunk):
-                e = min(q, s + chunk)
-                # Pad slots carry candidate index n (sorts after every
-                # real index, so a real +inf-distance candidate still
-                # wins the tie) and distance +inf.
-                cand = self._gather_candidates(sel[s:e], sizes,
-                                               counts[s:e])
-                gathered = train_x[np.minimum(cand, n - 1)]
-                gdiff = queries[s:e][:, None, :] - gathered
-                d = np.einsum("qmd,qmd->qm", gdiff, gdiff,
-                              dtype=np.float32)
-                np.nan_to_num(d, copy=False, nan=np.inf)
-                d[cand == n] = np.inf
-                dists_out[s:e], idx_out[s:e] = lexicographic_topk(
-                    d, cand, k)
+            use_device = mode == "device" or (
+                mode == "auto"
+                and q * m_global * d_feat >= DEVICE_SCORER_MIN_ELEMS)
+            padded_rows = 0
+            if use_device:
+                try:
+                    dists_out, idx_out, padded_rows = self._score_device(
+                        train_x, queries, k, sel, counts)
+                except Exception:
+                    if mode == "device":
+                        raise  # forced: the caller wants the failure
+                    use_device = False  # auto: the host path always works
+            if not use_device:
+                dists_out, idx_out = self._score_host(
+                    train_x, queries, k, sel, counts)
         return dists_out, idx_out, IVFSearchStats(
             nprobe=nprobe, requested=requested, forced_widenings=forced,
             candidate_rows=int(counts.sum()) if q else 0,
             cells_probed=q * nprobe,
+            scorer="device" if use_device else "host",
+            ranking=ranking, dead_rows=dead_rows,
+            padded_candidate_rows=padded_rows if use_device else 0,
         )
+
+    def search_merged(self, train_x: np.ndarray, queries: np.ndarray,
+                      k: int, nprobe: int, view, *, scorer: str = "auto",
+                      dead_per_cell: Optional[np.ndarray] = None,
+                      metric: str = "euclidean"):
+        """Probed retrieval MERGED with a live mutable view — the fused
+        half of the device hot path: when the view's delta block is
+        device-resident and no base rows are tombstoned, the delta tail
+        is scored beside the probed candidates in the SAME device
+        dispatch and the one two-key sort covers base+delta
+        (``ops/segment_score._segment_topk_delta_core``). Otherwise the
+        host scorer + host merge answer (tombstoned-base views keep the
+        host path because the host merge's per-row oracle widening has
+        no fixed compiled shape — docs/INDEXES.md §On-device scoring).
+        Returns ``(dists, idx, stats)`` in the view's positional id
+        space."""
+        from knn_tpu.mutable import state as mstate
+
+        mode = _scorer_mode(scorer)
+        train_x = np.asarray(train_x, np.float32)
+        queries = np.asarray(queries, np.float32)
+        q = queries.shape[0]
+        # The merged answer can draw from base AND delta slots — clamp k
+        # to the combined width (the PR-10 host-merge contract: the base
+        # retrieval clamps itself to base rows, lexicographic_topk to
+        # the concatenated columns).
+        k_eff = min(int(k), self.num_rows + view.count)
+        tail = getattr(view, "device", None)
+        fuse = (tail is not None and view.tomb_base.size == 0
+                and mode != "host")
+        if fuse:
+            with obs.span("ivf.search", rows=q, nprobe=nprobe, k=k_eff,
+                          merged_delta=True):
+                # Coverage is a BASE concern: probe for the base share
+                # of k (what the host fallback's search would cover),
+                # the delta columns ride along regardless.
+                sel, counts, nprobe_used, forced, ranking, dead_rows = \
+                    self._coverage(queries, min(k_eff, self.num_rows),
+                                   min(max(1, int(nprobe)),
+                                       self.num_cells), dead_per_cell)
+                try:
+                    d, i, padded = self._score_device(
+                        train_x, queries, k_eff, sel, counts, tail=tail,
+                        view=view, metric=metric)
+                    return d, i, IVFSearchStats(
+                        nprobe=nprobe_used,
+                        requested=min(max(1, int(nprobe)),
+                                      self.num_cells),
+                        forced_widenings=forced,
+                        candidate_rows=int(counts.sum()) if q else 0,
+                        cells_probed=q * nprobe_used,
+                        scorer="device", ranking=ranking,
+                        dead_rows=dead_rows,
+                        padded_candidate_rows=padded, merged_delta=True,
+                    )
+                except Exception:
+                    if mode == "device":
+                        raise
+                    # auto: fall through to the host merge below.
+        d, i, stats = self.search(
+            train_x, queries, k_eff, nprobe, scorer=mode,
+            dead_per_cell=dead_per_cell)
+
+        def wide(wfeats, k_wide):
+            wd, wi, _ = self.search(
+                train_x, wfeats, k_wide, nprobe, scorer=mode,
+                dead_per_cell=dead_per_cell)
+            return wd, wi
+
+        d, i = mstate.merge_candidates(view, queries, d, i, k_eff,
+                                       metric, wide)
+        return d, i, stats
 
 
 class IVFServing:
@@ -313,22 +625,44 @@ class IVFServing:
     """
 
     def __init__(self, base_probes: int, num_cells: int, *, slo=None,
-                 recall_floor: float = 0.95, policy=None):
+                 recall_floor: float = 0.95, policy=None,
+                 scorer: str = "auto"):
         if not 0.0 < recall_floor <= 1.0:
             raise ValueError(
                 f"recall_floor must be in (0, 1], got {recall_floor}")
         from knn_tpu.index.probe_policy import ProbePolicy
 
         self.recall_floor = float(recall_floor)
+        self.scorer = _scorer_mode(scorer)
         self.policy = policy if policy is not None else ProbePolicy(
             base_probes, num_cells, slo=slo)
+        # Per-tombstone-set memo for the delete-aware per-cell dead
+        # counts (views share their tomb arrays between mutations, so
+        # identity is the cheap and correct key).
+        self._dead_cache: Optional[tuple] = None
 
     def set_num_cells(self, num_cells: int) -> None:
         """Re-bound the policy after a hot reload swapped in an index
         with a different cell count."""
         self.policy.set_num_cells(num_cells)
+        self._dead_cache = None
 
-    def kneighbors(self, model, feats: np.ndarray, k: Optional[int] = None):
+    def _dead_per_cell(self, index: IVFIndex, view):
+        """Per-cell live-tombstone counts for the k-coverage widening
+        (``IVFIndex.dead_rows_per_cell``), memoized on the view's shared
+        tombstone array identity."""
+        if view is None or view.tomb_base.size == 0:
+            return None
+        hit = self._dead_cache
+        if (hit is not None and hit[0] is view.tomb_base
+                and hit[1] is index):
+            return hit[2]
+        counts = index.dead_rows_per_cell(view.tomb_base)
+        self._dead_cache = (view.tomb_base, index, counts)
+        return counts
+
+    def kneighbors(self, model, feats: np.ndarray,
+                   k: Optional[int] = None, view=None):
         """One ivf-rung dispatch for the micro-batcher: policy-chosen
         ``nprobe``, probed search, instruments. Returns ``(dists, idx)``
         like every other rung closure. ``k`` overrides ``model.k`` for
@@ -336,14 +670,32 @@ class IVFServing:
         (``knn_tpu/mutable/state.py``) — the probed search takes k as a
         plain host argument, so widening recompiles nothing and the
         delta rows are searched exhaustively beside the probed cells by
-        the merge layer."""
+        the merge layer. ``view`` — a live (non-empty)
+        :class:`~knn_tpu.mutable.state.MutableView`: the answer is then
+        MERGED with the delta tier + tombstones, fused into the device
+        dispatch when the view carries a device-resident tail
+        (``IVFIndex.search_merged``), and the delete-aware per-cell dead
+        counts feed the coverage widening either way."""
         index = getattr(model, IVF_ATTR, None)
         if index is None:  # reload validation forbids this; stay typed
             raise DataError("serving model has no ivf partition")
         train = model.train_
-        dists, idx, stats = index.search(
-            train.features, feats, model.k if k is None else k,
-            self.policy.current())
+        kq = model.k if k is None else k
+        dead = self._dead_per_cell(index, view)
+        if view is not None and not view.empty:
+            dists, idx, stats = index.search_merged(
+                train.features, feats, kq, self.policy.current(), view,
+                scorer=self.scorer, dead_per_cell=dead,
+                metric=model.metric)
+        else:
+            dists, idx, stats = index.search(
+                train.features, feats, kq, self.policy.current(),
+                scorer=self.scorer, dead_per_cell=dead)
+        self._record(index, feats, stats)
+        return dists, idx
+
+    def _record(self, index: IVFIndex, feats: np.ndarray,
+                stats: IVFSearchStats) -> None:
         obs.gauge_set(
             "knn_ivf_probes", stats.nprobe,
             help="cells probed per query by the last ivf-rung dispatch "
@@ -363,20 +715,44 @@ class IVFServing:
             help="train rows gathered and exactly scored by ivf probes "
                  "(the sub-linear win: compare with train_rows x queries)",
         )
+        obs.counter_add(
+            "knn_ivf_scorer_dispatch_total", 1,
+            help="ivf-rung dispatches by the scorer that answered "
+                 "(device = the fused gather+score kernel, host = the "
+                 "numpy scan) and centroid ranking mode",
+            scorer=stats.scorer, ranking=stats.ranking,
+        )
         if stats.forced_widenings:
             obs.counter_add(
                 "knn_ivf_forced_widenings_total", stats.forced_widenings,
                 help="probe doublings forced because the probed cells "
-                     "held fewer than k candidates (the never-return-"
-                     "short guarantee)",
+                     "held fewer than k LIVE candidates (the never-"
+                     "return-short guarantee, tombstone-aware)",
             )
-        return dists, idx
+        if stats.dead_rows:
+            obs.counter_add(
+                "knn_ivf_dead_candidate_rows_total", stats.dead_rows,
+                help="tombstoned rows that occupied probed cells "
+                     "(scanned but never returnable until compaction "
+                     "folds them — probe-policy-visible dead work)",
+            )
+        if stats.padded_candidate_rows:
+            obs.counter_add(
+                "knn_ivf_padded_candidate_rows_total",
+                stats.padded_candidate_rows,
+                help="compiled-shape candidate rows beyond the gathered "
+                     "candidates (the device scorer's bucket-ladder "
+                     "pad — the candidate-axis twin of "
+                     "knn_cost_padded_rows_total)",
+            )
 
     def export(self, model=None) -> dict:
         """The ``/healthz`` ivf block."""
         index = getattr(model, IVF_ATTR, None) if model is not None else None
         doc = {
             "recall_floor": self.recall_floor,
+            "scorer": self.scorer,
+            "approx_rank_min_cells": _approx_rank_threshold(),
             **self.policy.export(),
         }
         if index is not None:

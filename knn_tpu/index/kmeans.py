@@ -89,6 +89,24 @@ def _plus_plus_seeds(x: np.ndarray, num_cells: int,
     return seeds
 
 
+def assign_cells(x: np.ndarray, centroids: np.ndarray,
+                 batch_rows: int = DEFAULT_BATCH_ROWS) -> np.ndarray:
+    """ONE deterministic nearest-centroid assignment step over existing
+    centroids — the incremental-compaction primitive
+    (``IVFIndex.assign_to``): folded rows get cells without re-running
+    Lloyd's. Ties break to the lowest cell id exactly like the builder's
+    rounds (argmin first-minimum)."""
+    x = np.ascontiguousarray(x, np.float32)
+    centroids = np.ascontiguousarray(centroids, np.float32)
+    if x.ndim != 2 or centroids.ndim != 2 \
+            or x.shape[1] != centroids.shape[1]:
+        raise ValueError(
+            f"assign_cells wants [N, D] rows and [C, D] centroids, got "
+            f"{x.shape} and {centroids.shape}")
+    assign, _ = _assign_batched(x, centroids, batch_rows)
+    return assign
+
+
 def kmeans(x: np.ndarray, num_cells: int, *, seed: int = 0,
            iters: int = 25, tol: float = 1e-4,
            batch_rows: int = DEFAULT_BATCH_ROWS):

@@ -38,6 +38,14 @@ DEFAULT_BATCH_BUCKETS = (16, 32, 64, 128, 256)
 #: legacy pad-to-``QUERY_PAD_QUANTUM`` behavior byte-identical.
 _QUERY_BUCKETS: "tuple[int, ...] | None" = None
 
+#: The candidate-count bucket ladder for the device IVF gather+score
+#: kernel (``ops/segment_score.py``): the probed candidate axis ``M``
+#: varies with every (nprobe, cell-size) combination, so without buckets
+#: every dispatch would compile a fresh executable. A fixed geometric
+#: ladder keeps the compiled-shape set small; past the top bucket the
+#: shape steps in top-bucket multiples (the ``query_padded_rows`` rule).
+DEFAULT_CANDIDATE_BUCKETS = (256, 512, 1024, 2048, 4096, 8192, 16384)
+
 
 def normalize_buckets(buckets) -> "tuple[int, ...]":
     """Validate + canonicalize a bucket ladder: positive ints, sorted,
@@ -101,6 +109,26 @@ def query_padded_rows(rows: int) -> int:
     return -(-rows // QUERY_PAD_QUANTUM) * QUERY_PAD_QUANTUM
 
 
+def candidate_padded_rows(rows: int) -> int:
+    """THE compiled-shape candidate-row count for one device IVF
+    gather+score dispatch of ``rows`` actual candidates per query — the
+    ``query_padded_rows`` twin for the candidate axis, and the one
+    definition shared by the segment-score pad (``ops/segment_score.py``),
+    its executable-cache key, and the cost layer's candidate-waste
+    accounting (``obs/accounting.padded_candidate_rows``), so the waste
+    metrics can never silently diverge from the pad that really happens
+    (the PR-8/PR-12 one-definition contract). Smallest ladder bucket
+    >= rows; past the top bucket, the next multiple of it."""
+    rows = int(rows)
+    if rows <= 0:
+        return 0
+    for size in DEFAULT_CANDIDATE_BUCKETS:
+        if rows <= size:
+            return size
+    top = DEFAULT_CANDIDATE_BUCKETS[-1]
+    return -(-rows // top) * top
+
+
 def _kneighbors_arrays(
     train_x: np.ndarray,
     test_x: np.ndarray,
@@ -110,11 +138,23 @@ def _kneighbors_arrays(
     cache: "dict | None" = None,
     deferred: bool = False,
     prefetched_queries=None,
+    merge_tail=None,
 ):
     """Shared retrieval core for both model families: ``(dists [Q,k],
     indices [Q,k])`` sorted by (distance, train index). Pure geometry — no
     label semantics, so the regressor can use it with negative/float targets
     that the classifier's label validation would reject.
+
+    ``merge_tail`` (the mutable tier's device-resident delta tail,
+    ``knn_tpu/mutable/device_tail.py``): a callable
+    ``(d_dev, i_dev, queries_dev) -> (d_dev, i_dev)`` applied to the XLA
+    path's DEVICE outputs before the host copy starts — the delta block
+    is scored and merged into the base top-k in the same device round
+    trip as the base retrieval (one host sync for base+delta instead of
+    a per-batch host merge). XLA engine only (the stripe kernel pads and
+    fetches inside its own entry); its ``sig`` attribute joins the
+    executable-cache key so a merged dispatch never aliases an unmerged
+    one.
 
     ``prefetched_queries`` (the serving batcher's double-buffered upload,
     ``serve/batcher.py``): an already-on-device array of the PADDED query
@@ -178,10 +218,21 @@ def _kneighbors_arrays(
                 query_padded_rows(test_x.shape[0]),
                 k, form,
             )
+        if merge_tail is not None:
+            # The fused delta merge is a second executable chained onto
+            # the retrieval: its shape (delta capacity, merged width) is
+            # part of what compiles, so it is part of the key.
+            sig = sig + (getattr(merge_tail, "sig", "merge_tail"),)
         devprof.record_executable_lookup("retrieval", sig)
     if engine == "stripe":
         if not euclidean:
             raise ValueError("the stripe engine implements euclidean only")
+        if merge_tail is not None:
+            raise ValueError(
+                "merge_tail is an XLA-path hook; the stripe kernel pads "
+                "and fetches inside its own entry (the caller routes "
+                "stripe dispatches through the host merge instead)"
+            )
         from knn_tpu.ops.pallas_knn import stripe_candidates_arrays
         from knn_tpu.resilience.retry import guarded_call
 
@@ -262,12 +313,19 @@ def _kneighbors_arrays(
     query_tile = q_target if q_target < 128 else math.gcd(q_target, 128)
     with obs.span("distance", engine="xla", note="fused distance+top-k",
                   rows=q, padded_rows=qx.shape[0]):
+        qxj = jnp.asarray(qx)
         d, i, _ = guarded_call("backend.compile", lambda: knn_forward_candidates(
-            txj, tyj, jnp.asarray(qx),
+            txj, tyj, qxj,
             jnp.asarray(n, jnp.int32),
             k=k, train_tile=train_tile, precision=form,
             query_tile=query_tile,
         ))
+        if merge_tail is not None:
+            # Device-resident delta tail: score + merge the delta block
+            # on device, chained onto the base retrieval's outputs —
+            # base+delta come back in the ONE host sync below.
+            d, i = guarded_call("backend.compile",
+                                lambda: merge_tail(d, i, qxj))
         for leaf in (d, i):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()

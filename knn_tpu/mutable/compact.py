@@ -38,11 +38,49 @@ from typing import Optional
 
 import numpy as np
 
+import os
+
 from knn_tpu import obs
 from knn_tpu.data.dataset import Dataset
 from knn_tpu.models.knn import KNNClassifier, KNNRegressor
 from knn_tpu.resilience import faults
 from knn_tpu.serve import artifact
+
+#: Incremental IVF compaction falls back to a full k-means rebuild when
+#: the assignment-only partition's imbalance (largest cell over the
+#: balanced size) crosses this — the point where skewed cells make probe
+#: work and recall-per-probe visibly worse than a re-clustered field.
+#: KNN_TPU_IVF_REBUILD_IMBALANCE overrides.
+IVF_REBUILD_IMBALANCE = 4.0
+
+
+def _rebuild_imbalance() -> float:
+    try:
+        return float(os.environ.get("KNN_TPU_IVF_REBUILD_IMBALANCE",
+                                    IVF_REBUILD_IMBALANCE))
+    except ValueError:
+        return IVF_REBUILD_IMBALANCE
+
+
+def rebuild_ivf(old_ivf, new_train: Dataset):
+    """The compaction IVF step: ``(new_index, path)`` where ``path``
+    names which branch ran — ``"incremental"`` (one same-seed assignment
+    of the folded rows to the EXISTING centroids) or ``"rebuild"`` (full
+    Lloyd's, taken when the incremental partition's cell imbalance
+    crosses the threshold, or the fold shrank the row count below the
+    cell count). Every fold used to pay the full rebuild; incremental
+    assignment makes steady-state compaction O(rows · cells) instead of
+    O(rows · cells · iters)."""
+    from knn_tpu.index.ivf import IVFIndex
+
+    cells = min(old_ivf.num_cells, new_train.num_instances)
+    if cells == old_ivf.num_cells:
+        candidate = IVFIndex.assign_to(new_train.features, old_ivf)
+        if candidate.imbalance() <= _rebuild_imbalance():
+            return candidate, "incremental"
+    rebuilt = IVFIndex.build(
+        new_train.features, cells, seed=int(old_ivf.meta.get("seed", 0)))
+    return rebuilt, "rebuild"
 
 
 class CompactionInProgress(Exception):
@@ -258,18 +296,16 @@ class Compactor:
                     base_train, fold_input, base_stable)
                 new_model = clone_fitted(old_model, new_train)
                 new_ivf = None
+                ivf_path = None
                 old_ivf = getattr(old_model, "ivf_", None)
                 if old_ivf is not None:
-                    # Re-run cell assignment: the partition is a function
-                    # of the row set, so folded rows get fresh cells
-                    # (same seed — deterministic artifacts).
-                    from knn_tpu.index.ivf import IVF_ATTR, IVFIndex
+                    # Re-assign folded rows to cells: incremental (the
+                    # existing centroid field, one same-seed assignment
+                    # step) unless imbalance demands a full Lloyd's
+                    # rebuild — deterministic artifacts either way.
+                    from knn_tpu.index.ivf import IVF_ATTR
 
-                    new_ivf = IVFIndex.build(
-                        new_train.features,
-                        min(old_ivf.num_cells, new_train.num_instances),
-                        seed=int(old_ivf.meta.get("seed", 0)),
-                    )
+                    new_ivf, ivf_path = rebuild_ivf(old_ivf, new_train)
                     setattr(new_model, IVF_ATTR, new_ivf)
                 generation = fold_input["generation"] + 1
                 gen_dir = artifact.generation_path(eng.root, generation)
@@ -307,6 +343,11 @@ class Compactor:
                 "generation": generation, "index_version": version,
                 "previous_version": previous, **stats,
             }
+            if ivf_path is not None:
+                # Which IVF branch this fold rode (the compaction
+                # verdict's answer to "did we pay a full re-cluster?").
+                detail["ivf_compaction"] = ivf_path
+                detail["ivf_cell_imbalance"] = new_ivf.imbalance()
             eng.note_compaction("ok", wall_ms, detail)
             return {"compacted": True, "ms": round(wall_ms, 3), **detail}
         except CompactionInProgress:

@@ -1,0 +1,211 @@
+"""The device-resident delta tail: the mutable tier's block on device.
+
+PR 10's delta tier lives in host numpy, so every mutable-on dispatch
+pays a host roundtrip to score the delta block and merge it into the
+base answer (``mutable/state.merge_candidates``). This module keeps the
+delta features in a pre-allocated DEVICE buffer — grown by doubling in
+lockstep with the engine's host arrays, updated in place via
+``jax.lax.dynamic_update_slice`` on insert — and merges base+delta in
+the SAME device round trip as the base retrieval:
+
+- the exact rungs chain :func:`make_merge_tail`'s jitted two-key sort
+  onto the XLA retrieval's device outputs
+  (``models/knn._kneighbors_arrays(merge_tail=...)``) — one host sync
+  returns the merged candidates;
+- the ivf rung fuses the same operands into its segment scorer
+  (``ops/segment_score._segment_topk_delta_core``) so probed cells and
+  delta rows ride one gather+score+select dispatch.
+
+Snapshot semantics: jax arrays are immutable, so a
+:class:`DeviceTailView` taken under the engine lock is a consistent
+frozen snapshot for free — the functional ``dynamic_update_slice``
+builds a NEW buffer for the appended state while readers keep theirs
+(the same append-frozen contract the host arrays honor; this is also
+why the update is NOT donated: a donated buffer would be reused under a
+live snapshot). Dead slots are a separate ``[cap] bool`` mask rebuilt
+on delete (deletes are rare; the mask upload is tiny) and liveness is
+``slot < count AND not dead``, so appends never touch the mask.
+
+Bit-identity: the device merge selects top-(k + RERANK_PAD) by device
+distances; :func:`rerank_merged` re-scores the delta survivors on the
+host with the oracle einsum form (shape-invariant per pair) and
+re-selects through ``lexicographic_topk``, so the merged answer is
+bit-identical to the host ``merge_candidates`` path. Views with BASE
+tombstones keep the host merge — its per-affected-row oracle widening
+has no fixed compiled shape (docs/INDEXES.md §On-device scoring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from knn_tpu.models.ordering import lexicographic_topk
+
+#: Append regions round up to this many slots so the jitted
+#: dynamic_update_slice sees a bounded set of row shapes.
+_APPEND_QUANTUM = 8
+
+
+class DeviceTailView(NamedTuple):
+    """One frozen device snapshot of the delta tail, carried on
+    :class:`~knn_tpu.mutable.state.MutableView.device`."""
+
+    features: jnp.ndarray  # [cap, D] float32, slots >= count undefined
+    dead: jnp.ndarray      # [cap] bool — tombstoned delta slots
+    count: int             # delta slots in use (live + tombstoned)
+    base_n: int            # base rows in this generation
+
+
+@jax.jit
+def _append_core(buf, rows, start):
+    return lax.dynamic_update_slice(buf, rows, (start, jnp.int32(0)))
+
+
+@functools.partial(jax.jit, static_argnames=("kk",))
+def _delta_merge_core(base_d, base_i, queries, delta_rows, delta_dead,
+                      base_n, count, kk):
+    """Fuse the delta block into a base top-k ON DEVICE: score every
+    delta slot (subtraction-form squared euclidean), mask dead/unused
+    slots to (+inf, sentinel), and run ONE selection over base+delta
+    under the ``models/ordering.py`` tie contract
+    (``ops/segment_score.margin_select`` — fast distance top-k with the
+    exact two-key sort as the on-device tie fallback). Returns the
+    top-``kk`` merged survivors for the host re-rank."""
+    from knn_tpu.ops.segment_score import delta_columns, margin_select
+
+    dd, di, _sentinel = delta_columns(queries, delta_rows, delta_dead,
+                                      base_n, count)
+    all_d = jnp.concatenate([base_d, dd], axis=1)
+    all_i = jnp.concatenate([base_i.astype(jnp.int32), di], axis=1)
+    return margin_select(all_d, all_i, kk)
+
+
+def make_merge_tail(view: DeviceTailView, k: int):
+    """The ``merge_tail`` hook for ``models/knn._kneighbors_arrays``:
+    ``(d_dev, i_dev, queries_dev) -> (d_dev, i_dev)`` merging this
+    view's delta block into the base top-k on device. The ``sig``
+    attribute joins the retrieval executable-cache key."""
+    from knn_tpu.ops.segment_score import RERANK_PAD
+
+    cap = view.features.shape[0]
+    kk = min(k + RERANK_PAD, k + cap)
+    base_n = jnp.asarray(view.base_n, jnp.int32)
+    count = jnp.asarray(view.count, jnp.int32)
+
+    def tail(d_dev, i_dev, queries_dev):
+        return _delta_merge_core(d_dev, i_dev, queries_dev,
+                                 view.features, view.dead, base_n,
+                                 count, kk=kk)
+
+    tail.sig = ("delta-merge", cap, kk)
+    return tail
+
+
+def rerank_merged(view, train_x: np.ndarray, queries: np.ndarray,
+                  cand: np.ndarray, k: int, metric: str,
+                  base_d: Optional[np.ndarray] = None):
+    """Host exact re-rank of device-merged survivors, in the view's
+    positional id space: delta candidates (``base_n <= id < sentinel``)
+    are re-scored with the oracle einsum form on the HOST delta arrays
+    (bit-identical to ``mutable/state.delta_distances``), sentinel slots
+    mask to +inf, and the final top-k selects through
+    ``lexicographic_topk``.
+
+    ``base_d`` — when given (the exact rungs), base candidates keep
+    these pass-through distances exactly as the host merge keeps the
+    answering rung's values; when None (the ivf fused path), base
+    candidates are re-scored with the einsum form too, matching the ivf
+    host scorer's exact-distance promise."""
+    if metric not in (None, "euclidean"):
+        raise ValueError("the device delta merge implements euclidean "
+                         "only; the host merge handles other metrics")
+    queries = np.asarray(queries, np.float32)
+    cand = np.asarray(cand, np.int64)
+    base_n, sentinel = view.base_n, view.sentinel
+    if base_d is not None:
+        d = np.ascontiguousarray(base_d, np.float32).copy()
+    else:
+        d = np.full(cand.shape, np.inf, np.float32)
+        base_mask = cand < base_n
+        if base_mask.any():
+            qi, ci = np.nonzero(base_mask)
+            diff = queries[qi] - train_x[cand[qi, ci]]
+            d[qi, ci] = np.einsum("nd,nd->n", diff, diff,
+                                  dtype=np.float32)
+    delta_mask = (cand >= base_n) & (cand < sentinel)
+    if delta_mask.any():
+        qi, ci = np.nonzero(delta_mask)
+        rows = np.asarray(view.features)[cand[qi, ci] - base_n]
+        diff = queries[qi] - rows
+        d[qi, ci] = np.einsum("nd,nd->n", diff, diff, dtype=np.float32)
+    # NaN -> +inf without touching the pass-through +inf entries
+    # (nan_to_num's posinf default would clobber them to float32 max).
+    d[np.isnan(d)] = np.inf
+    d[cand >= sentinel] = np.inf
+    return lexicographic_topk(d, cand, k)
+
+
+class DeviceDeltaTail:
+    """Owns the device buffer + dead mask; driven by the engine under
+    its lock (``mutable/engine.py``). All updates are functional — old
+    buffers stay valid under any snapshot holding them."""
+
+    __slots__ = ("_buf", "_dead", "_count", "_base_n")
+
+    def __init__(self):
+        self._buf = None
+        self._dead = None
+        self._count = 0
+        self._base_n = 0
+
+    @property
+    def cap(self) -> int:
+        return 0 if self._buf is None else self._buf.shape[0]
+
+    def rebuild(self, host_features: np.ndarray, count: int,
+                dead_slots: np.ndarray, base_n: int) -> None:
+        """Full (re)upload — activation, growth past the current device
+        cap, and compaction rebase all land here."""
+        self._buf = jnp.asarray(
+            np.ascontiguousarray(host_features, np.float32))
+        self._count = int(count)
+        self._base_n = int(base_n)
+        self.set_dead(dead_slots)
+
+    def append(self, host_features: np.ndarray, start: int,
+               end: int, base_n: int) -> None:
+        """Write slots ``[start, end)`` in place via
+        ``dynamic_update_slice`` (region rounded to the append quantum
+        so compiled row shapes stay bounded); a host-side growth
+        (capacity change) falls back to a full rebuild."""
+        if self._buf is None or self.cap != host_features.shape[0]:
+            dead = (np.asarray(self._dead) if self._dead is not None
+                    else np.zeros(host_features.shape[0], bool))
+            dead_slots = np.flatnonzero(dead[:min(len(dead), end)])
+            self.rebuild(host_features, end, dead_slots, base_n)
+            return
+        s0 = (start // _APPEND_QUANTUM) * _APPEND_QUANTUM
+        m = min(-(-(end - s0) // _APPEND_QUANTUM) * _APPEND_QUANTUM,
+                self.cap - s0)
+        rows = np.ascontiguousarray(host_features[s0:s0 + m], np.float32)
+        self._buf = _append_core(self._buf, jnp.asarray(rows),
+                                 jnp.asarray(s0, jnp.int32))
+        self._count = int(end)
+        self._base_n = int(base_n)
+
+    def set_dead(self, dead_slots: np.ndarray) -> None:
+        mask = np.zeros(self.cap, bool)
+        dead_slots = np.asarray(dead_slots, np.int64)
+        if dead_slots.size:
+            mask[dead_slots] = True
+        self._dead = jnp.asarray(mask)
+
+    def view(self) -> DeviceTailView:
+        return DeviceTailView(self._buf, self._dead, self._count,
+                              self._base_n)

@@ -52,6 +52,13 @@ FRESHNESS_BUCKETS_MS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
 #: Initial delta allocation; grows by amortized doubling up to the cap.
 _INITIAL_SLOTS = 64
 
+#: ``device_tail="auto"`` activates the device-resident delta buffer
+#: (``mutable/device_tail.py``) once this many delta slots are in use —
+#: below it, the host merge's numpy scan beats a device dispatch, so the
+#: tail would be pure overhead. "on" activates at the first insert,
+#: "off" never constructs it. KNN_TPU_DEVICE_TAIL overrides "auto".
+DEVICE_TAIL_MIN_ROWS = 256
+
 
 class _Freshness:
     """Streaming write-to-visible stats + a bounded ring for quantiles
@@ -99,9 +106,19 @@ class MutableEngine:
 
     def __init__(self, model, root, *, delta_cap: int = 4096,
                  current: Optional[dict] = None, base_dir=None,
-                 version: Optional[str] = None):
+                 version: Optional[str] = None,
+                 device_tail: str = "auto"):
         if delta_cap < 1:
             raise ValueError(f"delta_cap must be >= 1, got {delta_cap}")
+        if device_tail == "auto":
+            import os
+
+            env = os.environ.get("KNN_TPU_DEVICE_TAIL", "auto")
+            device_tail = env if env in ("on", "off") else "auto"
+        if device_tail not in ("auto", "on", "off"):
+            raise ValueError(
+                f"device_tail must be 'auto', 'on', or 'off', got "
+                f"{device_tail!r}")
         from pathlib import Path
 
         self.root = Path(root)
@@ -157,8 +174,15 @@ class MutableEngine:
         self._tomb_pos: frozenset = frozenset()
         self._tomb_base = np.empty(0, np.int64)
         self._tomb_delta = np.empty(0, np.int64)
+        # Device-resident delta tail (mutable/device_tail.py): built
+        # LAZILY at the activation threshold so a mutable-on boot with
+        # no (or few) mutations constructs zero device machinery and the
+        # empty-view byte-identity pin holds trivially.
+        self._device_tail_mode = device_tail
+        self._dtail = None
 
         self._replay()
+        self._sync_device_tail()
         epochs = artifact.list_epochs(self.root)
         self._epoch = (epochs[-1][0] + 1) if epochs else 1
         self._log = artifact.EpochLog(
@@ -255,7 +279,34 @@ class MutableEngine:
         self._values[s:s + m] = values
         self._stable[s:s + m] = np.arange(sid0, sid0 + m, dtype=np.int64)
         self._count = s + m
+        self._sync_device_tail(appended=(s, self._count))
         return list(range(self._base_n + s, self._base_n + s + m))
+
+    def _sync_device_tail(self, appended=None) -> None:
+        """Keep the device-resident delta buffer in lockstep with the
+        host arrays (caller holds the lock). Lazy activation at the mode
+        threshold; after that, appends write in place via
+        ``dynamic_update_slice`` and a host growth (capacity change)
+        triggers a full rebuild inside :meth:`DeviceDeltaTail.append`."""
+        mode = self._device_tail_mode
+        if mode == "off":
+            return
+        if self._dtail is None:
+            want = 1 if mode == "on" else DEVICE_TAIL_MIN_ROWS
+            if self._count < want:
+                return
+            from knn_tpu.mutable.device_tail import DeviceDeltaTail
+
+            self._dtail = DeviceDeltaTail()
+            self._dtail.rebuild(self._features, self._count,
+                                self._tomb_delta, self._base_n)
+            return
+        if appended is not None:
+            self._dtail.append(self._features, appended[0], appended[1],
+                               self._base_n)
+        else:
+            self._dtail.rebuild(self._features, self._count,
+                                self._tomb_delta, self._base_n)
 
     def _rebuild_tomb_arrays(self) -> None:
         base, delta = [], []
@@ -311,6 +362,10 @@ class MutableEngine:
         self._tomb_stable = self._tomb_stable | set(sids)
         self._tomb_pos = self._tomb_pos | set(positions)
         self._rebuild_tomb_arrays()
+        if self._dtail is not None:
+            # Deletes are rare next to reads: a full [cap] mask upload
+            # keeps the device tail's tombstones exact.
+            self._dtail.set_dead(self._tomb_delta)
         return positions
 
     # -- mutation application (batcher worker thread) ----------------------
@@ -430,6 +485,8 @@ class MutableEngine:
                 tomb_pos=self._tomb_pos, tomb_base=self._tomb_base,
                 tomb_delta_slots=self._tomb_delta, seq=self._seq,
                 base_n=self._base_n, generation=self._generation,
+                device=(self._dtail.view() if self._dtail is not None
+                        else None),
             )
 
     def pressure(self) -> int:
@@ -514,6 +571,11 @@ class MutableEngine:
             self._tomb_stable = frozenset(keep_tombs)
             self._tomb_pos = frozenset(positions)
             self._rebuild_tomb_arrays()
+            # Fresh generation, fresh tail: drop the old device buffer
+            # (snapshots holding its view keep reading it — jax arrays
+            # are immutable) and lazily re-activate at the threshold.
+            self._dtail = None
+            self._sync_device_tail()
 
     def note_compaction(self, outcome: str, wall_ms: float,
                         detail: Optional[dict] = None) -> None:
@@ -595,6 +657,10 @@ class MutableEngine:
                 "base_rows": self._base_n,
                 "freshness": self._fresh.export(),
                 "last_compaction": self._last_compaction,
+                "device_tail": {
+                    "mode": self._device_tail_mode,
+                    "active": self._dtail is not None,
+                },
             }
         obs.gauge_set(
             "knn_mutable_delta_rows", doc["delta_rows"],

@@ -80,6 +80,12 @@ class MutableView(NamedTuple):
     seq: int                    # last mutation folded into this view
     base_n: int                 # base rows in this generation
     generation: int
+    #: Device-resident twin of the delta block
+    #: (:class:`~knn_tpu.mutable.device_tail.DeviceTailView`), or None
+    #: while the tail is host-only — when present, device rungs merge
+    #: base+delta in the same dispatch instead of through the host
+    #: merge below (``serve/batcher.py`` decides per rung).
+    device: "object | None" = None
 
     @property
     def empty(self) -> bool:

@@ -97,6 +97,19 @@ def padded_query_rows(engine: str, rows: int, num_features: int = 1,
     return rows
 
 
+def padded_candidate_rows(rows: int) -> int:
+    """Compiled-shape candidate rows for one device IVF gather+score
+    dispatch of ``rows`` gathered candidates per query — resolved from
+    ``models/knn.candidate_padded_rows``, THE definition the segment
+    scorer's pad and its executable-cache key also use (the same
+    one-definition contract :func:`padded_query_rows` holds for the
+    query axis), so the ``knn_ivf_padded_candidate_rows_total`` waste
+    counter reflects the bucket really dispatched."""
+    from knn_tpu.models.knn import candidate_padded_rows
+
+    return candidate_padded_rows(rows)
+
+
 def resolved_retrieval_engine(model) -> str:
     """The candidate engine the model's fast serving rung resolves to —
     mirrors ``models._kneighbors_arrays``'s auto selection so padded-row

@@ -1,0 +1,290 @@
+"""Device-resident IVF candidate gather + score + select.
+
+The host IVF scorer (``knn_tpu/index/ivf.py``) gathers every probed
+cell's rows into a ``[B, M, D]`` block and einsums it on the host — the
+last host-resident inner loop of the approximate serving path (ROADMAP
+item 2). This module is its device twin, the accelerator-resident IVF
+shape of Johnson et al.'s billion-scale search (PAPERS.md):
+
+- the cell-sort already makes probed rows contiguous in the permuted
+  train copy, so the gather is ONE ``jnp.take`` over flattened
+  (query, cell) segment offsets — no per-probe host slicing;
+- candidate distances are the subtraction-form squared euclidean
+  (``ops/distance.py`` exact semantics) fused with the gather, and
+  selection is ``lax.sort`` with TWO keys — (distance, train index) —
+  the in-kernel realization of the ``models/ordering.py`` tie contract;
+- the candidate axis pads to the ``models/knn.candidate_padded_rows``
+  bucket ladder and queries to ``query_padded_rows``, so compiled
+  shapes are reused across dispatches and the executable-cache key,
+  the pad, and the waste accounting all read the one definition.
+
+Bit-identity strategy (the ``nprobe == num_cells`` pin): float32
+reductions cannot be made bit-equal across numpy and XLA (different
+partial-sum association), so the kernel does NOT try — it selects a
+small SAFETY MARGIN of extra candidates (``RERANK_PAD``) by device
+distances, and the caller re-scores exactly those survivors on the host
+with the oracle's own einsum form and selects the final top-k through
+``lexicographic_topk`` (einsum per-pair values are shape-invariant, so
+the re-ranked distances are bit-identical to the host scorer's). Device
+LSB error can demote a true top-k candidate past the margin only if
+``RERANK_PAD`` candidates sit within ~1 ulp of each other — exact ties
+(duplicate rows) are safe outright because both implementations give
+them exactly equal values and the two-key sort breaks them by index.
+This is the classic IVF exact-re-rank split (Jégou et al., PAPERS.md):
+the O(B·M·D) work rides the device, the O(B·k·D) finish stays exact.
+
+The optional **delta tail** operands fuse the mutable tier's
+device-resident delta block (``knn_tpu/mutable/device_tail.py``) into
+the SAME selection: delta rows are scored beside the probed candidates
+and the one two-key sort covers base+delta — no per-batch host merge.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+#: Extra candidates the device selection keeps beyond k for the host
+#: exact re-rank (see the module docstring's bit-identity strategy).
+RERANK_PAD = 32
+
+
+def margin_select(d, ids, kk: int, row_ok=None):
+    """Top-``kk`` survivors of ``(d [B, W], ids [B, W])`` for the host
+    exact re-rank — the in-kernel selection every device scorer shares
+    (traced; callers jit).
+
+    Fast path: ``lax.top_k`` by distance (≈25x cheaper than a full
+    two-key sort at serving widths). top_k breaks value ties by
+    POSITION, not by train index — safe exactly when no distance
+    plateau crosses the selection boundary, because then the selected
+    set is ALL candidates with ``d <= kk-th smallest`` (a superset of
+    the true (distance, index) top-k whatever the within-plateau
+    order; the host re-rank restores the exact order). The in-kernel
+    detector counts candidates at-or-under the boundary distance:
+    ``count > kk`` means a plateau crossed (adversarial ties, all-inf
+    NaN rows) and ``lax.cond`` routes to the exact two-key
+    ``lax.sort`` branch — still on device, no host sync, correctness
+    never depends on the heuristic. ``row_ok [B] bool`` masks rows out
+    of the detector (the bucket ladder's PAD query rows are all-inf by
+    construction and their results are sliced off — without the mask
+    every padded dispatch would ride the slow branch)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def exact(_):
+        sd, si = lax.sort((d, ids), dimension=-1, num_keys=2)
+        return sd[:, :kk], si[:, :kk]
+
+    if kk >= d.shape[1]:
+        return exact(None)
+    neg, pos = lax.top_k(-d, kk)
+    sd = -neg
+    si = jnp.take_along_axis(ids, pos, axis=1)
+    per_row = jnp.sum(d <= sd[:, -1:], axis=1) > kk
+    if row_ok is not None:
+        per_row = per_row & row_ok
+    return lax.cond(jnp.any(per_row), exact, lambda _: (sd, si), None)
+
+
+def delta_columns(queries, delta_rows, delta_dead, base_n, count):
+    """Score the device-resident delta tail (traced): ``(dd [B, cap],
+    di [B, cap])`` — subtraction-form squared euclidean per slot, a slot
+    live when below ``count`` and not dead, dead/pad slots masked to
+    ``(+inf, sentinel = base_n + count)``. THE one definition of the
+    delta liveness/sentinel rule shared by the fused ivf kernel
+    (:func:`_segment_topk_delta_core`) and the exact rungs' merge
+    (``mutable/device_tail._delta_merge_core``)."""
+    import jax.numpy as jnp
+
+    ddiff = queries[:, None, :] - delta_rows[None, :, :]
+    dd = jnp.sum(ddiff * ddiff, axis=-1)                 # [B, cap]
+    slot = jnp.arange(delta_rows.shape[0], dtype=jnp.int32)
+    live = (slot < count) & ~delta_dead
+    dd = jnp.where(jnp.isnan(dd) | ~live[None, :], jnp.inf, dd)
+    sentinel = (base_n + count).astype(jnp.int32)
+    di = jnp.where(live, base_n.astype(jnp.int32) + slot, sentinel)
+    return dd, jnp.broadcast_to(di[None, :], dd.shape), sentinel
+
+
+def _segment_scores(perm_rows, perm_ids, queries, starts, lens, m_pad):
+    """Gather + score the probed segments (traced): ``(d [B, m_pad],
+    ids [B, m_pad])`` with pad slots at (+inf, N)."""
+    import jax
+    import jax.numpy as jnp
+
+    ends = jnp.cumsum(lens, axis=1)                      # [B, P]
+    total = ends[:, -1:]                                 # [B, 1]
+    pos = jnp.arange(m_pad, dtype=lens.dtype)            # [M]
+    # Which probed segment does flat slot m fall into? Small probe
+    # counts take one vectorized compare-sum (measured ~40x faster than
+    # batched searchsorted at P=8); wide probes keep the O(M log P)
+    # searchsorted.
+    if lens.shape[1] <= 32:
+        seg = jnp.sum(pos[None, :, None] >= ends[:, None, :],
+                      axis=2).astype(lens.dtype)
+    else:
+        seg = jax.vmap(
+            lambda e: jnp.searchsorted(e, pos, side="right"))(ends)
+    seg_c = jnp.minimum(seg, lens.shape[1] - 1)
+    seg_start = jnp.take_along_axis(starts, seg_c, axis=1)
+    seg_base = jnp.take_along_axis(ends - lens, seg_c, axis=1)
+    src = seg_start + pos[None, :] - seg_base            # [B, M] perm pos
+    valid = pos[None, :] < total
+    src = jnp.where(valid, src, perm_rows.shape[0] - 1)  # the pad row
+    ids = perm_ids[src]                                  # [B, M]
+    gathered = perm_rows[src]                            # [B, M, D]
+    diff = queries[:, None, :] - gathered
+    d = jnp.sum(diff * diff, axis=-1)
+    d = jnp.where(jnp.isnan(d) | ~valid, jnp.inf, d)
+    return d, ids
+
+
+@functools.partial(jax.jit, static_argnames=("m_pad", "kk"))
+def _segment_topk_core(perm_rows, perm_ids, queries, starts, lens,
+                       row_ok, m_pad, kk):
+    """One fused gather+score+select dispatch.
+
+    ``perm_rows [N+1, D]`` — cell-sorted train rows plus one zero pad
+    row; ``perm_ids [N+1] int32`` — original train index per permuted
+    row, pad slot carrying the sentinel ``N``; ``queries [B, D]``;
+    ``starts/lens [B, P] int32`` — each query's probed segments in
+    permutation space; ``row_ok [B]`` — False for bucket-pad query
+    rows. Returns ``(dists [B, kk] f32, ids [B, kk] i32)`` — the
+    margin-selected survivors (see :func:`margin_select`)."""
+    d, ids = _segment_scores(perm_rows, perm_ids, queries, starts, lens,
+                             m_pad)
+    return margin_select(d, ids, kk, row_ok=row_ok)
+
+
+@functools.partial(jax.jit, static_argnames=("m_pad", "kk"))
+def _segment_topk_delta_core(perm_rows, perm_ids, queries, starts, lens,
+                             row_ok, delta_rows, delta_dead, base_n,
+                             count, m_pad, kk):
+    """:func:`_segment_topk_core` with the mutable delta tail fused in:
+    ``delta_rows [cap, D]`` is the device-resident delta buffer,
+    ``delta_dead [cap] bool`` its tombstone mask (a slot is live when
+    below ``count`` and not dead), and delta candidates carry positional
+    ids ``base_n + slot`` (dead/pad slots the past-everything sentinel
+    ``base_n + count``) so the ONE selection ranks base and delta
+    together under the shared tie contract."""
+    import jax.numpy as jnp
+
+    bd, bi = _segment_scores(perm_rows, perm_ids, queries, starts, lens,
+                             m_pad)
+    dd, di, sentinel = delta_columns(queries, delta_rows, delta_dead,
+                                     base_n, count)
+    # Probed base candidates carry raw train indices < base_n; pad slots
+    # carry N == base_n which collides with delta slot 0 — remap base
+    # pads to the sentinel before the merged selection.
+    bi = jnp.where(bi >= base_n.astype(jnp.int32), sentinel, bi)
+    all_d = jnp.concatenate([bd, dd], axis=1)
+    all_i = jnp.concatenate([bi, di], axis=1)
+    return margin_select(all_d, all_i, kk, row_ok=row_ok)
+
+
+def device_operands(train_x: np.ndarray, row_perm: np.ndarray):
+    """Build the device-resident permuted-train operands: ``(perm_rows
+    [N+1, D] f32, perm_ids [N+1] i32)`` with the pad row zero and the
+    pad id ``N`` (the sentinel the scorer masks to +inf). One upload per
+    (train, partition) pair — the caller memoizes."""
+    import jax.numpy as jnp
+
+    n = train_x.shape[0]
+    if n >= 2 ** 31 - 1:
+        raise ValueError(
+            f"device IVF scorer indexes rows in int32; {n} rows need the "
+            f"host scorer")
+    rows = np.concatenate(
+        [np.ascontiguousarray(train_x[row_perm], np.float32),
+         np.zeros((1, train_x.shape[1]), np.float32)])
+    ids = np.concatenate(
+        [np.asarray(row_perm, np.int64), [n]]).astype(np.int32)
+    return jnp.asarray(rows), jnp.asarray(ids)
+
+
+def segment_topk(perm_rows, perm_ids, queries: np.ndarray,
+                 starts: np.ndarray, lens: np.ndarray, m_actual: int,
+                 k: int, tail=None):
+    """Host entry: pad to the compiled-shape ladders, dispatch, fetch.
+
+    ``queries [B, D]`` host float32; ``starts/lens [B, P]`` the probed
+    segments (permutation-space start + length per probe); ``m_actual``
+    the batch's largest per-query candidate count. ``tail`` — an
+    optional :class:`~knn_tpu.mutable.device_tail.DeviceTailView` whose
+    delta block is fused into the same selection. Returns ``(dists
+    [B, kk] f32, ids [B, kk] i64)`` — the device's top-(k+margin)
+    survivors for the host exact re-rank, NOT the final answer.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from knn_tpu import obs
+    from knn_tpu.models.knn import candidate_padded_rows, query_padded_rows
+
+    b, d_feat = queries.shape
+    m_pad = max(candidate_padded_rows(m_actual), 1)
+    b_pad = max(query_padded_rows(b), 1)
+    width = m_pad + (tail.features.shape[0] if tail is not None else 0)
+    kk = min(k + RERANK_PAD, width)
+    if obs.enabled():
+        from knn_tpu.obs import devprof
+
+        devprof.record_executable_lookup("retrieval", (
+            "ivf-segment", b_pad, lens.shape[1], m_pad, d_feat, kk,
+            tail.features.shape[0] if tail is not None else 0,
+        ))
+    qx = queries
+    if b_pad != b:
+        qx = np.zeros((b_pad, d_feat), np.float32)
+        qx[:b] = queries
+    sl = np.zeros((b_pad, lens.shape[1]), np.int32)
+    st = np.zeros((b_pad, lens.shape[1]), np.int32)
+    sl[:b] = lens
+    st[:b] = starts
+    row_ok = jnp.asarray(np.arange(b_pad) < b)
+    if tail is None:
+        sd, si = _segment_topk_core(
+            perm_rows, perm_ids, jnp.asarray(qx), jnp.asarray(st),
+            jnp.asarray(sl), row_ok, m_pad=m_pad, kk=kk)
+    else:
+        sd, si = _segment_topk_delta_core(
+            perm_rows, perm_ids, jnp.asarray(qx), jnp.asarray(st),
+            jnp.asarray(sl), row_ok, tail.features, tail.dead,
+            jnp.asarray(tail.base_n, jnp.int32),
+            jnp.asarray(tail.count, jnp.int32), m_pad=m_pad, kk=kk)
+    d_h, i_h = jax.device_get((sd, si))
+    return d_h[:b], i_h[:b].astype(np.int64)
+
+
+@functools.partial(jax.jit, static_argnames=("need",))
+def _rank_cells_core(queries, centroids, need):
+    from knn_tpu.ops.distance import pairwise_sq_dists_dot
+    from knn_tpu.ops.topk import approx_smallest_indices
+
+    d = pairwise_sq_dists_dot(queries, centroids)
+    return approx_smallest_indices(d, need)
+
+
+def rank_cells_approx(queries: np.ndarray, centroids_dev,
+                      need: int) -> np.ndarray:
+    """Approximate top-``need`` centroid ranking on device:
+    ``lax.approx_max_k`` over matmul-form centroid distances (ranking
+    only — probed candidates are still scored exactly, so this trades
+    recall, never correctness). Used once ``num_cells`` crosses the
+    ``index/ivf.py`` threshold; exact ranking keeps the small-C path."""
+    import jax.numpy as jnp
+
+    from knn_tpu import obs
+
+    if obs.enabled():
+        from knn_tpu.obs import devprof
+
+        devprof.record_executable_lookup("retrieval", (
+            "ivf-rank-approx", queries.shape[0],
+            centroids_dev.shape[0], need,
+        ))
+    out = _rank_cells_core(jnp.asarray(queries), centroids_dev, need)
+    return np.asarray(out).astype(np.int64)

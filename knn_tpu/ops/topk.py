@@ -78,3 +78,18 @@ def merge_topk(
     i = jnp.concatenate([idx_a, idx_b], axis=-1)
     d_sorted, i_sorted = lax.sort((d, i), dimension=-1, num_keys=2)
     return d_sorted[..., :k], i_sorted[..., :k]
+
+
+def approx_smallest_indices(
+    dists: jnp.ndarray, k: int, recall_target: float = 0.95
+) -> jnp.ndarray:
+    """[..., N] distances -> [..., k] int32 indices of the approximately
+    k smallest, via ``lax.approx_max_k`` on negated distances — the TPU's
+    hardware-binned approximate selection (Chern et al., PAPERS.md).
+    Ranking only, no values: the IVF centroid ranker uses this to pick
+    probe cells once ``num_cells`` is large enough that an exact argsort
+    dominates the query (the probed candidates are still re-scored
+    exactly, so what approximation costs is recall, never wrong
+    distances — the same contract as every approx rung)."""
+    _, idx = lax.approx_max_k(-dists, k, recall_target=recall_target)
+    return idx.astype(jnp.int32)

@@ -998,8 +998,8 @@ class MicroBatcher:
         else:
             engine = model.engine
 
-        def fast(feats, prefetched=None):
-            if self._stager is not None:
+        def fast(feats, prefetched=None, merge_tail=None):
+            if self._stager is not None or merge_tail is not None:
                 # Bucketed serving: dispatch DEFERRED (device work +
                 # result copies in flight when _kneighbors_arrays
                 # returns), start the NEXT batch's host→device upload in
@@ -1012,12 +1012,15 @@ class MicroBatcher:
                 # calls this rung once per chunk, and re-staging the
                 # same queue head N times would be pure wasted host
                 # copies + uploads on the already-degraded path.
+                # ``merge_tail`` (the device-resident delta merge) rides
+                # the same deferred dispatch — base+delta in one sync.
                 resolve = _kneighbors_arrays(
                     train.features, feats, k, metric=metric, engine=engine,
                     cache=train.device_cache, deferred=True,
-                    prefetched_queries=prefetched,
+                    prefetched_queries=prefetched, merge_tail=merge_tail,
                 )
-                if not self._prefetched_this_dispatch:
+                if (self._stager is not None
+                        and not self._prefetched_this_dispatch):
                     self._prefetched_this_dispatch = True
                     self._stager.prefetch(self)
                 return resolve()
@@ -1025,10 +1028,11 @@ class MicroBatcher:
                 Dataset(feats, np.zeros(feats.shape[0], np.int32))
             )
 
-        def xla(feats, prefetched=None):
+        def xla(feats, prefetched=None, merge_tail=None):
             return _kneighbors_arrays(
                 train.features, feats, k, metric=metric, engine="xla",
                 cache=train.device_cache, prefetched_queries=prefetched,
+                merge_tail=merge_tail,
             )
 
         def oracle(feats, prefetched=None):
@@ -1060,23 +1064,57 @@ class MicroBatcher:
         return rungs
 
     def _merged_rung(self, name: str, fn, model, mview):
-        """Wrap one rung closure with the delta/tombstone merge. The
-        k-coverage widening re-retrieves affected rows through the SAME
-        family: the ivf rung widens its own probed search, exact rungs
-        widen through the oracle (bit-identical to every exact rung by
-        the ladder contract)."""
+        """Wrap one rung closure with the delta/tombstone merge.
+
+        Three realizations of the ONE merge contract, picked per rung:
+
+        - **ivf** — :meth:`IVFServing.kneighbors` owns its merge: the
+          delta tail fuses into the segment scorer's device dispatch
+          when the view carries a device-resident tail, else the host
+          merge with the probed search as the widening family;
+        - **fast/xla with a device tail** (and no base tombstones, the
+          euclidean XLA engine): the jitted delta merge chains onto the
+          retrieval's device outputs (``merge_tail``) — base+delta in
+          ONE host sync — and the host re-rank restores the merge's
+          bit-exact distances (``mutable/device_tail.rerank_merged``);
+        - **everything else** (oracle, stripe, other metrics,
+          tombstoned-base views, host-only tails): the host merge,
+          with k-coverage widening through the oracle — unchanged
+          PR-10 behavior.
+        """
         from knn_tpu.mutable import state as mstate
 
         k = model.k
         if name == "ivf":
-            def wide(feats, k_wide):
-                return self.ivf.kneighbors(model, feats, k=k_wide)
-        else:
-            def wide(feats, k_wide):
-                from knn_tpu.backends.oracle import oracle_kneighbors
+            def merged_ivf(feats, prefetched=None):
+                return self.ivf.kneighbors(model, np.asarray(feats),
+                                           view=mview)
 
-                return oracle_kneighbors(model.train_.features, feats,
-                                         k_wide, model.metric)
+            return merged_ivf
+        tview = getattr(mview, "device", None)
+        if (tview is not None and name in ("fast", "xla")
+                and mview.tomb_base.size == 0
+                and model.metric in (None, "euclidean")
+                and (name == "xla"
+                     or acct.resolved_retrieval_engine(model) == "xla")):
+            from knn_tpu.mutable import device_tail as dtail
+
+            tail_fn = dtail.make_merge_tail(tview, k)
+
+            def merged_dev(feats, prefetched=None):
+                d, i = fn(feats, prefetched, merge_tail=tail_fn)
+                return dtail.rerank_merged(
+                    mview, model.train_.features,
+                    np.asarray(feats, np.float32), i, k, model.metric,
+                    base_d=d)
+
+            return merged_dev
+
+        def wide(feats, k_wide):
+            from knn_tpu.backends.oracle import oracle_kneighbors
+
+            return oracle_kneighbors(model.train_.features, feats,
+                                     k_wide, model.metric)
 
         def merged(feats, prefetched=None):
             d, i = fn(feats, prefetched)

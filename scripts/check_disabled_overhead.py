@@ -128,6 +128,19 @@ def main() -> int:
                         "disabled")
         if app.batcher.mutable is not None:
             return fail("the batcher holds a mutable engine while disabled")
+        # Device-resident retrieval hot path (PR 13): exact-only,
+        # mutable-off serving must construct ZERO device-IVF machinery —
+        # the segment-score kernel module and the device delta tail are
+        # lazy imports that only the ivf/mutable device paths pull in,
+        # so their mere presence in sys.modules here means something
+        # constructed them on the disabled path.
+        for mod in ("knn_tpu.ops.segment_score",
+                    "knn_tpu.mutable.device_tail"):
+            if mod in sys.modules:
+                return fail(f"{mod} imported during exact-only, "
+                            f"mutable-off serving — the device-IVF/"
+                            f"delta-tail machinery must not construct "
+                            f"while disabled")
         # Workload capture (PR 11): the default (no --capture-dir /
         # ServeApp's capture_dir=None) must construct NOTHING — no
         # recorder, no sample queue, no consumer thread, no

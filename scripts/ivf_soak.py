@@ -292,7 +292,14 @@ def main() -> int:
               f"{sh['shed']} shed, nprobe {ivf_block.get('nprobe')})")
 
         # -- phase 2: starve probes; burn must rise, policy must recover ---
+        # The device scorer is forced here (phase 1 keeps the production
+        # auto routing because its >=3x timing assertion is about the
+        # index family, not the scorer): this phase's assertions are
+        # burn/widen/recover — timing-free — so it is where the fused
+        # gather+score kernel soaks under live serving, the probe policy
+        # widening through its compiled-shape ladder as nprobe moves.
         env2 = dict(env,
+                    KNN_TPU_IVF_SCORER="device",
                     KNN_TPU_PROBE_COOLDOWN_MS="800",
                     KNN_TPU_PROBE_EVAL_MS="100")
         proc, base = boot(index, env2, shadow_flags + [

@@ -446,8 +446,16 @@ def main() -> int:
     ref = Path("/root/reference/datasets")
     train_arff = str((ref if ref.exists() else d) / "medium-train.arff")
 
+    # Device tail forced ON (not the lazy auto threshold): the soak's
+    # short-mode delta never reaches the auto activation size, and the
+    # whole point of this gate is that the DEVICE merge path replays
+    # bit-identically under chaos too (docs/INDEXES.md §The
+    # device-resident delta tail). KNN_TPU_DEVICE_TAIL in the caller's
+    # env still overrides for debugging the host path.
     env = dict(os.environ, JAX_PLATFORMS="cpu", KNN_TPU_RETRY_BASE_MS="0")
+    env.setdefault("KNN_TPU_DEVICE_TAIL", "on")
     report = {"mutable_soak": {
+        "device_tail": env["KNN_TPU_DEVICE_TAIL"],
         "train_rows": train.num_instances, "writers": args.writers,
         "readers": args.readers, "rows_per_read": args.rows,
         "window_s": args.window_s, "faults": args.faults,

@@ -1,0 +1,523 @@
+"""The device-resident retrieval hot path (ROADMAP item 2).
+
+Pins the three tentpole pieces and their satellites:
+
+- the device IVF gather+score kernel (``ops/segment_score.py``) is
+  BIT-identical to the host scorer — same distances, same indices —
+  across k/nprobe/ties/NaN/dtypes, and the ``nprobe == num_cells``
+  device path reproduces ``oracle_kneighbors`` exactly (the acceptance
+  pin);
+- ``lax.approx_max_k`` centroid ranking arms past the cell threshold,
+  never touches the full-probe bit-identity anchor, and its answers
+  stay honest under the shadow scorer's recall-floor machinery;
+- the device-resident delta tail (``mutable/device_tail.py``) grows by
+  doubling with append-frozen snapshots, merges bit-identically to the
+  host merge on every path that fuses (and falls back to the host merge
+  where documented), and survives concurrent mutation;
+- incremental IVF compaction assigns folded rows to existing cells and
+  records which branch ran; delete-aware probe accounting feeds live
+  tombstone counts into the k-coverage widening.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from knn_tpu.backends.oracle import oracle_kneighbors
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.index.ivf import (
+    IVF_ATTR,
+    IVFIndex,
+    IVFServing,
+)
+from knn_tpu.models.knn import (
+    DEFAULT_CANDIDATE_BUCKETS,
+    KNNClassifier,
+    KNNRegressor,
+    candidate_padded_rows,
+)
+from knn_tpu.mutable.engine import MutableEngine
+from knn_tpu.mutable.state import merged_oracle_kneighbors
+from knn_tpu.serve.artifact import save_index
+from knn_tpu.serve.batcher import MicroBatcher
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _tie_problem(rng, n=400, d=6, q=24):
+    """Grid-valued features -> plentiful exact distance ties, plus an
+    exact-match query and a NaN query (the adversarial corners)."""
+    x = rng.integers(0, 4, (n, d)).astype(np.float32)
+    x[40:50] = x[0:10]  # duplicate rows: exact ties across cells
+    qx = rng.integers(0, 4, (q, d)).astype(np.float32)
+    qx[1] = x[17]       # exact match (distance 0 ties)
+    qx[3, 2] = np.nan   # NaN query -> all +inf, ties broken by index
+    return x, qx
+
+
+def _assert_bitwise(a, b, what=""):
+    d1, i1 = a
+    d2, i2 = b
+    np.testing.assert_array_equal(i1, i2, err_msg=f"{what}: indices")
+    assert (np.asarray(d1, np.float32).view(np.uint32)
+            == np.asarray(d2, np.float32).view(np.uint32)).all(), \
+        f"{what}: distances not bit-identical"
+
+
+class TestDeviceScorerBitIdentity:
+    def test_matrix_vs_host(self, rng):
+        x, qx = _tie_problem(rng)
+        ivf = IVFIndex.build(x, 16, seed=0)
+        for k, nprobe in [(1, 1), (5, 2), (5, 16), (10, 3), (64, 5)]:
+            host = ivf.search(x, qx, k, nprobe, scorer="host")
+            dev = ivf.search(x, qx, k, nprobe, scorer="device")
+            _assert_bitwise(host[:2], dev[:2], f"k={k} nprobe={nprobe}")
+            assert host[2].scorer == "host"
+            assert dev[2].scorer == "device"
+            assert dev[2].padded_candidate_rows >= 0
+
+    def test_full_probe_device_bit_identical_to_oracle(self, rng):
+        x, qx = _tie_problem(rng)
+        ivf = IVFIndex.build(x, 16, seed=0)
+        for k in (1, 5, 17):
+            od, oi = oracle_kneighbors(x, qx, k)
+            dd, di, st = ivf.search(x, qx, k, 16, scorer="device")
+            _assert_bitwise((od, oi), (dd, di), f"oracle k={k}")
+            assert st.scorer == "device"
+
+    def test_wide_dtype_queries_coerce(self, rng):
+        x, qx = _tie_problem(rng)
+        ivf = IVFIndex.build(x, 8, seed=0)
+        host = ivf.search(x, qx.astype(np.float64), 5, 3, scorer="host")
+        dev = ivf.search(x.astype(np.float64), qx, 5, 3, scorer="device")
+        _assert_bitwise(host[:2], dev[:2], "dtype coercion")
+
+    def test_k_exceeds_candidates_pads_with_sentinel(self, rng):
+        x, qx = _tie_problem(rng, n=60)
+        ivf = IVFIndex.build(x, 30, seed=0)
+        # k near n forces widening to everything; both scorers must agree
+        # on the (inf, sentinel) padding rows too.
+        host = ivf.search(x, qx, 59, 1, scorer="host")
+        dev = ivf.search(x, qx, 59, 1, scorer="device")
+        _assert_bitwise(host[:2], dev[:2], "k-coverage saturation")
+
+    def test_auto_routes_small_to_host_env_overrides(self, rng, monkeypatch):
+        x, qx = _tie_problem(rng, n=120, q=4)
+        ivf = IVFIndex.build(x, 8, seed=0)
+        st = ivf.search(x, qx, 3, 2)[2]
+        assert st.scorer == "host"  # tiny workload: auto stays host
+        monkeypatch.setenv("KNN_TPU_IVF_SCORER", "device")
+        st = ivf.search(x, qx, 3, 2)[2]
+        assert st.scorer == "device"
+        monkeypatch.setenv("KNN_TPU_IVF_SCORER", "host")
+        st = ivf.search(x, qx, 3, 2, scorer="device")[2]
+        assert st.scorer == "device"  # explicit arg beats the env
+
+    def test_serving_rung_device_scorer_bit_identity(self, rng):
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=5, engine="xla").fit(Dataset(x, y))
+        setattr(model, IVF_ATTR, IVFIndex.build(x, 16, seed=0))
+        want = model.ivf_.search(x, qx, 5, 4, scorer="host")[:2]
+        serving = IVFServing(4, 16, scorer="device")
+        got = serving.kneighbors(model, qx)
+        _assert_bitwise(want, got, "serving rung")
+
+    def test_candidate_bucket_one_definition(self):
+        from knn_tpu.obs import accounting as acct
+
+        assert candidate_padded_rows(0) == 0
+        assert candidate_padded_rows(1) == DEFAULT_CANDIDATE_BUCKETS[0]
+        for b in DEFAULT_CANDIDATE_BUCKETS:
+            assert candidate_padded_rows(b) == b
+            assert candidate_padded_rows(b - 1) == b
+        top = DEFAULT_CANDIDATE_BUCKETS[-1]
+        assert candidate_padded_rows(top + 1) == 2 * top
+        # The accounting twin resolves through the SAME definition.
+        for m in (1, 300, 5000, top + 9):
+            assert acct.padded_candidate_rows(m) == candidate_padded_rows(m)
+
+
+class TestApproxCentroidRanking:
+    def test_arms_past_threshold_only(self, rng, monkeypatch):
+        x, qx = _tie_problem(rng)
+        ivf = IVFIndex.build(x, 16, seed=0)
+        assert ivf.search(x, qx, 5, 4)[2].ranking == "exact"
+        monkeypatch.setenv("KNN_TPU_IVF_APPROX_CELLS", "8")
+        assert ivf.search(x, qx, 5, 4)[2].ranking == "approx"
+        # Full probe NEVER rides approx ranking: the bit-identity anchor.
+        dd, di, st = ivf.search(x, qx, 5, 16, scorer="device")
+        assert st.ranking == "exact"
+        _assert_bitwise(oracle_kneighbors(x, qx, 5), (dd, di),
+                        "full probe under approx threshold")
+
+    def test_approx_answers_carry_exact_distances(self, rng, monkeypatch):
+        """The approx rung's promise: ranking is approximate, every
+        returned candidate's distance is exact — the shadow scorer's
+        recomputed-distance admissibility check must stay silent."""
+        monkeypatch.setenv("KNN_TPU_IVF_APPROX_CELLS", "8")
+        x, qx = _tie_problem(rng)
+        ivf = IVFIndex.build(x, 16, seed=0)
+        d, i, st = ivf.search(x, qx, 5, 4)
+        assert st.ranking == "approx"
+        finite = np.isfinite(d)
+        diff = qx[:, None, :] - x[i]
+        true_d = np.einsum("qkd,qkd->qk", diff, diff, dtype=np.float32)
+        np.testing.assert_array_equal(d[finite], true_d[finite])
+
+    def test_recall_floor_machinery_scores_approx_rung(self, rng,
+                                                       monkeypatch):
+        from knn_tpu.obs import quality as q
+
+        monkeypatch.setenv("KNN_TPU_IVF_APPROX_CELLS", "8")
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=5, engine="xla").fit(Dataset(x, y))
+        ivf = IVFIndex.build(x, 16, seed=0)
+        setattr(model, IVF_ATTR, ivf)
+        verdicts = []
+
+        class SpySLO:
+            def record_quality(self, good):
+                verdicts.append(good)
+
+        scorer = q.ShadowScorer(1.0, seed=0, slo=SpySLO(),
+                                approx_floors={"ivf": 0.5},
+                                autostart=False)
+        d, i, st = ivf.search(x, qx, 5, 4)
+        assert st.ranking == "approx"
+        assert scorer.offer(features=qx, kind="kneighbors", dists=d,
+                            idx=i, preds=None, rung="ivf", model=model,
+                            version="v1")
+        scorer._sq.start()
+        assert scorer.drain(30)
+        # approx ranking holds recall above this generous floor here,
+        # and the answers carry honest exact distances -> good verdict,
+        # no distance divergence.
+        assert verdicts[-1] is True
+        rungs = scorer.export()["rungs"]
+        assert not rungs["ivf"]["divergence"].get("distance")
+
+
+def _mutable_pair(model, tmp_path, **kw):
+    """Two engines over byte-identical artifacts: device tail forced on
+    vs off — the merged-serving bit-identity harness."""
+    import shutil
+
+    root_on = tmp_path / "idx-on"
+    save_index(model, root_on, ivf=getattr(model, IVF_ATTR, None))
+    root_off = tmp_path / "idx-off"
+    shutil.copytree(root_on, root_off)
+    on = MutableEngine(model, root_on, delta_cap=256,
+                       device_tail="on", **kw)
+    off = MutableEngine(model, root_off, delta_cap=256,
+                        device_tail="off", **kw)
+    return on, off
+
+
+class TestDeviceDeltaTail:
+    def test_lazy_activation_and_modes(self, rng, tmp_path):
+        x, _ = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=3, engine="xla").fit(Dataset(x, y))
+        on, off = _mutable_pair(model, tmp_path)
+        assert on.snapshot().device is None  # lazy: nothing inserted yet
+        on.apply_insert(x[:2], y[:2].astype(np.float32),
+                        time.monotonic_ns())
+        tv = on.snapshot().device
+        assert tv is not None and tv.count == 2 and tv.base_n == x.shape[0]
+        off.apply_insert(x[:2], y[:2].astype(np.float32),
+                         time.monotonic_ns())
+        assert off.snapshot().device is None  # off: never constructs
+        doc = on.export()
+        assert doc["device_tail"] == {"mode": "on", "active": True}
+
+    def test_auto_threshold_activation(self, rng, tmp_path, monkeypatch):
+        from knn_tpu.mutable import engine as eng_mod
+
+        monkeypatch.setattr(eng_mod, "DEVICE_TAIL_MIN_ROWS", 8)
+        x, _ = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=3, engine="xla").fit(Dataset(x, y))
+        root = tmp_path / "idx"
+        save_index(model, root)
+        eng = MutableEngine(model, root, delta_cap=256)
+        eng.apply_insert(x[:4], y[:4].astype(np.float32),
+                         time.monotonic_ns())
+        assert eng.snapshot().device is None  # below the threshold
+        eng.apply_insert(x[4:12], y[4:12].astype(np.float32),
+                         time.monotonic_ns())
+        assert eng.snapshot().device is not None
+
+    def test_growth_keeps_snapshots_frozen(self, rng, tmp_path):
+        x, _ = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=3, engine="xla").fit(Dataset(x, y))
+        on, _off = _mutable_pair(model, tmp_path)
+        rows = rng.standard_normal((20, x.shape[1])).astype(np.float32)
+        on.apply_insert(rows, rng.integers(0, 3, 20).astype(np.float32),
+                        time.monotonic_ns())
+        view = on.snapshot()
+        tv = view.device
+        frozen = np.asarray(tv.features)[:tv.count].copy()
+        # Grow past several doublings (64 -> 256 host slots).
+        more = rng.standard_normal((200, x.shape[1])).astype(np.float32)
+        on.apply_insert(more, rng.integers(0, 3, 200).astype(np.float32),
+                        time.monotonic_ns())
+        np.testing.assert_array_equal(
+            np.asarray(tv.features)[:tv.count], frozen)
+        v2 = on.snapshot()
+        np.testing.assert_array_equal(
+            np.asarray(v2.device.features)[:v2.count],
+            np.asarray(v2.features)[:v2.count])
+
+    def test_merged_serving_bit_identity_both_families(self, rng,
+                                                       tmp_path):
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        for family in ("classifier", "regressor"):
+            if family == "classifier":
+                model = KNNClassifier(k=5, engine="xla").fit(
+                    Dataset(x, y))
+            else:
+                model = KNNRegressor(k=5, engine="xla").fit(Dataset(x, y))
+            on, off = _mutable_pair(model, tmp_path / family)
+            rows = rng.standard_normal((30, x.shape[1])).astype(
+                np.float32)
+            vals = rng.integers(0, 3, 30).astype(np.float32)
+            for e in (on, off):
+                e.apply_insert(rows, vals, time.monotonic_ns())
+            b_on = MicroBatcher(model, max_batch=64, max_wait_ms=0.0,
+                                mutable=on)
+            b_off = MicroBatcher(model, max_batch=64, max_wait_ms=0.0,
+                                 mutable=off)
+            try:
+                _assert_bitwise(b_off.kneighbors(qx, timeout=60),
+                                b_on.kneighbors(qx, timeout=60),
+                                f"{family} insert-only")
+                np.testing.assert_array_equal(
+                    b_on.predict(qx, timeout=60),
+                    b_off.predict(qx, timeout=60))
+                # delta delete: fused path masks the dead slot
+                for b in (b_on, b_off):
+                    b.submit_mutation(
+                        "delete", {"ids": [x.shape[0] + 1]}).result(
+                        timeout=60)
+                d1, i1 = b_on.kneighbors(qx, timeout=60)
+                _assert_bitwise(b_off.kneighbors(qx, timeout=60),
+                                (d1, i1), f"{family} delta delete")
+                assert not (i1 == x.shape[0] + 1).any()
+                # base tombstone: documented host-merge fallback, still
+                # bit-identical end to end
+                for b in (b_on, b_off):
+                    b.submit_mutation("delete", {"ids": [17]}).result(
+                        timeout=60)
+                d1, i1 = b_on.kneighbors(qx, timeout=60)
+                _assert_bitwise(b_off.kneighbors(qx, timeout=60),
+                                (d1, i1), f"{family} base tombstone")
+                assert not (i1 == 17).any()
+                want = merged_oracle_kneighbors(model, on.snapshot(), qx)
+                np.testing.assert_array_equal(i1, want[1])
+            finally:
+                b_on.close()
+                b_off.close()
+
+    def test_ivf_rung_fused_delta_bit_identity(self, rng, tmp_path):
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=4, engine="xla").fit(Dataset(x, y))
+        setattr(model, IVF_ATTR, IVFIndex.build(x, 12, seed=0))
+        on, off = _mutable_pair(model, tmp_path)
+        rows = rng.standard_normal((30, x.shape[1])).astype(np.float32)
+        for e in (on, off):
+            e.apply_insert(rows, rng.integers(0, 3, 30).astype(
+                np.float32), time.monotonic_ns())
+        serving = IVFServing(4, 12)
+        got = serving.kneighbors(model, qx, view=on.snapshot())
+        want = serving.kneighbors(model, qx, view=off.snapshot())
+        _assert_bitwise(want, got, "ivf fused delta")
+        # fused stats really rode the device
+        st = model.ivf_.search_merged(
+            x, qx, 4, 4, on.snapshot())[2]
+        assert st.merged_delta and st.scorer == "device"
+
+    def test_concurrent_mutation_vs_reads(self, rng, tmp_path):
+        """Readers race a writer thread: every response must be
+        internally consistent (bit-equal to the merged oracle at ITS
+        view), and the device tail must never tear."""
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=4, engine="xla").fit(Dataset(x, y))
+        on, _off = _mutable_pair(model, tmp_path)
+        b = MicroBatcher(model, max_batch=64, max_wait_ms=0.0, mutable=on)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set() and i < 40:
+                rows = rng.standard_normal((3, x.shape[1])).astype(
+                    np.float32)
+                try:
+                    b.submit_mutation("insert", {
+                        "rows": rows,
+                        "values": rng.integers(0, 3, 3).astype(
+                            np.float32),
+                    }).result(timeout=30)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(15):
+                d, i = b.kneighbors(qx, timeout=60)
+                assert d.shape == (qx.shape[0], 4)
+                # rows sorted ascending wherever finite (the NaN query's
+                # all-inf row has no meaningful diff)
+                with np.errstate(invalid="ignore"):
+                    steps = np.diff(d, axis=1)
+                ok = np.isfinite(steps)
+                assert (steps[ok] >= 0).all()
+        finally:
+            stop.set()
+            t.join(30)
+            b.close()
+        assert not errors
+        view = on.snapshot()
+        want = merged_oracle_kneighbors(model, view, qx)
+        got = merged_oracle_kneighbors(model, view, qx)
+        np.testing.assert_array_equal(got[1], want[1])
+
+
+class TestIncrementalCompaction:
+    def _compact_once(self, model, engine):
+        from knn_tpu.mutable.compact import Compactor
+
+        def swap(m, v, hook):
+            hook()
+            return "prev"
+
+        c = Compactor(engine, swap=swap, warm=lambda m: None,
+                      threshold=10_000, interval_s=0)
+        return c.run_once()
+
+    def test_incremental_path_and_forced_rebuild(self, rng, tmp_path,
+                                                 monkeypatch):
+        x, _ = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=4, engine="xla").fit(Dataset(x, y))
+        setattr(model, IVF_ATTR, IVFIndex.build(x, 12, seed=0))
+        root = tmp_path / "idx"
+        save_index(model, root, ivf=model.ivf_)
+        eng = MutableEngine(model, root, delta_cap=256,
+                            device_tail="off")
+        eng.apply_insert(
+            x[:20] + 0.25, rng.integers(0, 3, 20).astype(np.float32),
+            time.monotonic_ns())
+        out = self._compact_once(model, eng)
+        assert out["compacted"] and out["ivf_compaction"] == "incremental"
+        assert out["ivf_cell_imbalance"] >= 1.0
+        # a zero imbalance budget forces the full Lloyd's rebuild
+        monkeypatch.setenv("KNN_TPU_IVF_REBUILD_IMBALANCE", "0")
+        eng.apply_insert(
+            x[:5] + 0.5, rng.integers(0, 3, 5).astype(np.float32),
+            time.monotonic_ns())
+        out2 = self._compact_once(model, eng)
+        assert out2["compacted"] and out2["ivf_compaction"] == "rebuild"
+
+    def test_assign_to_is_deterministic_and_keeps_centroids(self, rng):
+        x, _ = _tie_problem(rng)
+        base = IVFIndex.build(x, 10, seed=3)
+        extra = np.concatenate([x, x[:13] + 0.125])
+        a = IVFIndex.assign_to(extra, base)
+        b = IVFIndex.assign_to(extra, base)
+        np.testing.assert_array_equal(a.row_perm, b.row_perm)
+        np.testing.assert_array_equal(a.centroids, base.centroids)
+        assert a.meta["incremental"] and a.num_rows == extra.shape[0]
+        # the incremental partition still serves exactly
+        od, oi = oracle_kneighbors(extra, x[:8], 5)
+        dd, di, _ = a.search(extra, x[:8], 5, 10)
+        _assert_bitwise((od, oi), (dd, di), "incremental full probe")
+
+
+class TestDeleteAwareProbeAccounting:
+    def test_dead_rows_per_cell(self, rng):
+        x, _ = _tie_problem(rng)
+        ivf = IVFIndex.build(x, 8, seed=0)
+        inv = np.empty(x.shape[0], np.int64)
+        inv[ivf.row_perm] = np.arange(x.shape[0])
+        dead = np.array([0, 5, 9, 200], np.int64)
+        got = ivf.dead_rows_per_cell(dead)
+        want = np.zeros(8, np.int64)
+        for r in dead:
+            cell = int(np.searchsorted(ivf.cell_offsets, inv[r],
+                                       side="right") - 1)
+            want[cell] += 1
+        np.testing.assert_array_equal(got, want)
+        assert got.sum() == dead.size
+
+    def test_live_coverage_widens_past_dead_cells(self, rng):
+        """A probed cell whose rows are all tombstoned must not satisfy
+        k-coverage: the widening math counts LIVE rows only, so results
+        never come up short of live candidates."""
+        x, qx = _tie_problem(rng, n=120)
+        ivf = IVFIndex.build(x, 6, seed=0)
+        sizes = ivf.cell_sizes
+        # tombstone every row of the largest cell
+        cell = int(np.argmax(sizes))
+        lo, hi = int(ivf.cell_offsets[cell]), int(ivf.cell_offsets[
+            cell + 1])
+        dead_rows = ivf.row_perm[lo:hi]
+        dead_per_cell = ivf.dead_rows_per_cell(dead_rows)
+        k = 5
+        d_naive, i_naive, st_naive = ivf.search(x, qx, k, 1)
+        d, i, st = ivf.search(x, qx, k, 1, dead_per_cell=dead_per_cell)
+        assert st.dead_rows >= 0
+        live = ~np.isin(i, dead_rows)
+        # after masking the dead rows, every query still has k live
+        # candidates available among the returned set's live portion
+        # only if coverage counted live rows; the naive search can
+        # return rows that are all dead for queries centred on the
+        # dead cell.
+        assert st.forced_widenings >= st_naive.forced_widenings
+        assert (np.isin(i, dead_rows).sum(axis=1) + live.sum(axis=1)
+                == k).all()
+        # the live-coverage guarantee: at least k live candidates were
+        # gathered for every query (the probe set widened past the dead
+        # cell), so a post-merge mask can always fill top-k.
+        live_sizes = sizes - dead_per_cell
+        sel_counts = st.candidate_rows - st.dead_rows
+        assert sel_counts >= k * qx.shape[0] or (
+            live_sizes.sum() < k)
+
+    def test_serving_records_dead_candidate_counter(self, rng, tmp_path):
+        from knn_tpu import obs
+
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=4, engine="xla").fit(Dataset(x, y))
+        setattr(model, IVF_ATTR, IVFIndex.build(x, 12, seed=0))
+        on, _off = _mutable_pair(model, tmp_path)
+        on.apply_insert(x[:8] + 0.5,
+                        rng.integers(0, 3, 8).astype(np.float32),
+                        time.monotonic_ns())
+        on.apply_delete([11, 23], time.monotonic_ns())
+        serving = IVFServing(4, 12)
+        obs.enable()
+        try:
+            obs.reset()
+            serving.kneighbors(model, qx, view=on.snapshot())
+            metrics = {i.name for i in obs.registry().instruments()}
+            assert "knn_ivf_dead_candidate_rows_total" in metrics
+            assert "knn_ivf_scorer_dispatch_total" in metrics
+        finally:
+            obs.disable()
