@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak capacity-probe replay-gate bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak fleet-soak capacity-probe replay-gate bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -135,6 +135,24 @@ ivf-soak:
 mutable-soak:
 	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/mutable_soak.py \
 		--short --json-out build/mutable-soak-verdict.json
+
+# The replica-set gate (docs/SERVING.md §Running a replica set): 3
+# mutable replicas (primary + 2 WAL-shipped followers) behind a
+# `knn_tpu route` router with auto-failover. Four legs — (1) a follower's
+# process group is SIGKILLed under concurrent load: ZERO failed reads,
+# every read bit-identical to the oracle replay of the primary's durable
+# WAL; (2) the PRIMARY is SIGKILLed: writes 503 typed until the router
+# promotes the most-caught-up follower, then resume, with zero
+# acknowledged writes lost (every acked (seq, rows) pair present
+# bit-identical in the new primary's WAL); (3) the ex-primary rejoins as
+# a follower — unacked tail truncated at the takeover seq, catch-up over
+# wal-append with no divergence; (4) a crash-stopped replica aborts a
+# coordinated reload all-or-nothing (rolled back fleet-wide), and the
+# retry flips every replica. The verdict JSON lands in build/ (CI
+# uploads it).
+fleet-soak:
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/fleet_soak.py \
+		--short --json-out build/fleet-soak-verdict.json
 
 # The cost & capacity gate (docs/OBSERVABILITY.md §Cost & capacity): boot
 # serve with cost accounting on and assert (1) every 200's timeline

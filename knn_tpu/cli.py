@@ -55,7 +55,7 @@ EXIT_RUNTIME = 1
 
 # Subcommands (`classify` is implied when argv starts with anything else,
 # keeping the reference's positional invocation byte-compatible).
-_SUBCOMMANDS = ("classify", "serve", "save-index", "replay")
+_SUBCOMMANDS = ("classify", "serve", "save-index", "replay", "route")
 
 # persona -> (default backend, usage string modeled on the reference's)
 _PERSONAS = {
@@ -80,7 +80,8 @@ def build_parser() -> argparse.ArgumentParser:
                     "index building, and a micro-batching server",
     )
     sub = p.add_subparsers(dest="command",
-                           metavar="{classify,serve,save-index,replay}")
+                           metavar="{classify,serve,save-index,replay,"
+                                   "route}")
     _add_classify_args(sub.add_parser(
         "classify",
         help="one-shot classify (default; bare positional argv implies it)",
@@ -102,6 +103,17 @@ def build_parser() -> argparse.ArgumentParser:
                     "(arrays.npz + manifest.json) that `knn_tpu serve` "
                     "boots from without re-parsing ARFF.",
     ))
+    _add_route_args(sub.add_parser(
+        "route",
+        help="a fault-tolerant router over N serve replicas "
+             "(docs/SERVING.md §Running a replica set)",
+        description="Route /predict and /kneighbors reads to healthy "
+                    "replicas (health-polled + passively demoted, "
+                    "cross-replica retry, optional tail hedging), "
+                    "/insert and /delete writes to the one primary, "
+                    "with coordinated reload, serialized compaction, "
+                    "and optional automatic failover.",
+    ))
     _add_replay_args(sub.add_parser(
         "replay",
         help="re-drive a captured workload against a live server or an "
@@ -116,6 +128,42 @@ def build_parser() -> argparse.ArgumentParser:
                     "counts, captured-vs-replayed comparison).",
     ))
     return p
+
+
+def _add_route_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("replicas", nargs="+", metavar="REPLICA_URL",
+                   help="replica base URLs (e.g. http://127.0.0.1:8099); "
+                   "at least one")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8098,
+                   help="TCP port (0 picks an ephemeral port, reported "
+                   "in the ready line)")
+    p.add_argument("--health-interval-s", type=float, default=1.0,
+                   help="active /healthz poll interval per replica "
+                   "(passive demotion on forward errors is immediate "
+                   "regardless)")
+    p.add_argument("--health-timeout-s", type=float, default=2.0,
+                   help="per-poll timeout before a replica is marked "
+                   "unusable")
+    p.add_argument("--forward-timeout-s", type=float, default=30.0,
+                   help="per-forward timeout for reads and writes")
+    p.add_argument("--admin-timeout-s", type=float, default=300.0,
+                   help="timeout for coordinated reload/compact calls "
+                   "(reloads warm a whole index)")
+    p.add_argument("--hedge-ms", default="off", metavar="MS|auto|off",
+                   help="tail-read hedging: fire a second attempt on "
+                   "another replica once the first has been out this "
+                   "long ('auto' derives the delay from the observed "
+                   "read p99, so ~1%% of reads hedge; 'off' default)")
+    p.add_argument("--auto-failover", choices=["on", "off"],
+                   default="off",
+                   help="promote the most-caught-up usable follower "
+                   "automatically once the primary has been unusable "
+                   "for --failover-after-s (off: POST /admin/promote "
+                   "is the operator's lever)")
+    p.add_argument("--failover-after-s", type=float, default=3.0,
+                   help="how long the primary must be continuously "
+                   "unusable before --auto-failover acts")
 
 
 def _add_replay_args(p: argparse.ArgumentParser) -> None:
@@ -339,6 +387,36 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    help="burn-triggered capture windows auto-stop after "
                    "this many seconds (or at --capture-max-requests, "
                    "whichever first)")
+    p.add_argument("--follower-of", default=None, metavar="PRIMARY_URL",
+                   help="boot as a READ-ONLY replica of the primary at "
+                   "this base URL (docs/SERVING.md §Running a replica "
+                   "set): client /insert//delete are refused 409, "
+                   "primary-shipped WAL records apply through POST "
+                   "/admin/wal-append, and POST /admin/promote flips "
+                   "this process to primary in place. Requires "
+                   "--mutable on. A rebooting ex-primary passes the NEW "
+                   "primary here; its unacknowledged WAL tail past the "
+                   "takeover point is truncated before replay")
+    p.add_argument("--replicate-to", default=None,
+                   metavar="URL1,URL2,...",
+                   help="boot as the PRIMARY of a replica set, fanning "
+                   "every acknowledged WAL record out to these follower "
+                   "base URLs (one ordered cursor each; follower lag in "
+                   "/healthz fleet block + knn_fleet_replica_lag_seq). "
+                   "Requires --mutable on")
+    p.add_argument("--replicate-ack", choices=["any", "none"],
+                   default="any",
+                   help="write-durability bar with --replicate-to: "
+                   "'any' (default) holds each mutation's 200 until at "
+                   "least one follower confirmed its seq — that is what "
+                   "makes promoting the most-caught-up follower lose "
+                   "zero acknowledged writes; 'none' acks on the local "
+                   "WAL flush alone (faster, loses the failover "
+                   "guarantee)")
+    p.add_argument("--replicate-ack-timeout-s", type=float, default=5.0,
+                   help="how long a mutation waits for the follower ack "
+                   "before returning the typed 503 applied-but-"
+                   "unconfirmed outcome")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -642,6 +720,8 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         return _run_save_index(args, stdout)
     if args.command == "replay":
         return _run_replay(args, stdout)
+    if args.command == "route":
+        return _run_route(args, stdout)
     return _run_classify(args, stdout)
 
 
@@ -804,6 +884,19 @@ def _run_serve(args, stdout) -> int:
         (args.result_cache_rows < 0,
          f"--result-cache-rows must be >= 0, got "
          f"{args.result_cache_rows}"),
+        (args.follower_of is not None and args.replicate_to is not None,
+         "--follower-of and --replicate-to are contradictory: a replica "
+         "is born either the primary or a follower"),
+        ((args.follower_of is not None or args.replicate_to is not None)
+         and args.mutable != "on",
+         "--follower-of/--replicate-to ship the mutable tier's "
+         "write-ahead log; they need --mutable on"),
+        (args.follower_of is not None
+         and not args.follower_of.startswith(("http://", "https://")),
+         f"--follower-of wants a base URL, got {args.follower_of!r}"),
+        (args.replicate_ack_timeout_s <= 0,
+         f"--replicate-ack-timeout-s must be > 0, got "
+         f"{args.replicate_ack_timeout_s}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -869,7 +962,44 @@ def _run_serve(args, stdout) -> int:
     from knn_tpu.serve import artifact
     from knn_tpu.serve.server import ServeApp, make_server, serve_forever
 
+    replicate_to = None
+    if args.replicate_to is not None:
+        replicate_to = [u.strip() for u in args.replicate_to.split(",")
+                        if u.strip()]
+        bad_urls = [u for u in replicate_to
+                    if not u.startswith(("http://", "https://"))]
+        if not replicate_to or bad_urls:
+            print(f"error: --replicate-to wants comma-separated base "
+                  f"URLs, got {args.replicate_to!r}", file=sys.stderr)
+            return EXIT_USAGE
     mutable_on = args.mutable == "on"
+    if args.follower_of is not None:
+        # Rejoin reconciliation (docs/SERVING.md §Running a replica
+        # set): BEFORE the engine replays this artifact's WAL, drop the
+        # tail past the new primary's takeover point — on an ex-primary
+        # that tail is unacknowledged by construction, and under the new
+        # lineage those seqs name different mutations. Best-effort: an
+        # unreachable primary just means boot on the local log (the
+        # wal-append digest check still catches divergence, typed).
+        from knn_tpu.fleet.replica import reconcile_wal_with_primary
+        from knn_tpu.resilience.errors import DataError as _DataError
+
+        try:
+            outcome = reconcile_wal_with_primary(args.index,
+                                                 args.follower_of)
+        except _DataError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return EXIT_USAGE
+        if outcome and outcome.get("reconciled"):
+            if outcome.get("dropped"):
+                print(f"knn-tpu serve: rejoin truncated "
+                      f"{outcome['dropped']} unacknowledged WAL "
+                      f"record(s) past the takeover seq "
+                      f"{outcome['cap']}", file=sys.stderr, flush=True)
+        elif outcome:
+            print(f"warning: rejoin reconciliation skipped "
+                  f"({outcome.get('reason')}); booting on the local "
+                  f"log", file=sys.stderr, flush=True)
     try:
         if mutable_on:
             # The mutable tier owns the artifact's lifecycle: boot from
@@ -934,6 +1064,9 @@ def _run_serve(args, stdout) -> int:
             capture_burn_window_s=args.capture_burn_window_s,
             batch_buckets=batch_buckets,
             result_cache_rows=args.result_cache_rows,
+            follower_of=args.follower_of, replicate_to=replicate_to,
+            replicate_ack=args.replicate_ack,
+            replicate_ack_timeout_s=args.replicate_ack_timeout_s,
         )
     except OSError as e:  # an unwritable --access-log / --capture-dir path
         print(f"error: {e}", file=sys.stderr)
@@ -971,6 +1104,14 @@ def _run_serve(args, stdout) -> int:
                         f"epoch={m['epoch']}, "
                         f"replayed_delta={m['delta_slots']}, "
                         f"delta_cap={args.delta_cap})")
+    fleet_note = ""
+    if app.fleet is not None:
+        role = app.fleet.role
+        fleet_note = (f", fleet={role}"
+                      + (f" of {args.follower_of}"
+                         if role == "follower"
+                         else f" -> {len(replicate_to or ())} follower(s)"
+                              f" ack={args.replicate_ack}"))
     bucket_note = ""
     if batch_buckets is not None:
         bucket_note = f", buckets={'/'.join(str(b) for b in batch_buckets)}"
@@ -980,11 +1121,78 @@ def _run_serve(args, stdout) -> int:
         f"knn-tpu serve: ready on http://{host}:{port} "
         f"(family={app.family}, k={model.k}, "
         f"train_rows={model.train_.num_instances}, "
-        f"index_version={version}{ivf_note}{mutable_note}{bucket_note}, "
-        f"warmed={sorted(warmed)})",
+        f"index_version={version}{ivf_note}{mutable_note}{fleet_note}"
+        f"{bucket_note}, warmed={sorted(warmed)})",
         file=stdout, flush=True,
     )
     return serve_forever(server, drain_timeout_s=args.drain_timeout_s)
+
+
+def _run_route(args, stdout) -> int:
+    """``knn_tpu route URL...``: boot the fleet router. Bad policy values
+    (or a router port that cannot bind) follow the serve exit-code
+    contract. The router loads no model — it is up in milliseconds and
+    restartable with zero state loss."""
+    for bad, msg in (
+        (not 0 <= args.port <= 65535, f"--port out of range: {args.port}"),
+        (args.health_interval_s <= 0,
+         f"--health-interval-s must be > 0, got {args.health_interval_s}"),
+        (args.health_timeout_s <= 0,
+         f"--health-timeout-s must be > 0, got {args.health_timeout_s}"),
+        (args.forward_timeout_s <= 0,
+         f"--forward-timeout-s must be > 0, got {args.forward_timeout_s}"),
+        (args.admin_timeout_s <= 0,
+         f"--admin-timeout-s must be > 0, got {args.admin_timeout_s}"),
+        (args.failover_after_s <= 0,
+         f"--failover-after-s must be > 0, got {args.failover_after_s}"),
+    ):
+        if bad:
+            print(f"error: {msg}", file=sys.stderr)
+            return EXIT_USAGE
+    for url in args.replicas:
+        if not url.startswith(("http://", "https://")):
+            print(f"error: replica URL {url!r} must start with http:// "
+                  f"or https://", file=sys.stderr)
+            return EXIT_USAGE
+    from knn_tpu.fleet.router import (
+        RouterApp,
+        make_router_server,
+        router_forever,
+    )
+
+    # The /metrics endpoint is the router's observability artifact
+    # (the serve rule).
+    obs.enable()
+    try:
+        app = RouterApp(
+            args.replicas,
+            health_interval_s=args.health_interval_s,
+            poll_timeout_s=args.health_timeout_s,
+            forward_timeout_s=args.forward_timeout_s,
+            admin_timeout_s=args.admin_timeout_s,
+            hedge=args.hedge_ms,
+            auto_failover=(args.auto_failover == "on"),
+            failover_after_s=args.failover_after_s,
+        )
+    except ValueError as e:  # bad --hedge-ms / duplicate replica URLs
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    try:
+        server = make_router_server(app, args.host, args.port)
+    except OSError as e:
+        print(f"error: cannot bind {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        app.close()
+        return EXIT_RUNTIME
+    host, port = server.server_address[:2]
+    usable = app.set.export()["usable"]
+    print(
+        f"knn-tpu route: ready on http://{host}:{port} "
+        f"(replicas={len(args.replicas)}, usable={usable}, "
+        f"hedge={args.hedge_ms}, auto_failover={args.auto_failover})",
+        file=stdout, flush=True,
+    )
+    return router_forever(server)
 
 
 def _run_replay(args, stdout) -> int:

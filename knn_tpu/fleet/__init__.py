@@ -1,0 +1,21 @@
+"""Replica sets and fault-tolerant routing (docs/SERVING.md §Running a
+replica set).
+
+Three layers, each importable on its own:
+
+- :mod:`knn_tpu.fleet.replica` — the replica-side role: a PRIMARY fans
+  every acknowledged WAL record out to its followers (``WALShipper``,
+  one ordered cursor per follower, semi-synchronous ack), a FOLLOWER
+  applies shipped records through the exact local-mutation validation
+  path and can be promoted in place.
+- :mod:`knn_tpu.fleet.health` — the router's view of N replicas: active
+  ``/healthz`` polling plus passive demotion on connection errors.
+- :mod:`knn_tpu.fleet.router` — the thin HTTP front-end (`knn_tpu
+  route`): reads routed to healthy replicas with cross-replica retry and
+  optional tail hedging, writes routed to the one primary, coordinated
+  reload (all-or-nothing), serialized compaction, optional auto-failover.
+
+Everything here is OPT-IN: a plain ``knn_tpu serve`` (no
+``--follower-of``, no ``--replicate-to``) never imports this package
+(scripts/check_disabled_overhead.py pins it).
+"""

@@ -1,0 +1,245 @@
+"""The router's view of its replicas: polled + passively demoted health.
+
+One :class:`ReplicaSet` owns N replica base URLs. A background thread
+polls each replica's ``/healthz`` (the capacity/quality/slo document the
+serve process already exports) on an interval; a replica is **usable**
+when that poll returned HTTP 200 (ready, not draining). Two demotion
+paths, one promotion path:
+
+- **active**: a poll that fails to connect, times out, or returns non-200
+  marks the replica unusable;
+- **passive**: a connection error during a live forward marks it unusable
+  IMMEDIATELY (``note_failure``) — the drain path closes its listener
+  before flipping healthz exactly so this fires on the first refused
+  connect, not a poll interval later;
+- a replica only becomes usable again through a successful poll (a lucky
+  forward is not evidence of health — the poll reads the whole document).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from knn_tpu import obs
+from knn_tpu.fleet.wire import request_json
+
+
+class ReplicaState:
+    """Everything the router knows about one replica (exported verbatim
+    into the router's ``/healthz`` and ``/debug/fleet``)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.healthy = False
+        self.ever_seen = False
+        self.consecutive_failures = 0
+        self.last_error: Optional[str] = None
+        self.last_poll_unix: Optional[float] = None
+        self.draining = False
+        self.index_version: Optional[str] = None
+        self.role: Optional[str] = None       # primary|follower|None
+        self.applied_seq = 0
+        self.promoted_at_seq: Optional[int] = None
+        self.compaction_pressure: Optional[int] = None
+
+    def export(self) -> dict:
+        return {
+            "healthy": self.healthy,
+            "ever_seen": self.ever_seen,
+            "consecutive_failures": self.consecutive_failures,
+            "last_error": self.last_error,
+            "draining": self.draining,
+            "index_version": self.index_version,
+            "role": self.role,
+            "applied_seq": self.applied_seq,
+            "compaction_pressure": self.compaction_pressure,
+        }
+
+
+class ReplicaSet:
+    def __init__(self, urls, *, interval_s: float = 1.0,
+                 poll_timeout_s: float = 2.0, on_poll=None):
+        if not urls:
+            raise ValueError("a replica set needs at least one replica "
+                             "base URL")
+        self.urls = [u.rstrip("/") for u in urls]
+        if len(set(self.urls)) != len(self.urls):
+            raise ValueError(f"duplicate replica URLs: {self.urls}")
+        self.interval_s = float(interval_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self._on_poll = on_poll
+        self._lock = threading.Lock()
+        self._states = {u: ReplicaState(u) for u in self.urls}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.poll_once()  # the router answers its first request informed
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="knn-fleet-health")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the poller must survive
+                pass
+
+    # -- polling -----------------------------------------------------------
+
+    def poll_once(self) -> None:
+        for url in self.urls:
+            self._poll(url)
+        cb = self._on_poll
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — advisory (failover) hook
+                pass
+
+    def _poll(self, url: str) -> None:
+        try:
+            status, doc = request_json("GET", url + "/healthz",
+                                       timeout=self.poll_timeout_s)
+        except OSError as e:
+            self._mark_down(url, f"{type(e).__name__}: {e}")
+            return
+        with self._lock:
+            s = self._states[url]
+            s.ever_seen = True
+            s.last_poll_unix = time.time()
+            s.draining = bool(doc.get("draining"))
+            s.index_version = doc.get("index_version", s.index_version)
+            fleet = doc.get("fleet")
+            if isinstance(fleet, dict):
+                s.role = fleet.get("role")
+                s.applied_seq = int(fleet.get("applied_seq") or 0)
+                s.promoted_at_seq = fleet.get("promoted_at_seq")
+            mutable = doc.get("mutable")
+            if isinstance(mutable, dict):
+                s.compaction_pressure = (int(mutable.get("delta_slots", 0))
+                                         + int(mutable.get("tombstones", 0)))
+            if status == 200:
+                s.healthy = True
+                s.consecutive_failures = 0
+                s.last_error = None
+            else:
+                s.healthy = False
+                s.consecutive_failures += 1
+                s.last_error = (f"HTTP {status}"
+                                + (" (draining)" if s.draining else ""))
+        self._export_gauge(url)
+
+    def _mark_down(self, url: str, err: str) -> None:
+        with self._lock:
+            s = self._states[url]
+            s.healthy = False
+            s.consecutive_failures += 1
+            s.last_error = err
+            s.last_poll_unix = time.time()
+        self._export_gauge(url)
+
+    def note_failure(self, url: str, err: str) -> None:
+        """Passive demotion: a forward just failed at the transport layer
+        — don't wait for the next poll to stop routing there."""
+        self._mark_down(url.rstrip("/"), err)
+
+    def _export_gauge(self, url: str) -> None:
+        obs.gauge_set(
+            "knn_fleet_replica_healthy",
+            1 if self._states[url].healthy else 0,
+            help="1 while the replica's /healthz poll returns ready",
+            replica=url,
+        )
+
+    # -- queries -----------------------------------------------------------
+
+    def state(self, url: str) -> ReplicaState:
+        return self._states[url.rstrip("/")]
+
+    def usable_urls(self, start: int = 0) -> "list[str]":
+        """Healthy replicas, rotated by ``start`` (the router's
+        round-robin cursor) so consecutive reads spread the load."""
+        with self._lock:
+            up = [u for u in self.urls if self._states[u].healthy]
+        if not up:
+            return []
+        k = start % len(up)
+        return up[k:] + up[:k]
+
+    def primary_url(self) -> Optional[str]:
+        """The healthy replica reporting role=primary, or None (failover
+        window, or an immutable fleet with no roles at all)."""
+        return (self.primaries() or [None])[0]
+
+    def primaries(self) -> "list[str]":
+        with self._lock:
+            return [u for u in self.urls
+                    if self._states[u].healthy
+                    and self._states[u].role == "primary"]
+
+    def down_primary(self) -> Optional[str]:
+        """The replica whose LAST seen role was primary but which is now
+        unusable — the failover trigger (None while a healthy primary
+        exists)."""
+        with self._lock:
+            healthy_primary = any(
+                self._states[u].healthy
+                and self._states[u].role == "primary" for u in self.urls)
+            if healthy_primary:
+                return None
+            for u in self.urls:
+                if self._states[u].role == "primary":
+                    return u
+        return None
+
+    def most_caught_up(self, exclude=()) -> Optional[str]:
+        """The healthy follower with the highest ``applied_seq`` — with
+        semi-synchronous ack it holds every acknowledged write, which is
+        what makes promoting it lossless."""
+        exclude = {u.rstrip("/") for u in exclude}
+        with self._lock:
+            candidates = [
+                (self._states[u].applied_seq, u) for u in self.urls
+                if u not in exclude and self._states[u].healthy
+                and self._states[u].role == "follower"
+            ]
+        if not candidates:
+            return None
+        return max(candidates)[1]
+
+    def export(self) -> dict:
+        with self._lock:
+            states = {u: self._states[u].export() for u in self.urls}
+        primaries = [u for u, s in states.items()
+                     if s["healthy"] and s["role"] == "primary"]
+        primary_seq = max((s["applied_seq"] for s in states.values()
+                           if s["role"] == "primary"), default=None)
+        lag = None
+        if primary_seq is not None:
+            lag = {u: max(0, primary_seq - s["applied_seq"])
+                   for u, s in states.items() if s["role"] == "follower"}
+            for u, v in lag.items():
+                obs.gauge_set(
+                    "knn_fleet_replica_lag_seq", v,
+                    help="primary applied_seq minus this follower's "
+                         "acked seq",
+                    follower=u,
+                )
+        return {
+            "replicas": states,
+            "usable": sum(1 for s in states.values() if s["healthy"]),
+            "primary": primaries[0] if len(primaries) == 1 else None,
+            "split_brain": primaries if len(primaries) > 1 else None,
+            "lag": lag,
+        }

@@ -1,0 +1,777 @@
+"""The fleet router (`knn_tpu route`): a thin HTTP front-end over N
+replicas (docs/SERVING.md §Running a replica set).
+
+Routing rules (each one line of the robustness story):
+
+- **reads** (``/predict``, ``/kneighbors``) go to a usable replica
+  (round-robin); a transport failure or retryable status (429/5xx)
+  retries on a DIFFERENT replica — reads are idempotent, so they retry
+  freely. Optionally a tail read is **hedged**: if the first replica has
+  not answered within a p99-derived delay, a second attempt races it on
+  another replica and the first acceptable answer wins.
+- **writes** (``/insert``, ``/delete``) go to the ONE primary. A
+  connect-refused forward (proven never sent) demotes the primary and
+  returns a typed 503 — the failover window; anything that reached the
+  wire is NEVER blindly re-sent (an indeterminate mutation re-sent is a
+  duplicate). No primary (or two — split brain) is a typed 503.
+- **503 with a JSON body is the only total-failure answer**: the router
+  returns it exactly when ZERO replicas are usable (or no primary, for
+  writes) — never a traceback.
+- ``POST /admin/reload`` flips ``index_version`` on EVERY replica or
+  none: replicas reload sequentially through their own validated
+  rollback path; the first failure rolls the already-flipped replicas
+  back to the previous fleet-wide target.
+- ``POST /admin/compact`` runs on at most ONE replica at a time, chosen
+  by compaction debt (the ``/debug/capacity`` mutable block).
+- ``POST /admin/promote`` (and ``--auto-failover``) promotes the
+  most-caught-up usable follower.
+
+The router holds no model and no index — it is restartable at any time
+with zero state loss (its only state is health, a round-robin cursor,
+and the confirmed reload target).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from knn_tpu import obs
+from knn_tpu.fleet.health import ReplicaSet
+from knn_tpu.fleet.wire import forward_bytes, request_json
+from knn_tpu.obs import reqtrace
+from knn_tpu.resilience.retry import guarded_call
+
+#: Statuses a READ may retry on another replica: the replica refused or
+#: failed the request without serving it (429 overload, 503 draining,
+#: 5xx failure). 4xx client errors pass through — a malformed body is
+#: malformed everywhere.
+_READ_RETRYABLE = frozenset({429, 500, 502, 503, 504})
+
+#: Request bodies past this are rejected before buffering (the serve
+#: process's own bound).
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+#: Hedge latency ring size (p99 over the last N read forwards).
+_LATENCY_RING = 512
+
+
+class RouterBusy(Exception):
+    """A fleet-wide admin operation (reload/compact) is already running;
+    mapped to HTTP 409."""
+
+
+class RouterApp:
+    def __init__(self, replicas, *, health_interval_s: float = 1.0,
+                 poll_timeout_s: float = 2.0,
+                 forward_timeout_s: float = 30.0,
+                 admin_timeout_s: float = 300.0,
+                 hedge: str = "off",
+                 auto_failover: bool = False,
+                 failover_after_s: float = 3.0):
+        self.set = ReplicaSet(replicas, interval_s=health_interval_s,
+                              poll_timeout_s=poll_timeout_s,
+                              on_poll=self._maybe_failover)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.admin_timeout_s = float(admin_timeout_s)
+        self.hedge = self._parse_hedge(hedge)
+        self.auto_failover = bool(auto_failover)
+        self.failover_after_s = float(failover_after_s)
+        self.started_unix = time.time()
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._lat_ring = np.zeros(_LATENCY_RING, np.float64)
+        self._lat_pos = 0
+        self._lat_lock = threading.Lock()
+        self._admin_lock = threading.Lock()   # one reload/compact at a time
+        self._confirmed_index: Optional[str] = None
+        self._failover_lock = threading.Lock()
+        self._primary_down_since: Optional[float] = None
+        self._failover_inflight = False
+        self.failovers = 0
+        self.reloads = 0
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="knn-fleet-hedge")
+        self.set.start()
+
+    @staticmethod
+    def _parse_hedge(hedge) -> Optional[float]:
+        """``None`` = off, ``0.0`` = auto (p99-derived), >0 = fixed ms."""
+        if hedge in (None, "off", "", False):
+            return None
+        if hedge == "auto":
+            return 0.0
+        ms = float(hedge)
+        if ms <= 0:
+            raise ValueError(f"hedge delay must be > 0 ms, got {ms}")
+        return ms
+
+    def close(self) -> None:
+        self.set.close()
+        self._pool.shutdown(wait=False)
+
+    # -- latency / hedging -------------------------------------------------
+
+    def _note_latency(self, ms: float) -> None:
+        with self._lat_lock:
+            self._lat_ring[self._lat_pos % _LATENCY_RING] = ms
+            self._lat_pos += 1
+
+    def hedge_delay_s(self) -> Optional[float]:
+        """The wait before firing a hedge: the configured fixed delay, or
+        (auto) the observed read p99 — a hedge should only ever fire for
+        genuine tail requests, so it costs ~1% duplicate work. Auto with
+        under 50 observations returns None (no evidence, no hedging)."""
+        if self.hedge is None:
+            return None
+        if self.hedge > 0:
+            return self.hedge / 1e3
+        with self._lat_lock:
+            n = min(self._lat_pos, _LATENCY_RING)
+            if n < 50:
+                return None
+            p99 = float(np.percentile(self._lat_ring[:n], 99))
+        return max(p99, 1.0) / 1e3
+
+    # -- forwarding --------------------------------------------------------
+
+    def _next_rr(self) -> int:
+        with self._rr_lock:
+            self._rr += 1
+            return self._rr
+
+    def _attempt(self, url: str, path: str, body: Optional[bytes],
+                 headers: dict, timeout_s: float):
+        """One forward to one replica. Returns ``("ok"|"retryable",
+        url, status, raw_body)`` or ``("transport", url, error, None)``
+        — and passively demotes the replica on a transport failure."""
+        t0 = time.monotonic()
+        try:
+            status, raw = guarded_call(
+                "fleet.forward",
+                lambda: forward_bytes("POST", url + path, body,
+                                      timeout_s, headers),
+                attempts=1, classify=False,
+            )
+        except Exception as e:  # noqa: BLE001 — transport taxonomy below
+            self.set.note_failure(url, f"{type(e).__name__}: {e}")
+            self._count_forward(url, "transport_error")
+            return ("transport", url, e, None)
+        if status in _READ_RETRYABLE:
+            self._count_forward(url, f"http_{status}")
+            return ("retryable", url, status, raw)
+        self._note_latency((time.monotonic() - t0) * 1e3)
+        self._count_forward(url, "ok" if status == 200 else
+                            f"http_{status}")
+        return ("ok", url, status, raw)
+
+    @staticmethod
+    def _count_forward(url: str, outcome: str) -> None:
+        obs.counter_add(
+            "knn_fleet_forward_total",
+            help="router->replica forwards by replica and outcome",
+            replica=url, outcome=outcome,
+        )
+
+    def forward_read(self, path: str, body: Optional[bytes],
+                     headers: dict):
+        """Route one read; returns ``(status, raw_json_body, replica)``.
+        Walks the usable replicas (round-robin start), retrying transport
+        failures and retryable statuses on the NEXT replica; optionally
+        hedges the first attempt. 503 typed only when zero replicas are
+        usable or every one failed."""
+        candidates = self.set.usable_urls(start=self._next_rr())
+        if not candidates:
+            return self._none_usable("read")
+        failures = []
+        hedge_s = self.hedge_delay_s()
+        i = 0
+        while i < len(candidates):
+            url = candidates[i]
+            if i == 0 and hedge_s is not None and len(candidates) > 1:
+                result = self._hedged_attempt(candidates, path, body,
+                                              headers, hedge_s)
+                i += 2  # the hedged round consumed candidates[0] AND [1]
+            else:
+                result = self._attempt(url, path, body, headers,
+                                       self.forward_timeout_s)
+                i += 1
+            kind, where, detail, raw = result
+            if kind == "ok":
+                return detail, raw, where
+            failures.append(f"{where}: "
+                            f"{detail if kind == 'retryable' else f'{type(detail).__name__}: {detail}'}")
+            obs.counter_add(
+                "knn_fleet_retries_total",
+                help="reads re-routed to a different replica after a "
+                     "transient failure",
+                kind="read",
+            )
+            if kind == "retryable" and len(candidates) == 1:
+                # Nothing to retry on; surface the replica's own status.
+                return detail, raw, where
+        return 503, _json_body({
+            "error": f"every usable replica failed the read: "
+                     f"{'; '.join(failures[:4])}",
+            "replicas_tried": len(candidates),
+        }), None
+
+    def _hedged_attempt(self, candidates, path, body, headers,
+                        hedge_s: float):
+        """Race the first two candidates: fire #1, wait ``hedge_s``, fire
+        #2 if #1 is still out — OR if #1 failed fast (the backup then
+        doubles as the cross-replica retry: the caller consumed both
+        candidates, so skipping #2 on a fast failure would silently
+        shrink the retry walk). Returns the first acceptable answer."""
+        f1 = self._pool.submit(self._attempt, candidates[0], path, body,
+                               headers, self.forward_timeout_s)
+        first_failure = None
+        try:
+            result = f1.result(timeout=hedge_s)
+            if result[0] == "ok":
+                return result
+            first_failure = result
+        except concurrent.futures.TimeoutError:
+            obs.counter_add("knn_fleet_hedges_total",
+                            help="hedged tail reads by outcome",
+                            outcome="fired")
+        f2 = self._pool.submit(self._attempt, candidates[1], path, body,
+                               headers, self.forward_timeout_s)
+        pending = {f2} if first_failure is not None else {f1, f2}
+        last = first_failure
+        while pending:
+            done, pending = concurrent.futures.wait(
+                pending, return_when=concurrent.futures.FIRST_COMPLETED)
+            for fut in done:
+                result = fut.result()
+                if result[0] == "ok":
+                    if fut is f2 and first_failure is None:
+                        obs.counter_add("knn_fleet_hedges_total",
+                                        help="hedged tail reads by "
+                                             "outcome",
+                                        outcome="won")
+                    for p in pending:
+                        p.cancel()
+                    return result
+                last = result
+        return last
+
+    def forward_write(self, path: str, body: Optional[bytes],
+                      headers: dict):
+        """Route one mutation to the primary — exactly once on the wire.
+        Retry policy: only a PROVEN-not-applied failure (the connect was
+        refused, so no byte reached the primary) is safe to re-send, and
+        even then the primary is demoted and the answer is the typed 503
+        failover window — the client (or the soak's writer loop) retries
+        after the promote, against a new primary. Anything indeterminate
+        (timeout mid-request, connection reset after send) returns a
+        typed 502: re-sending could apply the mutation twice."""
+        primaries = self.set.primaries()  # cheap: no export()/gauge
+        # churn on the per-write hot path
+        if len(primaries) > 1:
+            return 503, _json_body({
+                "error": f"split brain: {primaries} both claim primary; "
+                         f"refusing writes until an operator demotes "
+                         f"one",
+            }), None
+        primary = primaries[0] if primaries else None
+        if primary is None:
+            return 503, _json_body({
+                "error": "no usable primary (failover in progress or "
+                         "the fleet is read-only); retry after promote",
+                "down_primary": self.set.down_primary(),
+            }), None
+        try:
+            status, raw = guarded_call(
+                "fleet.forward",
+                lambda: forward_bytes("POST", primary + path, body,
+                                      self.forward_timeout_s, headers),
+                attempts=1, classify=False,
+            )
+        except ConnectionRefusedError as e:
+            # Proven never sent: the listener is gone (the drain path
+            # closes it first, a SIGKILL'd process loses it with the
+            # process). Demote now so the failover clock starts.
+            self.set.note_failure(primary, f"ConnectionRefusedError: {e}")
+            self._count_forward(primary, "refused")
+            return 503, _json_body({
+                "error": f"primary {primary} refused the connection; "
+                         f"write not applied — retry after failover",
+            }), primary
+        except Exception as e:  # noqa: BLE001 — indeterminate transport
+            refused = isinstance(getattr(e, "reason", None),
+                                 ConnectionRefusedError)
+            self.set.note_failure(primary, f"{type(e).__name__}: {e}")
+            self._count_forward(primary, "refused" if refused
+                                else "transport_error")
+            if refused:
+                return 503, _json_body({
+                    "error": f"primary {primary} refused the connection; "
+                             f"write not applied — retry after failover",
+                }), primary
+            return 502, _json_body({
+                "error": f"write to {primary} failed mid-flight "
+                         f"({type(e).__name__}: {e}); the outcome is "
+                         f"INDETERMINATE — re-read before re-sending "
+                         f"(a blind retry could apply it twice)",
+            }), primary
+        self._count_forward(primary, "ok" if status == 200
+                            else f"http_{status}")
+        return status, raw, primary
+
+    def _none_usable(self, kind: str):
+        export = self.set.export()
+        detail = {u: s["last_error"]
+                  for u, s in export["replicas"].items()}
+        return 503, _json_body({
+            "error": f"zero usable replicas for this {kind}",
+            "replicas": detail,
+        }), None
+
+    # -- coordinated admin -------------------------------------------------
+
+    def coordinated_reload(self, index: Optional[str],
+                           rollback_to: Optional[str] = None) -> dict:
+        """Flip every replica's index or none. Sequential prepare/confirm
+        over each replica's own validated reload: the Nth failure rolls
+        replicas 1..N-1 back to the previous fleet-wide target — the
+        last CONFIRMED reload this router drove, overridable per-call
+        with ``rollback_to`` (the operator's lever after a router
+        restart, which loses the in-memory confirmed target and would
+        otherwise fall back to each replica's boot index), else their
+        boot index. All-or-nothing is judged over the WHOLE set — an
+        unreachable replica aborts, so a crash-stop mid-reload leaves
+        the survivors consistent. A fleet that is ALREADY divergent
+        (replicas reporting different versions) refuses the reload
+        before flipping anything: rolling back from an unknown mixed
+        state could only compound the divergence."""
+        if not self._admin_lock.acquire(blocking=False):
+            raise RouterBusy("a fleet-wide reload or compaction is "
+                             "already in progress")
+        try:
+            targets = list(self.set.urls)
+            # Divergence pre-check over the replicas that ANSWER — an
+            # unreachable one is not evidence of divergence (the flip
+            # sequence aborts + rolls back on it anyway, which is the
+            # crash-stop contract the fleet soak pins).
+            pre = {}
+            for url in targets:
+                st, doc, _err = self._admin_call("GET", url + "/healthz",
+                                                 None)
+                if st is not None and doc.get("index_version"):
+                    pre[url] = doc["index_version"]
+            if len(set(pre.values())) > 1:
+                return {"status": 409, "body": {
+                    "error": f"fleet versions already diverge: {pre} — "
+                             f"fix the stragglers (or remove them from "
+                             f"the set) before a coordinated reload",
+                    "rolled_back": False,
+                }}
+            if rollback_to is not None:
+                self._confirmed_index = rollback_to
+            flipped: "list[str]" = []
+            versions: "dict[str, str]" = {}
+            payload = {"index": index} if index else {}
+            for url in targets:
+                st, doc, err = self._admin_call(
+                    "POST", url + "/admin/reload", payload)
+                if st != 200:
+                    rollback = self._rollback_reload(flipped)
+                    obs.counter_add("knn_fleet_reloads_total",
+                                    help="coordinated fleet reloads by "
+                                         "outcome",
+                                    outcome="rolled_back")
+                    return {
+                        "status": 502,
+                        "body": {
+                            "error": f"reload failed on {url}: "
+                                     f"{err or doc.get('error', doc)}",
+                            "rolled_back": True,
+                            "flipped_then_rolled_back": flipped,
+                            "rollback": rollback,
+                        },
+                    }
+                flipped.append(url)
+                versions[url] = doc.get("index_version")
+            if len(set(versions.values())) > 1:
+                rollback = self._rollback_reload(flipped)
+                obs.counter_add("knn_fleet_reloads_total",
+                                help="coordinated fleet reloads by "
+                                     "outcome",
+                                outcome="rolled_back")
+                return {"status": 502, "body": {
+                    "error": f"replicas flipped to DIFFERENT versions "
+                             f"{versions} — the artifact paths do not "
+                             f"name one build; rolled back",
+                    "rolled_back": True, "rollback": rollback,
+                }}
+            self._confirmed_index = index
+            self.reloads += 1
+            obs.counter_add("knn_fleet_reloads_total",
+                            help="coordinated fleet reloads by outcome",
+                            outcome="ok")
+            return {"status": 200, "body": {
+                "index_version": next(iter(versions.values()), None),
+                "replicas": len(flipped),
+            }}
+        finally:
+            self._admin_lock.release()
+
+    def _admin_call(self, method: str, url: str, payload,
+                    timeout: Optional[float] = None):
+        try:
+            st, doc = request_json(
+                method, url, payload,
+                timeout=timeout if timeout is not None
+                else self.admin_timeout_s)
+            return st, doc, None
+        except OSError as e:
+            return None, {}, f"{type(e).__name__}: {e}"
+
+    def _rollback_reload(self, flipped) -> dict:
+        """Re-point already-flipped replicas at the previous confirmed
+        target (their boot index when none): best-effort, per-replica
+        outcome reported — a replica that ALSO fails rollback is left
+        marked unhealthy for the operator."""
+        payload = ({"index": self._confirmed_index}
+                   if self._confirmed_index else {})
+        out = {}
+        for url in flipped:
+            st, doc, err = self._admin_call(
+                "POST", url + "/admin/reload", payload)
+            out[url] = "ok" if st == 200 else (err or
+                                               doc.get("error", f"HTTP {st}"))
+            if st != 200:
+                self.set.note_failure(url, f"rollback reload failed: "
+                                           f"{out[url]}")
+        return out
+
+    def coordinated_compact(self, replica: Optional[str] = None) -> dict:
+        """Run one compaction on ONE replica: the named one, else the
+        highest compaction debt (delta slots + tombstones from each
+        usable replica's ``/debug/capacity``). Serialized fleet-wide —
+        compaction doubles a replica's working set while it folds, and
+        one replica at a time is the capacity contract."""
+        if not self._admin_lock.acquire(blocking=False):
+            raise RouterBusy("a fleet-wide reload or compaction is "
+                             "already in progress")
+        try:
+            target = replica
+            debts = {}
+            if target is None:
+                for url in self.set.usable_urls():
+                    st, doc, err = self._admin_call(
+                        "GET", url + "/debug/capacity", None)
+                    blk = doc.get("mutable") if st == 200 else None
+                    if isinstance(blk, dict):
+                        debts[url] = (int(blk.get("delta_slots", 0))
+                                      + int(blk.get("tombstones", 0)))
+                if not debts:
+                    return {"status": 503, "body": {
+                        "error": "no usable mutable replica reports "
+                                 "compaction debt",
+                    }}
+                target = max(debts, key=debts.get)
+            st, doc, err = self._admin_call(
+                "POST", target + "/admin/compact", {})
+            if st is None:
+                return {"status": 502, "body": {
+                    "error": f"compaction on {target} failed at the "
+                             f"transport layer: {err}",
+                    "replica": target,
+                }}
+            return {"status": st, "body": {**doc, "replica": target,
+                                           "debts": debts or None}}
+        finally:
+            self._admin_lock.release()
+
+    def promote(self, replica: Optional[str] = None,
+                trigger: str = "manual") -> dict:
+        """Promote ``replica`` (default: the most-caught-up usable
+        follower) and hand it the surviving peers to ship to. The
+        promote call itself is bounded short — it flips a role in place,
+        no index work — so a stalled target cannot pin the caller (the
+        auto-failover path runs this; see :meth:`_maybe_failover`)."""
+        target = replica.rstrip("/") if replica else None
+        if target is None:
+            target = self.set.most_caught_up(
+                exclude=[u for u in (self.set.down_primary(),) if u])
+        if target is None:
+            return {"status": 503, "body": {
+                "error": "no usable follower to promote",
+            }}
+        peers = [u for u in self.set.urls if u != target]
+        st, doc, err = self._admin_call(
+            "POST", target + "/admin/promote", {"replicate_to": peers},
+            timeout=min(self.admin_timeout_s, 10.0))
+        if st != 200:
+            return {"status": 502 if st is None else st, "body": {
+                "error": f"promote on {target} failed: "
+                         f"{err or doc.get('error', doc)}",
+                "replica": target,
+            }}
+        self.failovers += 1
+        obs.counter_add("knn_fleet_failovers_total",
+                        help="promotions the router drove, by trigger",
+                        trigger=trigger)
+        self.set.poll_once()  # writes resume as soon as the poll sees it
+        return {"status": 200, "body": {**doc, "replica": target,
+                                        "trigger": trigger}}
+
+    def _maybe_failover(self) -> None:
+        """Poll hook: with ``--auto-failover``, promote once the primary
+        has been unusable for ``failover_after_s`` straight. The promote
+        runs OFF the poll thread: health polling is the only path that
+        re-promotes replicas to usable, so a stalled promote call must
+        never freeze it."""
+        if not self.auto_failover:
+            return
+        with self._failover_lock:
+            down = self.set.down_primary()
+            if down is None:
+                self._primary_down_since = None
+                return
+            now = time.monotonic()
+            if self._primary_down_since is None:
+                self._primary_down_since = now
+                return
+            if now - self._primary_down_since < self.failover_after_s:
+                return
+            if self._failover_inflight:
+                return
+            self._failover_inflight = True
+            self._primary_down_since = None
+
+        def work():
+            try:
+                result = self.promote(trigger="auto")
+                if result["status"] != 200:
+                    # Nothing promotable yet; the next poll re-arms the
+                    # clock.
+                    obs.counter_add("knn_fleet_failovers_total",
+                                    help="promotions the router drove, "
+                                         "by trigger",
+                                    trigger="auto_failed")
+            finally:
+                with self._failover_lock:
+                    self._failover_inflight = False
+
+        threading.Thread(target=work, daemon=True,
+                         name="knn-fleet-failover").start()
+
+    # -- export ------------------------------------------------------------
+
+    def health(self) -> dict:
+        export = self.set.export()
+        return {
+            "ready": export["usable"] > 0,
+            "uptime_s": round(time.time() - self.started_unix, 1),
+            "primary": export["primary"],
+            "split_brain": export["split_brain"],
+            "lag": export["lag"],
+            "usable": export["usable"],
+            "replicas": export["replicas"],
+            "hedge": ("off" if self.hedge is None else
+                      ("auto" if self.hedge == 0 else f"{self.hedge}ms")),
+            "auto_failover": self.auto_failover,
+            "failovers": self.failovers,
+            "reloads": self.reloads,
+            "confirmed_index": self._confirmed_index,
+        }
+
+
+def _json_body(doc: dict) -> bytes:
+    return (json.dumps(doc) + "\n").encode()
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "knn-tpu-route/1"
+    protocol_version = "HTTP/1.1"
+    timeout = 60
+
+    @property
+    def app(self) -> RouterApp:
+        return self.server.app  # type: ignore[attr-defined]
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        pass  # /metrics is the log (the serve handler's rule)
+
+    def _send_raw(self, status: int, raw: bytes,
+                  content_type="application/json"):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        rid = getattr(self, "_rid", None)
+        if rid is not None:
+            self.send_header("x-request-id", rid)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _send(self, status: int, payload: dict):
+        self._send_raw(status, _json_body(payload))
+
+    def _begin(self) -> bool:
+        raw = self.headers.get("x-request-id")
+        if raw is None:
+            self._rid = reqtrace.gen_request_id()
+            return True
+        raw = raw.strip()
+        if not reqtrace.valid_request_id(raw):
+            self._rid = reqtrace.gen_request_id()
+            self.close_connection = True
+            self._send(400, {"error": "invalid x-request-id header"})
+            return False
+        self._rid = raw
+        return True
+
+    def _read_body(self):
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return None, "a body with Content-Length is required"
+        if length > MAX_BODY_BYTES:
+            return None, (f"body {length} B exceeds the "
+                          f"{MAX_BODY_BYTES} B bound")
+        return (self.rfile.read(length) if length > 0 else b""), None
+
+    def do_GET(self):  # noqa: N802 — stdlib dispatch name
+        if not self._begin():
+            return
+        route = urlparse(self.path).path
+        if route == "/healthz":
+            h = self.app.health()
+            self._send(200 if h["ready"] else 503, h)
+        elif route == "/debug/fleet":
+            self._send(200, self.app.health())
+        elif route == "/metrics":
+            self._send_raw(200, obs.registry().to_prometheus().encode(),
+                           "text/plain; version=0.0.4")
+        else:
+            self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def do_POST(self):  # noqa: N802 — stdlib dispatch name
+        if not self._begin():
+            return
+        route = urlparse(self.path).path
+        body, err = self._read_body()
+        if err is not None:
+            self.close_connection = True
+            self._send(413 if "exceeds" in err else 400, {"error": err})
+            return
+        headers = {"Content-Type": "application/json",
+                   "x-request-id": self._rid}
+        cls = self.headers.get("x-knn-class")
+        if cls is not None:
+            headers["x-knn-class"] = cls
+        try:
+            if route in ("/predict", "/kneighbors"):
+                status, raw, replica = self.app.forward_read(
+                    route, body, headers)
+                self._note(route, status, replica)
+                self._send_raw(status, raw)
+            elif route in ("/insert", "/delete"):
+                status, raw, replica = self.app.forward_write(
+                    route, body, headers)
+                self._note(route, status, replica)
+                self._send_raw(status, raw)
+            elif route == "/admin/promote":
+                self._do_admin(body, self._admin_promote)
+            elif route == "/admin/reload":
+                self._do_admin(body, self._admin_reload)
+            elif route == "/admin/compact":
+                self._do_admin(body, self._admin_compact)
+            else:
+                self.close_connection = True
+                self._send(404, {"error": f"no such endpoint: "
+                                          f"{self.path}"})
+        except Exception as e:  # noqa: BLE001 — the router's last line:
+            # typed JSON for EVERY terminal outcome, never a traceback.
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _note(self, route: str, status: int, replica) -> None:
+        obs.counter_add(
+            "knn_fleet_router_requests_total",
+            help="client requests answered by the router, by endpoint "
+                 "and status",
+            endpoint=route, status=str(status),
+        )
+
+    def _do_admin(self, body: bytes, fn) -> None:
+        try:
+            doc = json.loads(body) if body else {}
+            if not isinstance(doc, dict):
+                raise ValueError("the request body must be a JSON object")
+        except ValueError as e:
+            self._send(400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            result = fn(doc)
+        except RouterBusy as e:
+            self._send(409, {"error": str(e)})
+            return
+        self._send(result["status"], result["body"])
+
+    def _admin_promote(self, doc: dict) -> dict:
+        return self.app.promote(doc.get("replica"), trigger="manual")
+
+    def _admin_reload(self, doc: dict) -> dict:
+        return self.app.coordinated_reload(doc.get("index"),
+                                           doc.get("rollback_to"))
+
+    def _admin_compact(self, doc: dict) -> dict:
+        return self.app.coordinated_compact(doc.get("replica"))
+
+
+class RouterServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, address, app: RouterApp):
+        super().__init__(address, _RouterHandler)
+        self.app = app
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return
+        super().handle_error(request, client_address)
+
+
+def make_router_server(app: RouterApp, host: str = "127.0.0.1",
+                       port: int = 0) -> RouterServer:
+    return RouterServer((host, port), app)
+
+
+def router_forever(server: RouterServer, *, banner=None) -> int:
+    """Run until SIGINT/SIGTERM. The router holds no in-flight state
+    worth draining (every request is a synchronous forward on its own
+    handler thread), so both signals stop it the simple way."""
+    import signal
+
+    def on_stop(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    previous = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            previous[sig] = signal.signal(sig, on_stop)
+        except ValueError:
+            pass  # not the main thread (embedded use)
+    if banner:
+        print(banner, flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+        server.server_close()
+        server.app.close()
+    return 0

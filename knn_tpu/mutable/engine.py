@@ -28,6 +28,8 @@ mid-compaction and requires zero acknowledged writes lost.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import threading
 import time
 from typing import Optional
@@ -38,6 +40,8 @@ from knn_tpu import obs
 from knn_tpu.mutable.state import (
     MutableView,
     MutationConflict,
+    ReplicationGap,
+    WALDivergence,
     check_stable_ascending,
     stable_to_position,
     validate_insert,
@@ -51,6 +55,46 @@ FRESHNESS_BUCKETS_MS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
 
 #: Initial delta allocation; grows by amortized doubling up to the cap.
 _INITIAL_SLOTS = 64
+
+#: Content digests kept per applied WAL record for the replication
+#: overlap check (fleet/replica.py): enough to cover any realistic
+#: shipping window; older seqs fall back to skip-without-check (they are
+#: either folded into a generation or far behind every live cursor).
+_DIGEST_KEEP = 8192
+
+
+def wal_record_digest(rec: dict) -> str:
+    """Canonical content digest of one WAL record — what the WAL fan-out
+    protocol uses to prove that two logs agree about a sequence number
+    (``POST /admin/wal-append`` overlap checks). Excludes any ``digest``
+    field so a record round-trips."""
+    body = {k: v for k, v in rec.items() if k != "digest"}
+    payload = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def truncate_wal(root, cap_seq: int) -> int:
+    """Drop every epoch-log record with ``seq > cap_seq`` (atomic rewrite
+    per file, empty epochs removed) and return how many records were
+    dropped. The rejoin primitive: a rebooted ex-primary's WAL tail past
+    the promoted follower's takeover point is UNACKNOWLEDGED by
+    construction (a write is only acked once a follower holds it), and
+    under the new primary those seqs name different mutations — replaying
+    the stale tail before following would be silent divergence."""
+    dropped = 0
+    for _n, path in artifact.list_epochs(root):
+        records, _torn = artifact.read_epoch_records(path,
+                                                     tolerate_torn=True)
+        keep = [r for r in records if int(r.get("seq", 0)) <= cap_seq]
+        if len(keep) == len(records):
+            continue
+        dropped += len(records) - len(keep)
+        if keep:
+            artifact.repair_epoch(path, keep)
+        else:
+            path.unlink()
+    return dropped
+
 
 #: ``device_tail="auto"`` activates the device-resident delta buffer
 #: (``mutable/device_tail.py``) once this many delta slots are in use —
@@ -134,6 +178,10 @@ class MutableEngine:
         self._fresh = _Freshness()
         self._last_compaction: Optional[dict] = None
         self._on_pressure = None  # Compactor.kick, wired after build
+        self._on_applied = None  # fleet shipper kick, wired after build
+        # seq -> content digest for the replication overlap check
+        # (wal_record_digest); bounded, pruned oldest-first.
+        self._digests: "dict[int, str]" = {}
 
         base = Path(base_dir) if base_dir is not None else self.root
         block, stable = artifact.read_mutable_block(base)
@@ -207,6 +255,18 @@ class MutableEngine:
                         f"({seq} after {self._seq}); the write-ahead log "
                         f"is corrupt"
                     )
+                if seq != self._seq + 1:
+                    # A HOLE in the acknowledged history: every record was
+                    # acked durable in seq order, so a missing seq means
+                    # lost writes — replaying past it would silently serve
+                    # a history that never happened (the primary-failover
+                    # catch-up path depends on this being typed, never a
+                    # skip).
+                    raise DataError(
+                        f"{path}: epoch stream has a seq gap (expected "
+                        f"{self._seq + 1}, found {seq}); the write-ahead "
+                        f"log lost acknowledged records"
+                    )
                 self._replay_one(rec, path)
             if torn:
                 print(f"warning: {path}: dropped a torn final record "
@@ -241,9 +301,21 @@ class MutableEngine:
                 f"{path}: unreplayable epoch record (seq "
                 f"{rec.get('seq')}): {e}") from e
         self._seq = int(rec["seq"])
+        self._note_digest(self._seq, rec)
         self._next_stable = max(self._next_stable,
                                 int(self._stable[:self._count].max(
                                     initial=-1)) + 1)
+
+    def _note_digest(self, seq: int, rec: dict) -> None:
+        """Record ``seq``'s content digest for the replication overlap
+        check (caller holds the lock or is __init__); bounded by
+        ``_DIGEST_KEEP`` — seqs that age out fall back to
+        skip-without-check on overlap."""
+        self._digests[seq] = wal_record_digest(rec)
+        while len(self._digests) > _DIGEST_KEEP:
+            # Seqs insert strictly ascending, so dict order IS seq
+            # order: the first key is the oldest (O(1), not a key scan).
+            self._digests.pop(next(iter(self._digests)))
 
     # -- shared state primitives (caller holds self._lock or is __init__) --
 
@@ -387,13 +459,15 @@ class MutableEngine:
                 )
             seq = self._seq + 1
             sid0 = self._next_stable
-            self._log.append({
+            rec = {
                 "seq": seq, "op": "insert", "sid0": sid0,
                 "rows": [[float(v) for v in r] for r in rows],
                 "values": [float(v) for v in values],
-            })
+            }
+            self._log.append(rec)
             ids = self._append_rows(rows, values, sid0)
             self._seq = seq
+            self._note_digest(seq, rec)
             self._next_stable = sid0 + rows.shape[0]
             epoch = self._epoch
             # The version is stamped HERE, under the lock the rebase
@@ -406,6 +480,7 @@ class MutableEngine:
         self._note_visible(submitted_ns)
         self._note_mutation("insert", "ok", rows.shape[0])
         self._maybe_kick(pressure)
+        self._notify_applied()
         return {"op": "insert", "ids": ids, "rows": rows.shape[0],
                 "seq": seq, "epoch": epoch, "index_version": version}
 
@@ -463,17 +538,168 @@ class MutableEngine:
                 self._note_mutation("delete", "rejected")
                 raise
             seq = self._seq + 1
-            self._log.append({"seq": seq, "op": "delete", "sids": sids})
+            rec = {"seq": seq, "op": "delete", "sids": sids}
+            self._log.append(rec)
             self._tombstone_stables(sids, where="delete")
             self._seq = seq
+            self._note_digest(seq, rec)
             epoch = self._epoch
             version = self._version  # same-lock pairing as apply_insert
             pressure = self.pressure()
         self._note_visible(submitted_ns)
         self._note_mutation("delete", "ok", len(ids))
         self._maybe_kick(pressure)
+        self._notify_applied()
         return {"op": "delete", "deleted": len(ids), "seq": seq,
                 "epoch": epoch, "index_version": version}
+
+    # -- replication (fleet/replica.py, docs/SERVING.md §Replica sets) -----
+
+    def apply_replicated(self, rec: dict) -> dict:
+        """Apply ONE primary-shipped WAL record through the exact same
+        validation path local mutations take — a divergent record (wrong
+        width, unknown label, impossible delete) is a typed refusal, never
+        silent corruption.
+
+        Contract (what primary-failover catch-up depends on):
+
+        - ``seq == applied + 1`` → validate, append to THIS replica's own
+          WAL (flushed — a promoted follower must be able to re-ship and
+          to survive its own reboot), apply, return ``applied: True``;
+        - ``seq <= applied`` → **idempotent no-op** (the primary re-ships
+          from a conservative cursor after a resync) — but only after the
+          content digest matches the record already applied at that seq;
+          a mismatch raises :class:`WALDivergence` (the two logs disagree
+          about history — re-seed, don't retry);
+        - ``seq > applied + 1`` → :class:`ReplicationGap` carrying
+          ``applied_seq`` so the shipper resets its cursor (never a
+          silent skip).
+        """
+        try:
+            seq = int(rec["seq"])
+            op = rec["op"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise DataError(f"unreplayable WAL record: {e}") from e
+        with self._lock:
+            if self._closed:
+                raise OverloadError("mutable engine is shut down")
+            if seq <= self._seq:
+                known = self._digests.get(seq)
+                shipped = wal_record_digest(rec)
+                if known is not None and known != shipped:
+                    raise WALDivergence(
+                        f"seq {seq} is already applied with digest "
+                        f"{known} but the primary shipped {shipped} — "
+                        f"this replica's log has diverged from the "
+                        f"primary's; re-seed it from a fresh copy"
+                    )
+                return {"applied": False, "seq": self._seq}
+            if seq != self._seq + 1:
+                raise ReplicationGap(
+                    f"record seq {seq} skips past the next expected "
+                    f"{self._seq + 1}; re-ship from {self._seq}",
+                    applied_seq=self._seq,
+                )
+            if op == "insert":
+                # The full local-insert validation (width, finiteness,
+                # label range) — the "divergent record is a typed
+                # refusal" half of the fan-out contract.
+                rows, values = validate_insert(
+                    self._model, rec["rows"], rec.get("values"))
+                sid0 = int(rec["sid0"])
+                clean = {"seq": seq, "op": "insert", "sid0": sid0,
+                         "rows": [[float(v) for v in r] for r in rows],
+                         "values": [float(v) for v in values]}
+                self._log.append(clean)
+                self._append_rows(rows, values, sid0, enforce_cap=False)
+                self._next_stable = max(self._next_stable,
+                                        sid0 + rows.shape[0])
+            elif op == "delete":
+                sids = [int(s) for s in rec["sids"]]
+                clean = {"seq": seq, "op": "delete", "sids": sids}
+                # Validate BEFORE the WAL append (the apply_delete
+                # discipline: a refused record must leave this replica's
+                # log untouched).
+                self._validate_tombstones(sids, where="wal-append")
+                self._log.append(clean)
+                self._tombstone_stables(sids, where="wal-append")
+            else:
+                raise DataError(f"unknown op {op!r} in replicated record "
+                                f"seq {seq}")
+            self._seq = seq
+            self._note_digest(seq, clean)
+            pressure = self.pressure()
+        self._note_mutation(op, "replicated",
+                            len(clean.get("rows", clean.get("sids", [0]))))
+        self._maybe_kick(pressure)
+        self._notify_applied()
+        return {"applied": True, "seq": seq}
+
+    def records_since(self, after_seq: int,
+                      limit: int = 512) -> "tuple[list[dict], int]":
+        """WAL records with ``seq > after_seq`` (ascending, at most
+        ``limit``), each stamped with its content ``digest`` — the
+        shipping source for the primary's fan-out and for rejoin
+        catch-up. Reads the epoch files directly (the appender flushes
+        whole lines, and a torn tail is by definition un-acked — skipped
+        this round, shipped the next). Raises a typed :class:`DataError`
+        when ``after_seq`` predates the fold point: those records are
+        compacted into a base generation and their epochs pruned, so
+        that follower cannot catch up from the WAL and must re-seed from
+        a copy of the artifact directory. A file vanishing MID-scan
+        (the compactor's epoch pruning is not coordinated with this
+        lock-free read) is a transient race, re-scanned — and surfaced
+        as a plain ``OSError`` (retry later, NOT the terminal re-seed
+        state) if it somehow persists."""
+        for _attempt in range(3):
+            with self._lock:
+                folded = self._folded_seq
+                own_seq = self._seq
+            if after_seq < folded:
+                raise DataError(
+                    f"cursor seq {after_seq} predates the fold point "
+                    f"{folded}: those records are compacted into a base "
+                    f"generation and their epochs pruned — re-seed the "
+                    f"follower from a copy of the artifact directory"
+                )
+            out: "list[dict]" = []
+            try:
+                epochs = artifact.list_epochs(self.root)
+                last = epochs[-1][0] if epochs else None
+                for n, path in epochs:
+                    if len(out) >= limit:
+                        break
+                    records, _torn = artifact.read_epoch_records(
+                        path, tolerate_torn=(n == last))
+                    for rec in records:
+                        if (int(rec["seq"]) > after_seq
+                                and len(out) < limit):
+                            out.append({**rec,
+                                        "digest": wal_record_digest(rec)})
+            except DataError as e:
+                if isinstance(e.__cause__, FileNotFoundError):
+                    continue  # pruned mid-scan; re-list and re-read
+                raise
+            out.sort(key=lambda r: int(r["seq"]))
+            return out, own_seq
+        raise OSError(
+            "epoch files kept vanishing mid-scan (compaction churn); "
+            "transient — retry the shipment"
+        )
+
+    def on_applied(self, cb) -> None:
+        """Register the fan-out kick: called (outside the lock) after
+        every applied mutation so the WAL shippers wake immediately
+        instead of on their poll interval."""
+        self._on_applied = cb
+
+    def _notify_applied(self) -> None:
+        cb = self._on_applied
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — shipping nudge only
+                pass
 
     # -- read side ---------------------------------------------------------
 
@@ -488,6 +714,21 @@ class MutableEngine:
                 device=(self._dtail.view() if self._dtail is not None
                         else None),
             )
+
+    @property
+    def seq(self) -> int:
+        """The last applied mutation sequence number (the replication
+        cursor's anchor; /healthz ``fleet.applied_seq``)."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def folded_seq(self) -> int:
+        """The fold point: records at or below it live only in compacted
+        base generations (their epochs are pruned) — the lowest seq a
+        WAL shipper's cursor can meaningfully start from."""
+        with self._lock:
+            return self._folded_seq
 
     def pressure(self) -> int:
         """Mutations awaiting compaction: delta slots in use plus live

@@ -59,6 +59,28 @@ class MutationConflict(DataError):
     will keep failing; the client must re-read state first."""
 
 
+class ReplicationGap(DataError):
+    """A replicated WAL record arrived whose ``seq`` skips past the next
+    expected one: applying it would silently drop the missing mutations,
+    so the follower refuses typed and reports the seq it HAS applied —
+    the primary's shipper resets its cursor there and re-ships the gap
+    (``POST /admin/wal-append`` maps this to **409**)."""
+
+    def __init__(self, message: str, *, applied_seq: int):
+        super().__init__(message)
+        self.applied_seq = applied_seq
+
+
+class WALDivergence(DataError):
+    """A replicated record's ``seq`` overlaps history this replica
+    already holds, but its content digest differs — the two write-ahead
+    logs tell different stories for the same sequence number (the
+    rebooted-ex-primary hazard: an unacknowledged tail applied locally
+    before the crash, while the promoted follower assigned those seqs to
+    NEW writes). Applying or skipping would be silent corruption; the
+    replica must be re-seeded (**409**, never retried)."""
+
+
 class MutableView(NamedTuple):
     """One immutable snapshot of the mutable tier, taken per dispatch.
 

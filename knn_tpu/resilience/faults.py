@@ -83,6 +83,15 @@ FAULT_POINTS: Dict[str, str] = {
     # compaction leaves the old generation serving with zero
     # acknowledged writes lost.
     "mutable.compact": "device",
+    # One router->replica HTTP forward (knn_tpu/fleet/router.py): a
+    # fired fault stands in for the wire failing mid-request — reads
+    # must retry on a DIFFERENT replica, writes must refuse typed
+    # (indeterminate outcomes are never blindly re-sent).
+    "fleet.forward": "io",
+    # One primary->follower WAL shipment (knn_tpu/fleet/replica.py):
+    # the shipper must back off and re-ship without losing its cursor —
+    # follower lag grows, then drains, and no record is skipped.
+    "fleet.wal_ship": "io",
 }
 
 _KINDS = ("data", "compile", "device", "collective", "worker", "io", "oom")

@@ -79,9 +79,11 @@ draining — the load balancer's cue to route away, not retry here),
 the error class name in the body. Always a JSON body, never a traceback.
 
 Signals (the ops runbook, docs/SERVING.md): **SIGTERM** = graceful drain
-(healthz flips to 503 ``draining``, new admissions refused typed,
-in-flight answered within ``--drain-timeout-s``, remainders failed 504,
-exit 0); **SIGINT** = fast clean stop; **SIGHUP** = hot reload.
+(the LISTENER closes first — new connects are refused at the TCP layer,
+so a fleet router demotes this replica immediately — then healthz flips
+to 503 ``draining``, in-flight answered within ``--drain-timeout-s``,
+remainders failed 504, exit 0); **SIGINT** = fast clean stop;
+**SIGHUP** = hot reload.
 """
 
 from __future__ import annotations
@@ -182,7 +184,10 @@ class ServeApp:
                  capture_burn_threshold: Optional[float] = None,
                  capture_burn_objective: str = "availability",
                  capture_burn_window_s: float = 60.0,
-                 batch_buckets=None, result_cache_rows: int = 0):
+                 batch_buckets=None, result_cache_rows: int = 0,
+                 follower_of: Optional[str] = None,
+                 replicate_to=None, replicate_ack: str = "any",
+                 replicate_ack_timeout_s: float = 5.0):
         self._previous_buckets = None
         self._installed_buckets = False
         if batch_buckets is not None:
@@ -317,6 +322,36 @@ class ServeApp:
             )
         else:
             self.mutable = None
+        # Fleet replication (knn_tpu/fleet/, docs/SERVING.md §Running a
+        # replica set): --follower-of makes this process a read-only
+        # follower applying primary-shipped WAL records; --replicate-to
+        # makes it the primary fanning its WAL out. Neither (the default)
+        # constructs NOTHING — no fleet import, no shipper threads, no
+        # knn_fleet_* instruments (scripts/check_disabled_overhead.py).
+        if follower_of is not None or replicate_to:
+            if follower_of is not None and replicate_to:
+                raise DataError(
+                    "--follower-of and --replicate-to are contradictory: "
+                    "a replica is born EITHER the primary or a follower "
+                    "(promotion flips the role later)"
+                )
+            if self.mutable is None:
+                raise DataError(
+                    "fleet replication ships the mutable tier's "
+                    "write-ahead log; boot with --mutable on"
+                )
+            from knn_tpu.fleet.replica import FleetReplica
+
+            self.fleet = FleetReplica(
+                self.mutable,
+                role="follower" if follower_of is not None else "primary",
+                primary_url=follower_of,
+                replicate_to=tuple(replicate_to or ()),
+                ack_mode=replicate_ack,
+                ack_timeout_s=replicate_ack_timeout_s,
+            )
+        else:
+            self.fleet = None
         # Workload capture (obs/workload.py, docs/OBSERVABILITY.md
         # §Workload capture & replay): --capture-dir opts in to the
         # replayable traffic recorder — windows armed by POST
@@ -657,6 +692,9 @@ class ServeApp:
             # Finalizes any still-armed window first: an incident capture
             # must survive the shutdown the incident may have caused.
             self.workload.close()
+        if self.fleet is not None:
+            # Before the engine: shippers read the WAL the engine owns.
+            self.fleet.close()
         if self.mutable is not None:
             self.mutable.close()
         if self.quality is not None:
@@ -716,6 +754,12 @@ class ServeApp:
             # state — while --capture-dir is unset.
             "workload": (self.workload.export()
                          if self.workload is not None else None),
+            # The replication role (knn_tpu/fleet/replica.py): role,
+            # applied_seq, follower cursors/lag on a primary, the
+            # takeover point after a promotion. None — the distinct
+            # "fleet: absent" state — for a plain single-process serve.
+            "fleet": (self.fleet.export()
+                      if self.fleet is not None else None),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
@@ -869,6 +913,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_capture_status()
         elif route == "/debug/profile":
             self._do_profile()
+        elif route == "/admin/wal-since":
+            self._do_wal_since()
         else:
             self._send(404, {"error": f"no such endpoint: {self.path}"})
 
@@ -1070,6 +1116,12 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/admin/capture":
             self._do_capture_admin()
             return
+        if self.path == "/admin/wal-append":
+            self._do_wal_append()
+            return
+        if self.path == "/admin/promote":
+            self._do_promote()
+            return
         if self.path in ("/insert", "/delete"):
             with self.app.track_request():
                 self._do_mutation(self.path[1:])
@@ -1099,6 +1151,20 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
             self._send(404, {"error": "mutable serving is off — boot "
                                       "with `serve INDEX --mutable on`"})
+            return
+        if (self.app.fleet is not None
+                and self.app.fleet.role == "follower"):
+            # Read-only replica: the ONE primary owns the write order (a
+            # second writer would fork the WAL). 409, not 5xx — the
+            # request is well-formed, this replica just refuses it; the
+            # router never sends writes here, so seeing this means a
+            # client bypassed the router.
+            self.close_connection = True
+            primary = self.app.fleet.primary_url or "the router"
+            self._send(409, {
+                "error": f"this replica is a read-only follower; send "
+                         f"writes to the primary ({primary})",
+            })
             return
         body, err, status = self._read_json_body(required=True)
         if err is not None:
@@ -1142,7 +1208,151 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 — typed JSON, never a
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
             return
+        fleet = self.app.fleet
+        if fleet is not None and not fleet.wait_replicated(value["seq"]):
+            # Applied + durable LOCALLY, but no follower confirmed it
+            # inside the ack window: claiming success would promise a
+            # durability this moment cannot promise (a primary loss now
+            # would lose the write at promote). 503 with applied=true is
+            # the honest typed outcome — the caller must NOT blindly
+            # re-send (that would duplicate the mutation) and the router
+            # never retries a write that reached the wire.
+            self._send(503, {
+                "error": f"replication ack timeout: seq {value['seq']} "
+                         f"is applied and WAL-durable on this primary "
+                         f"but no follower confirmed it within "
+                         f"{fleet.ack_timeout_s:.1f} s — do not re-send; "
+                         f"re-read after the fleet recovers",
+                "applied": True, "seq": value["seq"],
+                "index_version": value.get("index_version"),
+            })
+            return
         self._send(200, value)
+
+    # -- fleet replication (knn_tpu/fleet/, docs/SERVING.md) ---------------
+
+    def _do_wal_append(self):
+        """``POST /admin/wal-append`` body ``{"records": [...],
+        "primary_seq": N}``: apply one primary-shipped WAL batch through
+        the engine's full validation path. Typed contract: 404 while no
+        fleet role exists, 409 on the primary (split-brain refusal), 409
+        with ``applied_seq`` on a seq gap (the shipper's resync cue), 409
+        with ``diverged: true`` when the logs disagree about an
+        already-applied seq, 400 for malformed records — never a
+        traceback, never a silent skip."""
+        if self.app.fleet is None:
+            self.close_connection = True
+            self._send(404, {"error": "fleet replication is off — boot "
+                                      "with `serve INDEX --mutable on "
+                                      "--follower-of PRIMARY_URL`"})
+            return
+        body, err, status = self._read_json_body(required=True)
+        if err is not None:
+            self.close_connection = True
+            self._send(status, {"error": err})
+            return
+        from knn_tpu.mutable.state import (
+            MutationConflict,
+            ReplicationGap,
+            WALDivergence,
+        )
+
+        try:
+            result = self.app.fleet.apply_wal_records(
+                body.get("records"), body.get("primary_seq"))
+        except ReplicationGap as e:
+            self._send(409, {"error": str(e),
+                             "applied_seq": e.applied_seq})
+            return
+        except WALDivergence as e:
+            self._send(409, {"error": str(e), "diverged": True})
+            return
+        except MutationConflict as e:
+            # A shipped record this state refuses (e.g. an impossible
+            # delete): divergence in content, not in seq — terminal for
+            # the shipper too.
+            self._send(409, {"error": str(e), "diverged": True})
+            return
+        except OverloadError as e:
+            self._send(503, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": f"bad wal-append body: {e}"})
+            return
+        except Exception as e:  # noqa: BLE001 — typed JSON, never a
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, result)
+
+    def _do_promote(self):
+        """``POST /admin/promote`` body ``{}`` or ``{"replicate_to":
+        [URL, ...]}``: flip this follower to primary in place (the
+        failover step — the router or the operator calls it on the
+        most-caught-up follower after a primary loss). 404 while no
+        fleet role, 409 when already primary."""
+        if self.app.fleet is None:
+            self.close_connection = True
+            self._send(404, {"error": "fleet replication is off — this "
+                                      "process has no role to promote"})
+            return
+        body, err, status = self._read_json_body(required=False)
+        if err is not None:
+            self.close_connection = True
+            self._send(status, {"error": err})
+            return
+        from knn_tpu.mutable.state import MutationConflict
+
+        urls = body.get("replicate_to") or []
+        if not isinstance(urls, list) or not all(
+                isinstance(u, str) for u in urls):
+            self._send(400, {"error": '"replicate_to" must be a list of '
+                                      'base URLs'})
+            return
+        try:
+            result = self.app.fleet.promote(urls)
+        except MutationConflict as e:
+            self._send(409, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — typed JSON, never a
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, result)
+
+    def _do_wal_since(self):
+        """``GET /admin/wal-since?seq=N[&limit=M]``: this replica's WAL
+        records newer than ``seq``, digest-stamped — the rejoin/catch-up
+        export (any mutable replica can serve its own log). 404 while
+        ``--mutable off``; 409 typed when ``seq`` predates the fold
+        point (those records are compacted away — re-seed instead)."""
+        if self.app.mutable is None:
+            self._send(404, {"error": "mutable serving is off — there is "
+                                      "no write-ahead log to export"})
+            return
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            seq = int(q.get("seq", ["0"])[0])
+            limit = int(q.get("limit", ["512"])[0])
+            if limit < 1:
+                raise ValueError
+        except ValueError:
+            self._send(400, {"error": f"bad seq/limit query: want "
+                                      f"integers, got {self.path!r}"})
+            return
+        try:
+            records, own_seq = self.app.mutable.records_since(
+                seq, limit=limit)
+        except DataError as e:
+            self._send(409, {"error": str(e)})
+            return
+        except OSError as e:
+            # Transient epoch churn (compaction pruning raced the scan
+            # past its re-read budget): retry later, NOT the re-seed
+            # refusal — and always typed JSON, never a traceback.
+            self._send(503, {"error": f"WAL scan raced compaction "
+                                      f"pruning; retry: {e}"})
+            return
+        self._send(200, {"records": records, "seq": own_seq},
+                   tag_request_id=False)
 
     def _do_compact(self):
         """``POST /admin/compact``: fold the delta tier + tombstones into
@@ -1465,6 +1675,7 @@ class KNNServer(ThreadingHTTPServer):
     def __init__(self, address, app: ServeApp):
         super().__init__(address, _Handler)
         self.app = app
+        self._stopper = None  # the SIGTERM drain thread, when one runs
 
     def handle_error(self, request, client_address):
         import sys
@@ -1479,6 +1690,23 @@ def make_server(app: ServeApp, host: str = "127.0.0.1",
                 port: int = 0) -> KNNServer:
     """Bind (port 0 → ephemeral; read ``server.server_address``)."""
     return KNNServer((host, port), app)
+
+
+def drain_and_stop(server: KNNServer, drain_timeout_s: float) -> dict:
+    """The SIGTERM sequence, ordered so a peer's connection-refused
+    demotion (the fleet router's passive health signal) fires
+    IMMEDIATELY: (1) stop the accept loop, (2) close the LISTENING
+    socket — from this instant a new connect is refused at the TCP
+    layer — and only THEN (3) flip healthz to draining and answer every
+    in-flight request. The old order (flip healthz first, close the
+    listener at exit) left a window where a connection accepted between
+    the 503 flip and the close raced the shutdown and died untracked.
+    In-flight connections ride their own sockets and handler threads, so
+    closing the listener cuts off nothing that was admitted.
+    tests/test_serve.py pins the ordering."""
+    server.shutdown()
+    server.server_close()
+    return server.app.drain(drain_timeout_s)
 
 
 def serve_forever(server: KNNServer, *, banner=None,
@@ -1503,15 +1731,19 @@ def serve_forever(server: KNNServer, *, banner=None,
 
     def on_sigterm(signum, frame):
         def drain_then_stop():
-            summary = server.app.drain(drain_timeout_s)
+            summary = drain_and_stop(server, drain_timeout_s)
             print(f"knn-tpu serve: drained "
                   f"(clean={summary['drained_clean']}, "
                   f"expired={summary['expired']}, "
                   f"{summary['ms']:.0f} ms); shutting down",
                   file=sys.stderr, flush=True)
-            server.shutdown()
 
-        threading.Thread(target=drain_then_stop, daemon=True).start()
+        t = threading.Thread(target=drain_then_stop, daemon=True)
+        # Registered BEFORE start: serve_forever's finally must never
+        # observe a started-but-unregistered drain and close the app
+        # under it.
+        server._stopper = t
+        t.start()
 
     def on_sighup(signum, frame):
         def work():
@@ -1558,6 +1790,12 @@ def serve_forever(server: KNNServer, *, banner=None,
     finally:
         for sig, handler in previous.items():
             signal.signal(sig, handler)
+        # SIGTERM path: the drain thread owns the shutdown sequence
+        # (listener already closed); wait for it to finish answering
+        # in-flight requests before tearing the app down under them.
+        stopper = getattr(server, "_stopper", None)
+        if stopper is not None and stopper.is_alive():
+            stopper.join(timeout=drain_timeout_s + 5.0)
         server.server_close()
         server.app.close()
     return 0

@@ -60,6 +60,9 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+import procgroup  # noqa: E402 — scripts-dir sibling (process-group
+# spawn + atexit kill sweep: a failed assertion can never strand a server)
+
 READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
 BOOT_TIMEOUT_S = 180
 
@@ -124,7 +127,7 @@ def http(base: str, path: str, payload_bytes=None, headers=None,
 
 
 def boot(index: str, env: dict, extra_flags):
-    proc = subprocess.Popen(
+    proc = procgroup.popen_group(
         [sys.executable, "-m", "knn_tpu.cli", "serve", index,
          "--port", "0", *extra_flags],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

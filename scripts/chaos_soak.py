@@ -58,6 +58,9 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+import procgroup  # noqa: E402 — scripts-dir sibling (process-group
+# spawn + atexit kill sweep: a failed assertion can never strand a server)
+
 READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
 BOOT_TIMEOUT_S = 120  # first-call compile on a cold cache can be slow
 TRACEBACK_MARKER = "Traceback (most recent call last)"
@@ -260,7 +263,7 @@ def main() -> int:
         print(f"chaos-soak: fault plan {fault_plan} (seed {args.seed}), "
               f"{args.clients} clients, {args.window_s:.0f} s window")
 
-        proc = subprocess.Popen(
+        proc = procgroup.popen_group(
             [sys.executable, "-m", "knn_tpu.cli", "serve", index,
              "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
              "--drain-timeout-s", str(args.drain_timeout_s),

@@ -141,6 +141,22 @@ def main() -> int:
                             f"mutable-off serving — the device-IVF/"
                             f"delta-tail machinery must not construct "
                             f"while disabled")
+        # Fleet replication (PR 15): plain single-process serving (no
+        # --follower-of, no --replicate-to, no router) must construct
+        # ZERO fleet machinery — no FleetReplica, no WAL shippers, no
+        # router imports; the whole knn_tpu.fleet package is a lazy
+        # import only the opted-in paths pull in.
+        if app.fleet is not None:
+            return fail("ServeApp built a fleet role with no "
+                        "--follower-of/--replicate-to — the layer must "
+                        "not exist while disabled")
+        for mod in ("knn_tpu.fleet", "knn_tpu.fleet.replica",
+                    "knn_tpu.fleet.router", "knn_tpu.fleet.health",
+                    "knn_tpu.fleet.wire"):
+            if mod in sys.modules:
+                return fail(f"{mod} imported during plain single-process "
+                            f"serving — fleet machinery must not "
+                            f"construct while disabled")
         # Workload capture (PR 11): the default (no --capture-dir /
         # ServeApp's capture_dir=None) must construct NOTHING — no
         # recorder, no sample queue, no consumer thread, no
@@ -194,7 +210,8 @@ def main() -> int:
                     "cache disabled")
     bad_threads = [t.name for t in threading.enumerate()
                    if t.name.startswith(("knn-quality", "knn-drift",
-                                         "knn-compactor", "knn-workload"))]
+                                         "knn-compactor", "knn-workload",
+                                         "knn-fleet"))]
     if bad_threads:
         return fail(f"quality/drift/compactor/workload worker thread(s) "
                     f"alive while disabled: {bad_threads}")
@@ -202,7 +219,8 @@ def main() -> int:
               if i.name.startswith(("knn_quality_", "knn_drift_",
                                     "knn_cost_", "knn_capacity_",
                                     "knn_ivf_", "knn_mutable_",
-                                    "knn_workload_", "knn_cache_"))]
+                                    "knn_workload_", "knn_cache_",
+                                    "knn_fleet_"))]
     if leaked:
         return fail(f"quality/drift/cost/capacity/ivf/mutable/workload "
                     f"instrument(s) recorded while disabled: {leaked}")

@@ -1,0 +1,676 @@
+"""Fleet-soak gate (`make fleet-soak`): replica sets held to their
+contracts (docs/SERVING.md §Running a replica set).
+
+Topology under test: 3 `knn_tpu serve --mutable on` replicas (1 primary
+with ``--replicate-to``, 2 followers with ``--follower-of``) behind a
+`knn_tpu route` router with auto-failover armed — every client request
+in this gate goes through the ROUTER, exactly as production traffic
+would.
+
+**Phase 1 — follower SIGKILL under load.** Concurrent readers + writers
+through the router; a follower's process GROUP is SIGKILLed mid-window.
+Invariants: ZERO failed reads (the router retries transport failures on
+a different replica), every read bit-identical to the oracle replay of
+the primary's durable WAL at that read's ``mutation_seq``, and the
+router's /healthz marks the dead replica unusable.
+
+**Phase 2 — primary SIGKILL + failover.** The primary is SIGKILLed under
+the same load. Invariants: reads never fail; writes return typed 503
+(never a traceback, never a hang) until ``--auto-failover`` promotes the
+most-caught-up follower, then resume; ZERO acknowledged writes lost —
+every client-acked (seq, rows) pair must appear bit-identical in the NEW
+primary's WAL (semi-synchronous ack is what makes this exact), and reads
+replay bit-identically against that WAL. Reads that observed the dead
+primary's unreplicated tail (seq past the takeover point, served before
+the promote) are excluded and counted — that pre-ack visibility is the
+documented read-uncommitted window, not a correctness loss.
+
+**Phase 3 — ex-primary rejoin.** The killed primary reboots
+``--follower-of`` the new primary: its unacknowledged WAL tail past the
+takeover seq is truncated, it catches up over wal-append (digest-checked
+overlap, no divergence), lag drains, and a read served directly by the
+rejoined replica replays bit-identical.
+
+**Phase 4 — coordinated reload under a crash-stop.** A fresh immutable
+3-replica fleet (hot reload is the immutable-serving operation — the
+mutable tier owns its own artifact lifecycle). One replica is
+crash-stopped, then the router is asked to reload: the attempt must fail
+typed with ``rolled_back: true`` and every LIVE replica still on the old
+version (all-or-nothing). The dead replica is rebooted and the retry
+must land every replica on the new version.
+
+Every terminal outcome in every phase must be typed JSON — a traceback
+body anywhere fails the gate. Exit 0 when every invariant holds; 1 with
+a diagnosis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import procgroup  # noqa: E402 — scripts-dir sibling (process-group
+# spawn + atexit kill sweep: a failed assertion can never strand a server)
+from mutable_soak import (  # noqa: E402 — shared soak machinery
+    BOOT_TIMEOUT_S,
+    READY_RE,
+    Mirror,
+    http,
+)
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--short", action="store_true",
+                   help="CI preset: ~6 s load windows")
+    p.add_argument("--window-s", type=float, default=None)
+    p.add_argument("--writers", type=int, default=2)
+    p.add_argument("--readers", type=int, default=3)
+    p.add_argument("--rows", type=int, default=4)
+    p.add_argument("--seed", type=int, default=17)
+    p.add_argument("--json-out", default=None, metavar="FILE")
+    args = p.parse_args()
+    if args.window_s is None:
+        args.window_s = 6.0 if args.short else 15.0
+    return args
+
+
+def fail(msg: str) -> int:
+    print(f"fleet-soak: FAIL: {msg}", file=sys.stderr)
+    return 1  # procgroup's atexit sweep reaps every spawned group
+
+
+def free_ports(n: int) -> "list[int]":
+    """Reserve n distinct ephemeral ports (bind, read, close). A
+    collision later fails the boot loudly rather than corrupting the
+    gate."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def spawn(cmd, env):
+    proc = procgroup.popen_group(
+        [sys.executable, "-m", "knn_tpu.cli", *cmd],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO,
+    )
+    import queue
+
+    lines: "queue.Queue[str]" = queue.Queue()
+    threading.Thread(
+        target=lambda: [lines.put(ln) for ln in proc.stdout], daemon=True,
+    ).start()
+    return proc, lines
+
+
+def wait_ready(proc, lines, what: str):
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=min(1.0, max(
+                0.01, deadline - time.monotonic())))
+        except Exception:  # noqa: BLE001 — queue.Empty
+            if proc.poll() is not None:
+                return None
+            continue
+        m = READY_RE.search(line)  # serve and route share the banner form
+        if m:
+            print(f"fleet-soak: {what}: {line.rstrip()}")
+            return m.group(1)
+    return None
+
+
+def healthz(base) -> dict:
+    _st, body = http(base, "/healthz")
+    return json.loads(body)
+
+
+def wait_until(pred, timeout_s: float, every_s: float = 0.2):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            v = pred()
+        except Exception:  # noqa: BLE001 — target mid-reboot
+            v = None
+        if v:
+            return v
+        time.sleep(every_s)
+    return None
+
+
+def build_wal_mirror(base_features, k, metric, replica_url) -> Mirror:
+    """The oracle: replay the replica's own durable WAL (insert-only in
+    this gate) via ``GET /admin/wal-since`` — gapless by the engine's
+    seq contract, so every served ``mutation_seq`` is verifiable."""
+    import numpy as np
+
+    mirror = Mirror(base_features, k, metric)
+    cursor = 0
+    while True:
+        st, body = http(replica_url,
+                        f"/admin/wal-since?seq={cursor}&limit=512")
+        if st != 200:
+            raise RuntimeError(f"wal-since on {replica_url}: {st}: "
+                               f"{body[:200]}")
+        records = json.loads(body)["records"]
+        if not records:
+            return mirror
+        for rec in records:
+            if rec["op"] != "insert":
+                raise RuntimeError(f"unexpected op {rec['op']!r} in the "
+                                   f"insert-only fleet soak WAL")
+            mirror.ack(rec["seq"], "insert",
+                       np.asarray(rec["rows"], np.float32))
+            cursor = rec["seq"]
+
+
+class FleetLoad:
+    """Readers + writers through the ROUTER. Readers treat ANY non-200
+    as a failure (the router's whole job is that reads never fail while
+    a replica survives); writers tolerate the typed 503 failover window
+    (counted) and require every such body to be JSON with an ``error``
+    field — never a traceback."""
+
+    def __init__(self, router: str, test_x, num_classes, args):
+        import numpy as np
+
+        self.np = np
+        self.router = router
+        self.test_x = test_x
+        self.num_classes = num_classes
+        self.args = args
+        self.stop = threading.Event()
+        self.lock = threading.Lock()
+        self.reads: list = []        # (inst, seq, version, d, i, t_mono)
+        self.reads_ok = 0
+        self.read_failures: list = []
+        self.acked: list = []        # (seq, rows) the client got a 200 for
+        self.writes_ok = 0
+        self.writes_503 = 0
+        self.write_failures: list = []
+        self.versions_seen: set = set()
+        self.threads: list = []
+
+    def _typed_or_fail(self, body: str, where: str):
+        try:
+            doc = json.loads(body)
+            if not isinstance(doc, dict) or "error" not in doc:
+                raise ValueError("no error field")
+            return doc
+        except ValueError:
+            with self.lock:
+                self.write_failures.append(
+                    f"{where}: non-JSON terminal body: {body[:160]}")
+            return None
+
+    def _writer(self, wid: int):
+        rng = self.np.random.default_rng(self.args.seed * 1000 + wid)
+        d = self.test_x.shape[1]
+        while not self.stop.is_set():
+            m = int(rng.integers(1, 3))
+            rows = rng.uniform(0, 4, (m, d)).astype(self.np.float32)
+            labels = rng.integers(0, self.num_classes, m).tolist()
+            try:
+                st, body = http(self.router, "/insert",
+                                {"rows": rows.tolist(), "labels": labels})
+            except Exception as e:  # noqa: BLE001 — the ROUTER died
+                with self.lock:
+                    self.write_failures.append(f"router transport: {e}")
+                time.sleep(0.05)
+                continue
+            if st == 200:
+                doc = json.loads(body)
+                with self.lock:
+                    self.writes_ok += 1
+                    self.acked.append((doc["seq"], rows))
+            elif st == 503:
+                # The typed failover window / replication-ack timeout.
+                # An applied-but-unconfirmed 503 is NOT an ack: the
+                # client was told so, and the lost-write accounting
+                # below only covers 200s.
+                if self._typed_or_fail(body, "write 503") is not None:
+                    with self.lock:
+                        self.writes_503 += 1
+                time.sleep(0.05)
+            elif st in (429, 502):
+                self._typed_or_fail(body, f"write {st}")
+                time.sleep(0.05)
+            else:
+                with self.lock:
+                    self.write_failures.append(
+                        f"write status {st}: {body[:160]}")
+            time.sleep(0.004)
+
+    def _reader(self, rid: int):
+        rng = self.np.random.default_rng(self.args.seed * 2000 + rid)
+        q = self.test_x.shape[0]
+        r = self.args.rows
+        while not self.stop.is_set():
+            lo = int(rng.integers(0, max(1, q - r)))
+            inst = self.test_x[lo:lo + r]
+            try:
+                st, body = http(self.router, "/kneighbors",
+                                {"instances": inst.tolist()})
+            except Exception as e:  # noqa: BLE001 — the ROUTER died
+                with self.lock:
+                    self.read_failures.append(f"router transport: {e}")
+                continue
+            if st != 200:
+                with self.lock:
+                    self.read_failures.append(
+                        f"read status {st}: {body[:200]}")
+                continue
+            doc = json.loads(body)
+            with self.lock:
+                self.reads_ok += 1
+                self.versions_seen.add(doc["index_version"])
+                if "mutation_seq" in doc:
+                    self.reads.append(
+                        (self.np.asarray(inst), doc["mutation_seq"],
+                         doc["index_version"], doc["distances"],
+                         doc["indices"], time.monotonic()))
+
+    def start(self):
+        self.threads = (
+            [threading.Thread(target=self._writer, args=(w,), daemon=True)
+             for w in range(self.args.writers)]
+            + [threading.Thread(target=self._reader, args=(r,),
+                                daemon=True)
+               for r in range(self.args.readers)])
+        for t in self.threads:
+            t.start()
+
+    def finish(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=90)
+            if t.is_alive():
+                self.read_failures.append("a load thread hung")
+
+
+def verify_against_wal(load: FleetLoad, mirror: Mirror, v0: str, where,
+                       exclude=None) -> "tuple[list, int]":
+    """Lost-write accounting + bit-identity replay. Returns
+    (violations, excluded_read_count)."""
+    import numpy as np
+
+    bad = []
+    for seq, rows in load.acked:
+        got = mirror.history.get(seq)
+        if got is None:
+            bad.append(f"{where}: ACKED write seq {seq} is missing from "
+                       f"the surviving WAL — an acknowledged write was "
+                       f"LOST")
+            continue
+        if got[0] != "insert" or not np.array_equal(
+                np.asarray(got[1], np.float32), rows):
+            bad.append(f"{where}: WAL seq {seq} carries different rows "
+                       f"than the client acked")
+    excluded = 0
+    verifiable = []
+    for inst, seq, version, dists, idx, t in load.reads:
+        if exclude is not None and exclude(seq, t):
+            excluded += 1
+            continue
+        verifiable.append((inst, seq, version, dists, idx))
+    bad += mirror.verify_reads(verifiable, {v0: ()}, where)
+    return bad, excluded
+
+
+def main() -> int:
+    args = parse_args()
+    from bench import _load_medium  # noqa: E402 — repo-root import
+    from knn_tpu.serve.artifact import load_index
+
+    train, test = _load_medium()
+    d = Path(__file__).parent.parent / "build" / "fixtures"
+    ref = Path("/root/reference/datasets")
+    train_arff = str((ref if ref.exists() else d) / "medium-train.arff")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KNN_TPU_RETRY_BASE_MS="0")
+    report: dict = {"fleet_soak": {
+        "train_rows": train.num_instances, "writers": args.writers,
+        "readers": args.readers, "window_s": args.window_s,
+    }}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        seed_idx = tmp / "seed"
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             str(seed_idx), "--k", "5"],
+            env=env, capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: "
+                        f"{build.stderr}")
+        model = load_index(seed_idx)
+        # Byte-identical copies => every replica reports the SAME
+        # index_version, which is what lets one oracle replay cover
+        # reads answered by any of them.
+        dirs = {}
+        for name in ("r1", "r2", "r3"):
+            dirs[name] = tmp / name
+            shutil.copytree(seed_idx, dirs[name])
+
+        p1, p2, p3, pr = free_ports(4)
+        url = {n: f"http://127.0.0.1:{p}"
+               for n, p in (("r1", p1), ("r2", p2), ("r3", p3))}
+        serve_common = ["--max-batch", "32", "--max-wait-ms", "1",
+                        "--mutable", "on", "--compact-interval-s", "0",
+                        "--compact-threshold", "1000000"]
+
+        def port_of(u: str) -> str:
+            return u.rsplit(":", 1)[1]
+
+        def boot_follower(name: str, primary: str):
+            proc, lines = spawn(
+                ["serve", str(dirs[name]), "--port", port_of(url[name]),
+                 *serve_common, "--follower-of", primary], env)
+            return proc, wait_ready(proc, lines, name)
+
+        procs = {}
+        procs["r2"], b2 = boot_follower("r2", url["r1"])
+        procs["r3"], b3 = boot_follower("r3", url["r1"])
+        procs["r1"], lines1 = spawn(
+            ["serve", str(dirs["r1"]), "--port", port_of(url["r1"]),
+             *serve_common, "--replicate-to",
+             f"{url['r2']},{url['r3']}", "--replicate-ack", "any",
+             "--replicate-ack-timeout-s", "10"], env)
+        b1 = wait_ready(procs["r1"], lines1, "r1")
+        if None in (b1, b2, b3):
+            return fail(f"replica boot failed (ready: r1={b1}, r2={b2}, "
+                        f"r3={b3})")
+        router_proc, router_lines = spawn(
+            ["route", url["r1"], url["r2"], url["r3"],
+             "--port", str(pr), "--health-interval-s", "0.25",
+             "--auto-failover", "on", "--failover-after-s", "1.0",
+             "--hedge-ms", "auto"], env)
+        router = wait_ready(router_proc, router_lines, "router")
+        if router is None:
+            return fail(f"router boot failed (rc={router_proc.poll()})")
+        v0 = healthz(url["r1"])["index_version"]
+        for name in ("r2", "r3"):
+            if healthz(url[name])["index_version"] != v0:
+                return fail(f"{name} booted a different index_version "
+                            f"than the primary — the copies diverged")
+
+        # ---- phase 1: follower SIGKILL under load ------------------------
+        load = FleetLoad(router, test.features, train.num_classes, args)
+        load.start()
+        time.sleep(args.window_s / 3)
+        procgroup.kill_group(procs["r3"])
+        kill_t = time.monotonic()
+        time.sleep(2 * args.window_s / 3)
+        load.finish()
+        if load.read_failures:
+            return fail(f"phase-1 failed reads after a follower "
+                        f"SIGKILL: {load.read_failures[:3]}")
+        if load.write_failures:
+            return fail(f"phase-1 write violations: "
+                        f"{load.write_failures[:3]}")
+        if load.reads_ok < 50 or load.writes_ok < 10:
+            return fail(f"too little load to trust phase 1 "
+                        f"({load.reads_ok} reads, {load.writes_ok} "
+                        f"writes)")
+        stray = load.versions_seen - {v0}
+        if stray:
+            return fail(f"phase-1 reads carried unknown version(s) "
+                        f"{stray} (want {v0} fleet-wide)")
+        mirror = build_wal_mirror(model.train_.features, model.k,
+                                  model.metric, url["r1"])
+        bad, _ = verify_against_wal(load, mirror, v0, "phase-1")
+        if bad:
+            return fail("; ".join(bad[:3]))
+        h = healthz(router)
+        if h["replicas"][url["r3"]]["healthy"]:
+            return fail(f"router still reports the SIGKILLed follower "
+                        f"healthy {time.monotonic() - kill_t:.1f}s "
+                        f"after the kill")
+        report["phase1"] = {
+            "reads_verified": len(load.reads), "reads_ok": load.reads_ok,
+            "writes_ok": load.writes_ok,
+            "acked_writes": len(load.acked),
+        }
+        print(f"fleet-soak: phase 1 ok — follower SIGKILL under load: "
+              f"{load.reads_ok} reads, ZERO failed; {len(load.reads)} "
+              f"replayed bit-identical; router demoted the corpse")
+
+        # Reboot the killed follower before phase 2 (a follower rejoin
+        # in its own right): the semi-synchronous ack needs a live
+        # follower to confirm against, and a healthy fleet is the
+        # stated starting point of the primary-loss leg.
+        procs["r3"], b3 = boot_follower("r3", url["r1"])
+        if b3 is None:
+            return fail(f"follower reboot before phase 2 failed "
+                        f"(rc={procs['r3'].poll()})")
+        if not wait_until(
+                lambda: (healthz(url["r3"])["mutable"]["seq"]
+                         >= healthz(url["r1"])["mutable"]["seq"]),
+                timeout_s=30):
+            return fail("rebooted follower never caught up before "
+                        "phase 2")
+        if not wait_until(lambda: healthz(router)["usable"] == 3,
+                          timeout_s=20):
+            return fail("router never saw 3 usable replicas before "
+                        "phase 2")
+
+        # ---- phase 2: primary SIGKILL -> typed 503 -> promote ------------
+        load = FleetLoad(router, test.features, train.num_classes, args)
+        load.start()
+        time.sleep(args.window_s / 3)
+        procgroup.kill_group(procs["r1"])
+
+        def new_primary():
+            p = healthz(router).get("primary")
+            return p if p and p != url["r1"] else None
+
+        promoted = wait_until(new_primary, timeout_s=30)
+        t_promote = time.monotonic()
+        if promoted not in (url["r2"], url["r3"]):
+            load.finish()
+            return fail(f"auto-failover did not promote a surviving "
+                        f"follower (primary={promoted!r}, want one of "
+                        f"{url['r2']}/{url['r3']})")
+        with load.lock:
+            writes_at_promote = load.writes_ok
+        time.sleep(args.window_s / 3)
+        load.finish()
+        if load.read_failures:
+            return fail(f"phase-2 failed reads during primary failover: "
+                        f"{load.read_failures[:3]}")
+        if load.write_failures:
+            return fail(f"phase-2 write violations: "
+                        f"{load.write_failures[:3]}")
+        if load.writes_503 < 1:
+            return fail("phase-2 never saw the typed 503 failover "
+                        "window — the kill landed outside the write "
+                        "path?")
+        if load.writes_ok <= writes_at_promote:
+            return fail(f"phase-2: writes never resumed after the "
+                        f"promote ({load.writes_ok} total, "
+                        f"{writes_at_promote} pre-promote)")
+        cap = healthz(promoted)["fleet"]["promoted_at_seq"]
+        if cap is None:
+            return fail("promoted replica reports no promoted_at_seq")
+        mirror = build_wal_mirror(model.train_.features, model.k,
+                                  model.metric, promoted)
+        # Reads that observed the dead primary's unreplicated tail:
+        # served BEFORE the promote with a seq past the takeover point.
+        bad, excluded = verify_against_wal(
+            load, mirror, v0, "phase-2",
+            exclude=lambda seq, t: seq > cap and t < t_promote)
+        if bad:
+            return fail("; ".join(bad[:3]))
+        report["phase2"] = {
+            "reads_verified": len(load.reads) - excluded,
+            "reads_excluded_unreplicated_tail": excluded,
+            "reads_ok": load.reads_ok,
+            "writes_503_window": load.writes_503,
+            "writes_after_promote": load.writes_ok - writes_at_promote,
+            "acked_writes": len(load.acked),
+            "takeover_seq": cap,
+            "promoted": promoted,
+        }
+        print(f"fleet-soak: phase 2 ok — primary SIGKILL: "
+              f"{load.writes_503} typed-503 writes in the window, "
+              f"promote to {promoted} at seq {cap}, writes resumed "
+              f"({load.writes_ok - writes_at_promote} post-promote), "
+              f"zero acked writes lost, "
+              f"{len(load.reads) - excluded} reads replay bit-identical "
+              f"({excluded} pre-ack tail reads excluded)")
+
+        # ---- phase 3: ex-primary rejoin ----------------------------------
+        procs["r1"], b1 = boot_follower("r1", promoted)
+        if b1 is None:
+            return fail(f"phase-3 rejoin boot failed "
+                        f"(rc={procs['r1'].poll()})")
+        caught_up = wait_until(
+            lambda: (healthz(url["r1"])["mutable"]["seq"]
+                     >= healthz(promoted)["mutable"]["seq"]),
+            timeout_s=30)
+        if not caught_up:
+            s1 = healthz(url["r1"])["mutable"]["seq"]
+            s2 = healthz(promoted)["mutable"]["seq"]
+            return fail(f"phase-3 rejoin never caught up (r1 seq {s1}, "
+                        f"primary seq {s2})")
+        ship = (healthz(promoted)["fleet"]["followers"]
+                or {}).get(url["r1"], {})
+        if ship.get("state") in ("diverged", "behind_fold", "rejected"):
+            return fail(f"phase-3 rejoin shipping failed: {ship}")
+        st, body = http(url["r1"], "/kneighbors",
+                        {"instances": test.features[:args.rows].tolist()})
+        if st != 200:
+            return fail(f"phase-3 read on the rejoined replica: {st}")
+        doc = json.loads(body)
+        mirror = build_wal_mirror(model.train_.features, model.k,
+                                  model.metric, promoted)
+        bad = mirror.verify_reads(
+            [(test.features[:args.rows], doc["mutation_seq"],
+              doc["index_version"], doc["distances"], doc["indices"])],
+            {v0: ()}, "phase-3")
+        if bad:
+            return fail("; ".join(bad))
+        report["phase3"] = {
+            "rejoined_seq": healthz(url["r1"])["mutable"]["seq"],
+            "ship_state": ship.get("state"),
+        }
+        print(f"fleet-soak: phase 3 ok — ex-primary rejoined as "
+              f"follower, caught up to seq "
+              f"{report['phase3']['rejoined_seq']} with no divergence, "
+              f"reads bit-identical")
+
+        # Tear the mutable fleet down before phase 4.
+        for name in ("r1", "r2", "r3"):
+            procgroup.kill_group(procs[name])
+        procgroup.kill_group(router_proc)
+
+        # ---- phase 4: coordinated reload under a crash-stop --------------
+        q1, q2, q3, qr = free_ports(4)
+        iurl = {n: f"http://127.0.0.1:{p}"
+                for n, p in (("i1", q1), ("i2", q2), ("i3", q3))}
+        idirs = {}
+        for name in ("i1", "i2", "i3"):
+            idirs[name] = tmp / name
+            shutil.copytree(seed_idx, idirs[name])
+        new_idx = tmp / "new"
+        subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             str(new_idx), "--k", "5"],
+            env=env, capture_output=True, text=True, cwd=REPO, check=True)
+
+        iprocs = {}
+        for name in ("i1", "i2", "i3"):
+            proc, lines = spawn(
+                ["serve", str(idirs[name]), "--port", port_of(iurl[name]),
+                 "--max-batch", "16", "--max-wait-ms", "1"], env)
+            if wait_ready(proc, lines, name) is None:
+                return fail(f"phase-4 {name} boot failed")
+            iprocs[name] = proc
+        rproc, rlines = spawn(
+            ["route", iurl["i1"], iurl["i2"], iurl["i3"],
+             "--port", str(qr), "--health-interval-s", "0.25"], env)
+        irouter = wait_ready(rproc, rlines, "router-4")
+        if irouter is None:
+            return fail("phase-4 router boot failed")
+        iv0 = healthz(iurl["i1"])["index_version"]
+
+        # Crash-stop i3, then immediately demand a coordinated reload:
+        # the router's sequential confirm hits the corpse mid-sequence
+        # and must roll the flipped replicas back — all-or-nothing.
+        procgroup.kill_group(iprocs["i3"])
+        st, body = http(irouter, "/admin/reload",
+                        {"index": str(new_idx)}, timeout=600)
+        doc = json.loads(body)
+        if st != 502 or not doc.get("rolled_back"):
+            return fail(f"phase-4 mid-crash reload: wanted 502 "
+                        f"rolled_back, got {st}: {body[:300]}")
+        for name in ("i1", "i2"):
+            v = healthz(iurl[name])["index_version"]
+            if v != iv0:
+                return fail(f"phase-4 {name} is on {v} after the rolled-"
+                            f"back reload (want {iv0}) — the fleet "
+                            f"version DIVERGED")
+        # Reboot the corpse, retry: now it must be all-or-nothing the
+        # other way — every replica lands on the new version.
+        proc, lines = spawn(
+            ["serve", str(idirs["i3"]), "--port", port_of(iurl["i3"]),
+             "--max-batch", "16", "--max-wait-ms", "1"], env)
+        if wait_ready(proc, lines, "i3-reboot") is None:
+            return fail("phase-4 i3 reboot failed")
+        iprocs["i3"] = proc
+        if not wait_until(lambda: healthz(irouter)["usable"] == 3,
+                          timeout_s=20):
+            return fail("phase-4: router never saw all 3 replicas "
+                        "usable after the reboot")
+        st, body = http(irouter, "/admin/reload",
+                        {"index": str(new_idx)}, timeout=600)
+        doc = json.loads(body)
+        if st != 200:
+            return fail(f"phase-4 retry reload: {st}: {body[:300]}")
+        iv_new = doc["index_version"]
+        for name in ("i1", "i2", "i3"):
+            v = healthz(iurl[name])["index_version"]
+            if v != iv_new:
+                return fail(f"phase-4 {name} on {v} after the confirmed "
+                            f"reload (want {iv_new})")
+        if iv_new == iv0:
+            return fail("phase-4 reload did not change the version — "
+                        "the gate proved nothing")
+        report["phase4"] = {
+            "rolled_back_on_crash": True, "v0": iv0, "v_new": iv_new,
+        }
+        print(f"fleet-soak: phase 4 ok — crash-stopped replica aborted "
+              f"the reload with every live replica still on {iv0}; "
+              f"retry flipped all three to {iv_new}")
+
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.json_out:
+        Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json_out).write_text(out + "\n")
+    print("fleet-soak: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,9 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+import procgroup  # noqa: E402 — scripts-dir sibling (process-group
+# spawn + atexit kill sweep: a failed assertion can never strand a server)
+
 READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
 BOOT_TIMEOUT_S = 180
 
@@ -105,7 +108,7 @@ def http(base: str, path: str, payload=None, timeout=60):
 
 
 def boot(index: str, env: dict, extra_flags=()):
-    proc = subprocess.Popen(
+    proc = procgroup.popen_group(
         [sys.executable, "-m", "knn_tpu.cli", "serve", index,
          "--port", "0", "--max-batch", "32", "--max-wait-ms", "1",
          "--mutable", "on", "--compact-interval-s", "0",

@@ -1,0 +1,53 @@
+"""Process-group hygiene for the soak/gate scripts (PR 13's noted flake).
+
+Every gate that boots `knn_tpu serve` (or `route`) as a subprocess MUST
+spawn it through :func:`popen_group`: the child gets its own session (=
+its own process group), and an ``atexit`` sweep SIGKILLs every group
+that is still alive — so an assertion failure, an uncaught exception, or
+a plain ``sys.exit(1)`` mid-gate can never strand a serving process that
+skews the next bench-gate run on a shared box.
+
+Deliberate in-gate kills keep working unchanged: ``proc.kill()`` /
+``proc.send_signal`` target the child directly, and
+:func:`kill_group` SIGKILLs a whole group on demand (what the fleet soak
+uses for its crash-stops). The sweep is a no-op for groups that already
+exited cleanly (``ProcessLookupError`` is the success case).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import subprocess
+
+_SPAWNED: "list[subprocess.Popen]" = []
+
+
+def popen_group(cmd, **kwargs) -> subprocess.Popen:
+    """``subprocess.Popen`` in a fresh session/process group, registered
+    for the atexit sweep. Same signature as Popen otherwise."""
+    kwargs.setdefault("start_new_session", True)
+    proc = subprocess.Popen(cmd, **kwargs)
+    _SPAWNED.append(proc)
+    return proc
+
+
+def kill_group(proc: subprocess.Popen,
+               sig: int = signal.SIGKILL) -> None:
+    """Signal the child's WHOLE process group (with start_new_session
+    the group id is the child's pid). Already-gone groups are a no-op."""
+    try:
+        os.killpg(proc.pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+
+
+def _sweep() -> None:
+    # Even for a leader that exited, sweep the group: a grandchild may
+    # linger in it (killpg on an empty group is the no-op success case).
+    for proc in _SPAWNED:
+        kill_group(proc)
+
+
+atexit.register(_sweep)

@@ -39,6 +39,9 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+import procgroup  # noqa: E402 — scripts-dir sibling (process-group
+# spawn + atexit kill sweep: a failed assertion can never strand a server)
+
 READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
 BOOT_TIMEOUT_S = 120  # first-call compile on a cold cache can be slow
 SHUTDOWN_GRACE_S = 15
@@ -86,7 +89,7 @@ def main() -> int:
         print(f"serve-smoke: {build.stdout.strip()}")
 
         captures_dir = os.path.join(tmp, "captures")
-        proc = subprocess.Popen(
+        proc = procgroup.popen_group(
             [sys.executable, "-m", "knn_tpu.cli", "serve", index,
              "--port", "0", "--max-batch", "16", "--max-wait-ms", "1",
              # Quality observability on (PR 7): every request shadow-scored

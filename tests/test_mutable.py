@@ -1089,3 +1089,81 @@ class TestMutableHTTP:
                               {"rows": [test_x[0].tolist()],
                                "labels": [1]})
         assert st == 503 and "draining" in body["error"]
+
+
+class TestWALReplicationEdges:
+    """The two WAL edge cases primary-failover catch-up depends on
+    (docs/SERVING.md §Running a replica set): a seq GAP in a replayed
+    epoch stream is a typed refusal (acked records vanished — replaying
+    past the hole would serve a history that never happened), and
+    re-applying an ALREADY-applied seq is an idempotent no-op (the
+    shipper re-sends from a conservative cursor after a resync)."""
+
+    def test_boot_replay_seq_gap_is_typed_never_skipped(self, rng,
+                                                        tmp_path):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        for v in (0, 1, 2):
+            eng.apply_insert(np.full((1, 5), float(v), np.float32),
+                             [v], 0)
+        eng.close()
+        # Surgically drop the MIDDLE record: an acknowledged write
+        # vanished from the stream.
+        path = artifact.epoch_path(_root(model, tmp_path), 1)
+        lines = [ln for ln in path.read_text().splitlines() if ln]
+        assert len(lines) == 3
+        path.write_text("\n".join([lines[0], lines[2]]) + "\n")
+        with pytest.raises(DataError, match="seq gap"):
+            _engine(model, _root(model, tmp_path))
+
+    def test_reapply_already_applied_seq_is_idempotent_noop(
+            self, rng, tmp_path):
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            eng.apply_insert(np.ones((2, 5), np.float32), [0, 1], 0)
+            eng.apply_delete([3], 0)
+            records, seq = eng.records_since(0)
+            assert [r["seq"] for r in records] == [1, 2]
+            assert all("digest" in r for r in records)
+            before = eng.snapshot()
+            for rec in records:  # the shipper's conservative re-send
+                out = eng.apply_replicated(rec)
+                assert out == {"applied": False, "seq": seq}
+            after = eng.snapshot()
+            assert after.seq == before.seq
+            assert after.count == before.count
+            assert after.tomb_pos == before.tomb_pos
+        finally:
+            eng.close()
+        # ...and the no-op appended NOTHING to the WAL: a reboot replays
+        # the identical two records.
+        eng2 = _engine(model, _root(model, tmp_path))
+        try:
+            records2, seq2 = eng2.records_since(0)
+            assert seq2 == seq
+            assert [(r["seq"], r["op"]) for r in records2] == [
+                (1, "insert"), (2, "delete")]
+        finally:
+            eng2.close()
+
+    def test_reapply_with_divergent_content_is_typed(self, rng,
+                                                     tmp_path):
+        """Same seq, different digest: the two logs disagree about
+        history — silent skip OR silent apply would both be corruption."""
+        from knn_tpu.mutable.state import WALDivergence
+
+        train, _ = _problem(rng)
+        model = KNNClassifier(k=3).fit(train)
+        eng = _engine(model, _root(model, tmp_path))
+        try:
+            eng.apply_insert(np.ones((1, 5), np.float32), [0], 0)
+            records, _seq = eng.records_since(0)
+            evil = dict(records[0])
+            evil["rows"] = [[9.0, 9.0, 9.0, 9.0, 9.0]]
+            with pytest.raises(WALDivergence, match="diverged"):
+                eng.apply_replicated(evil)
+        finally:
+            eng.close()

@@ -800,3 +800,75 @@ class TestServerQuality:
         st, body = _post(base, "/predict",
                          {"instances": test.features.tolist()})
         assert st == 200 and body["predictions"] == want
+
+
+class TestDrainOrdering:
+    def test_listener_refuses_before_healthz_flips(self, rng, obs_on):
+        """The SIGTERM sequence (``drain_and_stop``): the LISTENING
+        socket must already refuse new connections at the instant the
+        app flips to draining — so a fleet router's connection-refused
+        demotion fires immediately, and no connection can ever be
+        accepted into the 503 window and die untracked. An in-flight
+        request admitted before the drain still completes 200 (its
+        connection socket is not the listener)."""
+        import socket
+
+        from knn_tpu.serve.server import (
+            ServeApp,
+            drain_and_stop,
+            make_server,
+        )
+
+        train, test = _problem(rng)
+        model = KNNClassifier(k=3, engine="xla").fit(train)
+        model.predict(test)  # pre-compile: the in-flight leg times a
+        # dispatch, not a first-call compile
+        app = ServeApp(model, max_batch=8, max_wait_ms=300.0)
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        app.ready = True
+
+        probe = {}
+        orig_drain = app.drain
+
+        def probing_drain(timeout_s):
+            # This runs at the exact moment the old code would have
+            # flipped healthz FIRST: the listener must already be gone.
+            try:
+                socket.create_connection((host, port), timeout=2).close()
+                probe["refused"] = False
+            except ConnectionRefusedError:
+                probe["refused"] = True
+            except OSError as e:
+                probe["refused"] = f"unexpected {type(e).__name__}: {e}"
+            return orig_drain(timeout_s)
+
+        app.drain = probing_drain
+        results = []
+
+        def client():
+            req = urllib.request.Request(
+                f"http://{host}:{port}/predict",
+                data=json.dumps(
+                    {"instances": [test.features[0].tolist()]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                results.append(r.status)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        time.sleep(0.1)  # the request is admitted, parked in the
+        # batcher's 300 ms coalescing window — in flight across the
+        # drain
+        try:
+            summary = drain_and_stop(server, drain_timeout_s=10.0)
+            t.join(timeout=15)
+            assert probe["refused"] is True
+            assert results == [200]
+            assert summary["drained_clean"] is True
+            assert summary["inflight_at_exit"] == 0
+        finally:
+            server.server_close()
+            app.close()
