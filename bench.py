@@ -1635,7 +1635,7 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
     ivf_dev_trials = []
     for _ in range(predict_reps):
         t0 = time.monotonic()
-        dev_d, dev_i, _stats = ivf.search(
+        dev_d, dev_i, dev_stats = ivf.search(
             train.features, test.features, K, 8, scorer="device")
         ivf_dev_trials.append(round((time.monotonic() - t0) * 1e3, 3))
     if not (np.array_equal(dev_i, ivf_i)
@@ -1644,6 +1644,25 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
             "gate: device ivf scorer diverged from the host scorer")
     log(f"gate ivf device scorer: best {min(ivf_dev_trials)} ms vs host "
         f"{min(ivf_trials)} ms")
+
+    # Roofline-normalized forms of two walls above — the units the full
+    # bench reports (Gdist/s for retrieval scan rate, MFU against the
+    # f32 peak for predict). Derived 1:1 from their wall trials, so they
+    # gate the SAME measurements in hardware-meaningful units: a wall
+    # regression that hides behind a data-size change cannot hide here.
+    d_feat = int(train.features.shape[1])
+    flops = 2 * test.num_instances * train.num_instances * d_feat
+    predict_mfu = [
+        round(flops / (w / 1e3) / (PEAK_TF_F32 * 1e12), 9)
+        for w in predict_trials
+    ]
+    ivf_dev_gdist = [
+        round(dev_stats.candidate_rows * d_feat / (w / 1e3) / 1e9, 6)
+        for w in ivf_dev_trials
+    ]
+    log(f"gate roofline: predict MFU best {max(predict_mfu)}, ivf "
+        f"device scan best {max(ivf_dev_gdist)} Gdist/s "
+        f"({dev_stats.candidate_rows} candidate rows)")
 
     import os
 
@@ -1694,6 +1713,15 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
             "ivf_device_kneighbors_wall_ms": {"trials": ivf_dev_trials,
                                               "direction": "lower",
                                               "unit": "ms"},
+            # PR 16 roofline telemetry: ARMED for env fingerprints whose
+            # baseline entry carries them (this box's does); on any
+            # other fingerprint there is no baseline entry at all, so
+            # they are report-only by construction.
+            "predict_mfu": {"trials": predict_mfu,
+                            "direction": "higher", "unit": "ratio"},
+            "ivf_device_gdist_s": {"trials": ivf_dev_gdist,
+                                   "direction": "higher",
+                                   "unit": "Gdist/s"},
         },
     }
 

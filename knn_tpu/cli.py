@@ -164,6 +164,22 @@ def _add_route_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--failover-after-s", type=float, default=3.0,
                    help="how long the primary must be continuously "
                    "unusable before --auto-failover acts")
+    p.add_argument("--flight-recorder-size", type=int, default=256,
+                   help="router-side flight recorder ring (last-N "
+                   "request timelines at /debug/requests, stitched "
+                   "cross-tier with ?id=; 0 disables tracing)")
+    p.add_argument("--slowest-k", type=int, default=32,
+                   help="slowest-request reservoir kept alongside the "
+                   "flight recorder ring")
+    p.add_argument("--access-log", default=None, metavar="PATH",
+                   help="append one JSON line per routed request "
+                   "(outcome, replica, attempts, hedged) to PATH "
+                   "('-' = stderr; default: off)")
+    p.add_argument("--event-log", default=None, metavar="PATH",
+                   help="append-only fleet audit log (demote/promote/"
+                   "auto-failover/rejoin/hedge-fired/reload events as "
+                   "JSON lines) to PATH ('-' = stderr), also served at "
+                   "/debug/events (default: off — nothing constructed)")
 
 
 def _add_replay_args(p: argparse.ArgumentParser) -> None:
@@ -402,7 +418,7 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    help="boot as the PRIMARY of a replica set, fanning "
                    "every acknowledged WAL record out to these follower "
                    "base URLs (one ordered cursor each; follower lag in "
-                   "/healthz fleet block + knn_fleet_replica_lag_seq). "
+                   "/healthz fleet block + knn_fleet_replication_lag_seq). "
                    "Requires --mutable on")
     p.add_argument("--replicate-ack", choices=["any", "none"],
                    default="any",
@@ -1145,6 +1161,11 @@ def _run_route(args, stdout) -> int:
          f"--admin-timeout-s must be > 0, got {args.admin_timeout_s}"),
         (args.failover_after_s <= 0,
          f"--failover-after-s must be > 0, got {args.failover_after_s}"),
+        (args.flight_recorder_size < 0,
+         f"--flight-recorder-size must be >= 0, got "
+         f"{args.flight_recorder_size}"),
+        (args.slowest_k < 0,
+         f"--slowest-k must be >= 0, got {args.slowest_k}"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -1173,8 +1194,15 @@ def _run_route(args, stdout) -> int:
             hedge=args.hedge_ms,
             auto_failover=(args.auto_failover == "on"),
             failover_after_s=args.failover_after_s,
+            flight_recorder_size=args.flight_recorder_size,
+            slowest_k=args.slowest_k,
+            access_log=args.access_log,
+            event_log=args.event_log,
         )
     except ValueError as e:  # bad --hedge-ms / duplicate replica URLs
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    except OSError as e:  # an unwritable --access-log / --event-log path
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
     try:

@@ -60,7 +60,7 @@ class ReplicaState:
 
 class ReplicaSet:
     def __init__(self, urls, *, interval_s: float = 1.0,
-                 poll_timeout_s: float = 2.0, on_poll=None):
+                 poll_timeout_s: float = 2.0, on_poll=None, events=None):
         if not urls:
             raise ValueError("a replica set needs at least one replica "
                              "base URL")
@@ -70,6 +70,10 @@ class ReplicaSet:
         self.interval_s = float(interval_s)
         self.poll_timeout_s = float(poll_timeout_s)
         self._on_poll = on_poll
+        #: Optional :class:`knn_tpu.fleet.events.FleetEventLog`: health
+        #: TRANSITIONS (demote / passive-demote / rejoin) are audit
+        #: events; steady states are not.
+        self.events = events
         self._lock = threading.Lock()
         self._states = {u: ReplicaState(u) for u in self.urls}
         self._stop = threading.Event()
@@ -116,6 +120,7 @@ class ReplicaSet:
             return
         with self._lock:
             s = self._states[url]
+            was_healthy, was_seen = s.healthy, s.ever_seen
             s.ever_seen = True
             s.last_poll_unix = time.time()
             s.draining = bool(doc.get("draining"))
@@ -138,21 +143,46 @@ class ReplicaSet:
                 s.consecutive_failures += 1
                 s.last_error = (f"HTTP {status}"
                                 + (" (draining)" if s.draining else ""))
+            role, err = s.role, s.last_error
+        if self.events is not None:
+            if status == 200 and was_seen and not was_healthy:
+                # First-ever success is boot discovery, not a rejoin:
+                # the transition the audit log wants is down -> up on a
+                # replica this router had already met.
+                self.events.emit("rejoin", replica=url, role=role)
+            elif status != 200 and was_healthy:
+                self.events.emit("demote", replica=url, role=role,
+                                 error=err)
         self._export_gauge(url)
 
-    def _mark_down(self, url: str, err: str) -> None:
+    def _mark_down(self, url: str, err: str, *, event: str = "demote",
+                   request_id=None) -> None:
         with self._lock:
             s = self._states[url]
+            was_healthy = s.healthy
+            role = s.role
             s.healthy = False
             s.consecutive_failures += 1
             s.last_error = err
             s.last_poll_unix = time.time()
+        if self.events is not None and was_healthy:
+            self.events.emit(event, request_id=request_id, replica=url,
+                             role=role, error=err)
         self._export_gauge(url)
 
-    def note_failure(self, url: str, err: str) -> None:
+    def note_failure(self, url: str, err: str, request_id=None) -> None:
         """Passive demotion: a forward just failed at the transport layer
-        — don't wait for the next poll to stop routing there."""
-        self._mark_down(url.rstrip("/"), err)
+        — don't wait for the next poll to stop routing there.
+        ``request_id`` (when the failing forward had one) stamps the
+        audit event so the demotion joins back to the request that
+        surfaced it."""
+        self._mark_down(url.rstrip("/"), err, event="passive-demote",
+                        request_id=request_id)
+
+    def is_healthy(self, url: str) -> bool:
+        with self._lock:
+            s = self._states.get(url.rstrip("/"))
+            return bool(s is not None and s.healthy)
 
     def _export_gauge(self, url: str) -> None:
         obs.gauge_set(
@@ -231,7 +261,7 @@ class ReplicaSet:
                    for u, s in states.items() if s["role"] == "follower"}
             for u, v in lag.items():
                 obs.gauge_set(
-                    "knn_fleet_replica_lag_seq", v,
+                    "knn_fleet_replication_lag_seq", v,
                     help="primary applied_seq minus this follower's "
                          "acked seq",
                     follower=u,

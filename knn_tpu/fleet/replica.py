@@ -26,6 +26,7 @@ those seqs name different mutations.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Optional
@@ -46,6 +47,10 @@ SHIP_UNREACHABLE = "unreachable"
 SHIP_DIVERGED = "diverged"        # parked: re-seed the follower
 SHIP_BEHIND_FOLD = "behind_fold"  # parked: re-seed the follower
 SHIP_REJECTED = "rejected"
+
+#: Replication-lag clock bound: stamped apply instants kept while no
+#: follower has confirmed them (writes-in-flight, not history).
+_MAX_SEQ_STAMPS = 4096
 
 #: How long a parked (diverged/behind-fold) shipper waits before
 #: re-probing its follower. Parking — not dying — is what makes the
@@ -187,15 +192,17 @@ class WALShipper(threading.Thread):
 
     def _export_lag(self) -> None:
         obs.gauge_set(
-            "knn_fleet_replica_lag_seq", self.lag(),
+            "knn_fleet_replication_lag_seq", self.lag(),
             help="primary applied_seq minus this follower's acked seq",
             follower=self.url,
         )
 
     def export(self) -> dict:
+        lag_ms = self.fleet.follower_lag_ms(self.url)
         return {
             "acked_seq": self.acked_seq,
             "lag": self.lag(),
+            "lag_ms": lag_ms,
             "state": self.state,
             "last_error": self.last_error,
             "shipped": self.shipped,
@@ -226,10 +233,22 @@ class FleetReplica:
         self.ship_interval_s = float(ship_interval_s)
         self.promoted_at_seq: Optional[int] = None
         self.promotions = 0
+        #: Highest ``primary_seq`` a shipped batch has carried (follower
+        #: side): how far ahead the primary reported being when it last
+        #: shipped here — the read-staleness reference the serve layer
+        #: annotates lagging answers with.
+        self.primary_seq_seen = 0
         self._lock = threading.Lock()
         self._ack_cond = threading.Condition(self._lock)
         self._shippers: "dict[str, WALShipper]" = {}
         self._closed = False
+        # The replication-lag clock (primary side): stamp each applied
+        # seq's wall instant; a follower's ack of seq s then measures
+        # apply->confirmed-replicated in milliseconds. Bounded: seqs at
+        # or below every follower's ack are dropped on each ack.
+        self._seq_stamps: "collections.OrderedDict[int, float]" = \
+            collections.OrderedDict()
+        self._lag_ms: "dict[str, float]" = {}
         engine.on_applied(self._on_applied)
         if role == "primary":
             for url in replicate_to:
@@ -249,12 +268,53 @@ class FleetReplica:
         shipper.start()
 
     def _on_applied(self) -> None:
+        if self.role == "primary":
+            with self._ack_cond:
+                self._seq_stamps[self.engine.seq] = time.monotonic()
+                while len(self._seq_stamps) > _MAX_SEQ_STAMPS:
+                    self._seq_stamps.popitem(last=False)
         for s in list(self._shippers.values()):
             s.kick()
 
     def note_follower_ack(self, url: str, seq: int) -> None:
+        now = time.monotonic()
         with self._ack_cond:
+            # The newest stamped seq this ack covers gives the lag clock:
+            # apply-instant -> replicated-confirmed for that write. Acks
+            # usually confirm the latest seq, so the reversed scan is
+            # O(1) in the common case.
+            stamp = None
+            for s in reversed(self._seq_stamps):
+                if s <= seq:
+                    stamp = self._seq_stamps[s]
+                    break
+            if stamp is not None:
+                lag_ms = round((now - stamp) * 1e3, 3)
+                self._lag_ms[url.rstrip("/")] = lag_ms
+                obs.gauge_set(
+                    "knn_fleet_replication_lag_ms", lag_ms,
+                    help="ms from a write's primary apply to this "
+                         "follower's ack of it (the replication-delay "
+                         "SLI; seq-lag 0 with a stale clock means idle, "
+                         "not behind)",
+                    follower=url.rstrip("/"),
+                )
+            # Stamps every follower has confirmed can never clock a
+            # future ack; drop them so the dict stays ack-bounded.
+            floor = min((sh.acked_seq
+                         for sh in self._shippers.values()), default=seq)
+            while self._seq_stamps:
+                first = next(iter(self._seq_stamps))
+                if first > floor:
+                    break
+                del self._seq_stamps[first]
             self._ack_cond.notify_all()
+
+    def follower_lag_ms(self, url: str) -> Optional[float]:
+        """Last measured replication delay for one follower (ms), or
+        None before the first confirmed ack."""
+        with self._ack_cond:
+            return self._lag_ms.get(url.rstrip("/"))
 
     def max_follower_seq(self) -> int:
         shippers = list(self._shippers.values())
@@ -299,6 +359,12 @@ class FleetReplica:
         if not isinstance(records, list) or not records:
             raise ValueError('wal-append body needs a non-empty '
                              '"records" list')
+        if primary_seq is not None:
+            # The primary's own seq when it shipped this batch: the
+            # freshest "how far behind am I" reference a follower has,
+            # annotated onto lagging reads (staleness_seq).
+            self.primary_seq_seen = max(self.primary_seq_seen,
+                                        int(primary_seq))
         applied = skipped = 0
         for rec in sorted(records, key=lambda r: int(r.get("seq", 0))):
             result = self.engine.apply_replicated(rec)
@@ -332,6 +398,14 @@ class FleetReplica:
                 "promoted_at_seq": self.promoted_at_seq,
                 "followers": sorted(self._shippers)}
 
+    def staleness_seq(self) -> int:
+        """How many acknowledged primary writes this follower has not yet
+        applied, judged by the freshest shipped ``primary_seq`` (0 when
+        caught up, when never shipped to, or on the primary itself)."""
+        if self.role != "follower":
+            return 0
+        return max(0, self.primary_seq_seen - self.engine.seq)
+
     # -- shared ------------------------------------------------------------
 
     def export(self) -> dict:
@@ -343,6 +417,8 @@ class FleetReplica:
         }
         if self.role == "follower":
             doc["primary_url"] = self.primary_url
+            doc["primary_seq_seen"] = self.primary_seq_seen
+            doc["staleness_seq"] = self.staleness_seq()
         else:
             doc["followers"] = {url: s.export()
                                 for url, s in self._shippers.items()}

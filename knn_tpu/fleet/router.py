@@ -39,14 +39,15 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from knn_tpu import obs
+from knn_tpu.fleet.events import FleetEventLog
 from knn_tpu.fleet.health import ReplicaSet
 from knn_tpu.fleet.wire import forward_bytes, request_json
-from knn_tpu.obs import reqtrace
+from knn_tpu.obs import aggregate, reqtrace
 from knn_tpu.resilience.retry import guarded_call
 
 #: Statuses a READ may retry on another replica: the replica refused or
@@ -75,10 +76,35 @@ class RouterApp:
                  admin_timeout_s: float = 300.0,
                  hedge: str = "off",
                  auto_failover: bool = False,
-                 failover_after_s: float = 3.0):
+                 failover_after_s: float = 3.0,
+                 flight_recorder_size: int = 256, slowest_k: int = 32,
+                 access_log: Optional[str] = None,
+                 event_log=None):
+        # The fleet event audit log: None unless asked for — a router
+        # booted without --event-log constructs no writer, no ring
+        # (the zero-cost-when-off contract the overhead check pins).
+        # ``event_log=True`` keeps the ring without a file (tests).
+        self.events = (FleetEventLog(None if event_log is True
+                                     else event_log)
+                       if event_log else None)
+        # The router's own flight recorder (same default as serve: on,
+        # bounded, disable with flight_recorder_size=0). Its timelines
+        # are the router tier of every stitched cross-tier trace.
+        self.recorder = (reqtrace.FlightRecorder(flight_recorder_size,
+                                                 slowest_k)
+                         if flight_recorder_size > 0 else None)
+        self.access_log = None
+        if access_log:
+            # Serve's AccessLog IS the contract (same line shape, same
+            # off-hot-path discipline); imported lazily so a plain
+            # router never touches the serve module.
+            from knn_tpu.serve.server import AccessLog
+
+            self.access_log = AccessLog(access_log)
         self.set = ReplicaSet(replicas, interval_s=health_interval_s,
                               poll_timeout_s=poll_timeout_s,
-                              on_poll=self._maybe_failover)
+                              on_poll=self._maybe_failover,
+                              events=self.events)
         self.forward_timeout_s = float(forward_timeout_s)
         self.admin_timeout_s = float(admin_timeout_s)
         self.hedge = self._parse_hedge(hedge)
@@ -95,6 +121,10 @@ class RouterApp:
         self._failover_lock = threading.Lock()
         self._primary_down_since: Optional[float] = None
         self._failover_inflight = False
+        # Failover-window SLI: (monotonic, unix, request_id) of the first
+        # failover-typed write 503; cleared by the first write 200, which
+        # observes the span into knn_fleet_failover_window_ms.
+        self._fo_onset = None
         self.failovers = 0
         self.reloads = 0
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -116,6 +146,10 @@ class RouterApp:
     def close(self) -> None:
         self.set.close()
         self._pool.shutdown(wait=False)
+        if self.access_log is not None:
+            self.access_log.close()
+        if self.events is not None:
+            self.events.close()
 
     # -- latency / hedging -------------------------------------------------
 
@@ -148,10 +182,17 @@ class RouterApp:
             return self._rr
 
     def _attempt(self, url: str, path: str, body: Optional[bytes],
-                 headers: dict, timeout_s: float):
+                 headers: dict, timeout_s: float, trace=None, hop: int = 1):
         """One forward to one replica. Returns ``("ok"|"retryable",
         url, status, raw_body)`` or ``("transport", url, error, None)``
-        — and passively demotes the replica on a transport failure."""
+        — and passively demotes the replica on a transport failure.
+        ``hop`` numbers this attempt within its request and rides the
+        ``x-knn-hop`` header, so the replica's own timeline records
+        WHICH router attempt reached it; ``trace`` (when the router's
+        recorder is on) gets one attempt record with the forward wall,
+        the outcome, and the retry reason."""
+        if trace is not None or hop != 1:
+            headers = dict(headers, **{"x-knn-hop": str(hop)})
         t0 = time.monotonic()
         try:
             status, raw = guarded_call(
@@ -161,15 +202,27 @@ class RouterApp:
                 attempts=1, classify=False,
             )
         except Exception as e:  # noqa: BLE001 — transport taxonomy below
-            self.set.note_failure(url, f"{type(e).__name__}: {e}")
+            ms = (time.monotonic() - t0) * 1e3
+            rid = trace.request_id if trace is not None else None
+            self.set.note_failure(url, f"{type(e).__name__}: {e}",
+                                  request_id=rid)
             self._count_forward(url, "transport_error")
+            if trace is not None:
+                trace.attempt(url, False, ms, hop=hop,
+                              error=f"{type(e).__name__}: {e}")
             return ("transport", url, e, None)
+        ms = (time.monotonic() - t0) * 1e3
         if status in _READ_RETRYABLE:
             self._count_forward(url, f"http_{status}")
+            if trace is not None:
+                trace.attempt(url, False, ms, hop=hop, status=status,
+                              error=f"retryable HTTP {status}")
             return ("retryable", url, status, raw)
-        self._note_latency((time.monotonic() - t0) * 1e3)
+        self._note_latency(ms)
         self._count_forward(url, "ok" if status == 200 else
                             f"http_{status}")
+        if trace is not None:
+            trace.attempt(url, status == 200, ms, hop=hop, status=status)
         return ("ok", url, status, raw)
 
     @staticmethod
@@ -181,69 +234,107 @@ class RouterApp:
         )
 
     def forward_read(self, path: str, body: Optional[bytes],
-                     headers: dict):
+                     headers: dict, trace=None):
         """Route one read; returns ``(status, raw_json_body, replica)``.
         Walks the usable replicas (round-robin start), retrying transport
         failures and retryable statuses on the NEXT replica; optionally
         hedges the first attempt. 503 typed only when zero replicas are
-        usable or every one failed."""
+        usable or every one failed.
+
+        ``trace`` (the router's own :class:`RequestTrace`) records two
+        phases — ``route`` (candidate selection) and ``dispatch`` (the
+        whole forward walk) — with one attempt record per replica tried,
+        so the phase walls sum to ~the router-observed request wall (the
+        invariant the fleet soak's forensics phase pins)."""
+        if trace is not None:
+            trace.phase_start("route")
         candidates = self.set.usable_urls(start=self._next_rr())
+        if trace is not None:
+            trace.phase_end("route")
         if not candidates:
             return self._none_usable("read")
         failures = []
         hedge_s = self.hedge_delay_s()
-        i = 0
-        while i < len(candidates):
-            url = candidates[i]
-            if i == 0 and hedge_s is not None and len(candidates) > 1:
-                result = self._hedged_attempt(candidates, path, body,
-                                              headers, hedge_s)
-                i += 2  # the hedged round consumed candidates[0] AND [1]
-            else:
-                result = self._attempt(url, path, body, headers,
-                                       self.forward_timeout_s)
-                i += 1
-            kind, where, detail, raw = result
-            if kind == "ok":
-                return detail, raw, where
-            failures.append(f"{where}: "
-                            f"{detail if kind == 'retryable' else f'{type(detail).__name__}: {detail}'}")
-            obs.counter_add(
-                "knn_fleet_retries_total",
-                help="reads re-routed to a different replica after a "
-                     "transient failure",
-                kind="read",
-            )
-            if kind == "retryable" and len(candidates) == 1:
-                # Nothing to retry on; surface the replica's own status.
-                return detail, raw, where
-        return 503, _json_body({
-            "error": f"every usable replica failed the read: "
-                     f"{'; '.join(failures[:4])}",
-            "replicas_tried": len(candidates),
-        }), None
+        if trace is not None:
+            trace.phase_start("dispatch")
+        try:
+            i = 0
+            while i < len(candidates):
+                url = candidates[i]
+                if i == 0 and hedge_s is not None and len(candidates) > 1:
+                    result = self._hedged_attempt(candidates, path, body,
+                                                  headers, hedge_s,
+                                                  trace=trace)
+                    i += 2  # the hedged round consumed candidates[0] AND [1]
+                else:
+                    result = self._attempt(url, path, body, headers,
+                                           self.forward_timeout_s,
+                                           trace=trace, hop=i + 1)
+                    i += 1
+                kind, where, detail, raw = result
+                if kind == "ok":
+                    return detail, raw, where
+                failures.append(f"{where}: "
+                                f"{detail if kind == 'retryable' else f'{type(detail).__name__}: {detail}'}")
+                obs.counter_add(
+                    "knn_fleet_retries_total",
+                    help="reads re-routed to a different replica after a "
+                         "transient failure",
+                    kind="read",
+                )
+                if kind == "retryable" and len(candidates) == 1:
+                    # Nothing to retry on; surface the replica's own
+                    # status.
+                    return detail, raw, where
+            return 503, _json_body({
+                "error": f"every usable replica failed the read: "
+                         f"{'; '.join(failures[:4])}",
+                "replicas_tried": len(candidates),
+            }), None
+        finally:
+            if trace is not None:
+                trace.phase_end("dispatch")
 
     def _hedged_attempt(self, candidates, path, body, headers,
-                        hedge_s: float):
+                        hedge_s: float, trace=None):
         """Race the first two candidates: fire #1, wait ``hedge_s``, fire
         #2 if #1 is still out — OR if #1 failed fast (the backup then
         doubles as the cross-replica retry: the caller consumed both
         candidates, so skipping #2 on a fast failure would silently
-        shrink the retry walk). Returns the first acceptable answer."""
+        shrink the retry walk). Returns the first acceptable answer.
+
+        The losing attempt is never silently dropped: a done-callback
+        drains its result (the worker already read the whole response
+        off the socket) and counts
+        ``knn_fleet_hedge_wasted_total{outcome}`` — the duplicate
+        downstream work the hedge bought, i.e. the cost side of the
+        hedging SLI."""
+        rid = trace.request_id if trace is not None else None
         f1 = self._pool.submit(self._attempt, candidates[0], path, body,
-                               headers, self.forward_timeout_s)
+                               headers, self.forward_timeout_s,
+                               trace=trace, hop=1)
         first_failure = None
+        hedged = False
         try:
             result = f1.result(timeout=hedge_s)
             if result[0] == "ok":
                 return result
             first_failure = result
         except concurrent.futures.TimeoutError:
+            hedged = True
             obs.counter_add("knn_fleet_hedges_total",
                             help="hedged tail reads by outcome",
                             outcome="fired")
+            if trace is not None:
+                trace.event("hedge-fired", slow_replica=candidates[0],
+                            hedge_replica=candidates[1])
+            if self.events is not None:
+                self.events.emit("hedge-fired", request_id=rid,
+                                 slow_replica=candidates[0],
+                                 hedge_replica=candidates[1])
         f2 = self._pool.submit(self._attempt, candidates[1], path, body,
-                               headers, self.forward_timeout_s)
+                               headers, self.forward_timeout_s,
+                               trace=trace, hop=2)
         pending = {f2} if first_failure is not None else {f1, f2}
         last = first_failure
         while pending:
@@ -252,19 +343,51 @@ class RouterApp:
             for fut in done:
                 result = fut.result()
                 if result[0] == "ok":
-                    if fut is f2 and first_failure is None:
+                    if hedged:
+                        won = fut is f2
                         obs.counter_add("knn_fleet_hedges_total",
                                         help="hedged tail reads by "
                                              "outcome",
-                                        outcome="won")
+                                        outcome="won" if won else "lost")
+                        if trace is not None:
+                            trace.event("hedge-won" if won
+                                        else "hedge-lost")
                     for p in pending:
-                        p.cancel()
+                        self._drain_loser(p)
                     return result
                 last = result
         return last
 
+    @staticmethod
+    def _drain_loser(fut) -> None:
+        """The race decided; the other attempt still owns a socket and a
+        worker. ``cancel()`` only helps if it never started — otherwise
+        the attempt runs to completion and its outcome used to vanish.
+        A done-callback consumes the result (``_attempt`` returns, never
+        raises) so the duplicate work is drained, closed, and COUNTED
+        instead of silently discarded."""
+
+        def _consume(f):
+            if f.cancelled():
+                outcome = "cancelled"
+            else:
+                try:
+                    kind = f.result()[0]
+                except Exception:  # noqa: BLE001 — belt for future edits
+                    kind = "transport"
+                outcome = "completed" if kind == "ok" else "failed"
+            obs.counter_add(
+                "knn_fleet_hedge_wasted_total",
+                help="losing hedge attempts by how they ended — the "
+                     "duplicate replica work hedging paid for",
+                outcome=outcome,
+            )
+
+        fut.add_done_callback(_consume)
+        fut.cancel()
+
     def forward_write(self, path: str, body: Optional[bytes],
-                      headers: dict):
+                      headers: dict, trace=None):
         """Route one mutation to the primary — exactly once on the wire.
         Retry policy: only a PROVEN-not-applied failure (the connect was
         refused, so no byte reached the primary) is safe to re-send, and
@@ -272,9 +395,22 @@ class RouterApp:
         failover window — the client (or the soak's writer loop) retries
         after the promote, against a new primary. Anything indeterminate
         (timeout mid-request, connection reset after send) returns a
-        typed 502: re-sending could apply the mutation twice."""
+        typed 502: re-sending could apply the mutation twice.
+
+        Failover-window SLI: the FIRST failover-typed 503 (primary
+        refused, or no usable primary — NOT split brain, which an
+        operator must resolve) arms an onset clock; the first write 200
+        after it observes ``knn_fleet_failover_window_ms`` and stamps a
+        ``failover-window`` audit event — the measured span writes were
+        actually refused, as a client saw it."""
+        rid = (trace.request_id if trace is not None
+               else headers.get("x-request-id"))
+        if trace is not None:
+            trace.phase_start("route")
         primaries = self.set.primaries()  # cheap: no export()/gauge
         # churn on the per-write hot path
+        if trace is not None:
+            trace.phase_end("route")
         if len(primaries) > 1:
             return 503, _json_body({
                 "error": f"split brain: {primaries} both claim primary; "
@@ -283,48 +419,106 @@ class RouterApp:
             }), None
         primary = primaries[0] if primaries else None
         if primary is None:
+            self._arm_failover_onset(rid)
             return 503, _json_body({
                 "error": "no usable primary (failover in progress or "
                          "the fleet is read-only); retry after promote",
                 "down_primary": self.set.down_primary(),
             }), None
+        if trace is not None:
+            trace.phase_start("dispatch")
+            headers = dict(headers, **{"x-knn-hop": "1"})
+        t0 = time.monotonic()
         try:
-            status, raw = guarded_call(
-                "fleet.forward",
-                lambda: forward_bytes("POST", primary + path, body,
-                                      self.forward_timeout_s, headers),
-                attempts=1, classify=False,
-            )
-        except ConnectionRefusedError as e:
-            # Proven never sent: the listener is gone (the drain path
-            # closes it first, a SIGKILL'd process loses it with the
-            # process). Demote now so the failover clock starts.
-            self.set.note_failure(primary, f"ConnectionRefusedError: {e}")
-            self._count_forward(primary, "refused")
-            return 503, _json_body({
-                "error": f"primary {primary} refused the connection; "
-                         f"write not applied — retry after failover",
-            }), primary
-        except Exception as e:  # noqa: BLE001 — indeterminate transport
-            refused = isinstance(getattr(e, "reason", None),
-                                 ConnectionRefusedError)
-            self.set.note_failure(primary, f"{type(e).__name__}: {e}")
-            self._count_forward(primary, "refused" if refused
-                                else "transport_error")
-            if refused:
+            try:
+                status, raw = guarded_call(
+                    "fleet.forward",
+                    lambda: forward_bytes("POST", primary + path, body,
+                                          self.forward_timeout_s,
+                                          headers),
+                    attempts=1, classify=False,
+                )
+            except ConnectionRefusedError as e:
+                # Proven never sent: the listener is gone (the drain
+                # path closes it first, a SIGKILL'd process loses it
+                # with the process). Demote now so the failover clock
+                # starts.
+                self.set.note_failure(primary,
+                                      f"ConnectionRefusedError: {e}",
+                                      request_id=rid)
+                self._count_forward(primary, "refused")
+                self._arm_failover_onset(rid)
+                if trace is not None:
+                    trace.attempt(primary, False,
+                                  (time.monotonic() - t0) * 1e3, hop=1,
+                                  error=f"ConnectionRefusedError: {e}")
                 return 503, _json_body({
-                    "error": f"primary {primary} refused the connection; "
-                             f"write not applied — retry after failover",
+                    "error": f"primary {primary} refused the "
+                             f"connection; write not applied — retry "
+                             f"after failover",
                 }), primary
-            return 502, _json_body({
-                "error": f"write to {primary} failed mid-flight "
-                         f"({type(e).__name__}: {e}); the outcome is "
-                         f"INDETERMINATE — re-read before re-sending "
-                         f"(a blind retry could apply it twice)",
-            }), primary
+            except Exception as e:  # noqa: BLE001 — indeterminate
+                refused = isinstance(getattr(e, "reason", None),
+                                     ConnectionRefusedError)
+                self.set.note_failure(primary, f"{type(e).__name__}: {e}",
+                                      request_id=rid)
+                self._count_forward(primary, "refused" if refused
+                                    else "transport_error")
+                if trace is not None:
+                    trace.attempt(primary, False,
+                                  (time.monotonic() - t0) * 1e3, hop=1,
+                                  error=f"{type(e).__name__}: {e}")
+                if refused:
+                    self._arm_failover_onset(rid)
+                    return 503, _json_body({
+                        "error": f"primary {primary} refused the "
+                                 f"connection; write not applied — "
+                                 f"retry after failover",
+                    }), primary
+                return 502, _json_body({
+                    "error": f"write to {primary} failed mid-flight "
+                             f"({type(e).__name__}: {e}); the outcome "
+                             f"is INDETERMINATE — re-read before "
+                             f"re-sending (a blind retry could apply "
+                             f"it twice)",
+                }), primary
+        finally:
+            if trace is not None:
+                trace.phase_end("dispatch")
         self._count_forward(primary, "ok" if status == 200
                             else f"http_{status}")
+        if trace is not None:
+            trace.attempt(primary, status == 200,
+                          (time.monotonic() - t0) * 1e3, hop=1,
+                          status=status)
+        if status == 200:
+            self._close_failover_window(rid)
         return status, raw, primary
+
+    def _arm_failover_onset(self, rid) -> None:
+        with self._failover_lock:
+            if self._fo_onset is None:
+                self._fo_onset = (time.monotonic(), time.time(), rid)
+
+    def _close_failover_window(self, rid) -> None:
+        """A write succeeded: if a failover-typed 503 opened a window,
+        this 200 closes it — observe the span and stamp the audit event
+        that joins onset request to recovery request."""
+        with self._failover_lock:
+            onset = self._fo_onset
+            self._fo_onset = None
+        if onset is None:
+            return
+        window_ms = round((time.monotonic() - onset[0]) * 1e3, 3)
+        obs.histogram_observe(
+            "knn_fleet_failover_window_ms", window_ms,
+            help="write unavailability span: first failover-typed 503 "
+                 "to the first write 200 after it (as a client saw it)",
+        )
+        if self.events is not None:
+            self.events.emit("failover-window", request_id=rid,
+                             window_ms=window_ms, onset_unix=onset[1],
+                             onset_request_id=onset[2])
 
     def _none_usable(self, kind: str):
         export = self.set.export()
@@ -338,7 +532,8 @@ class RouterApp:
     # -- coordinated admin -------------------------------------------------
 
     def coordinated_reload(self, index: Optional[str],
-                           rollback_to: Optional[str] = None) -> dict:
+                           rollback_to: Optional[str] = None,
+                           request_id: Optional[str] = None) -> dict:
         """Flip every replica's index or none. Sequential prepare/confirm
         over each replica's own validated reload: the Nth failure rolls
         replicas 1..N-1 back to the previous fleet-wide target — the
@@ -356,6 +551,9 @@ class RouterApp:
             raise RouterBusy("a fleet-wide reload or compaction is "
                              "already in progress")
         try:
+            if self.events is not None:
+                self.events.emit("coordinated-reload-begin",
+                                 request_id=request_id, index=index)
             targets = list(self.set.urls)
             # Divergence pre-check over the replicas that ANSWER — an
             # unreachable one is not evidence of divergence (the flip
@@ -388,6 +586,11 @@ class RouterApp:
                                     help="coordinated fleet reloads by "
                                          "outcome",
                                     outcome="rolled_back")
+                    if self.events is not None:
+                        self.events.emit("coordinated-reload-rollback",
+                                         request_id=request_id,
+                                         failed_on=url,
+                                         flipped=list(flipped))
                     return {
                         "status": 502,
                         "body": {
@@ -406,6 +609,11 @@ class RouterApp:
                                 help="coordinated fleet reloads by "
                                      "outcome",
                                 outcome="rolled_back")
+                if self.events is not None:
+                    self.events.emit("coordinated-reload-rollback",
+                                     request_id=request_id,
+                                     reason="divergent versions",
+                                     versions=versions)
                 return {"status": 502, "body": {
                     "error": f"replicas flipped to DIFFERENT versions "
                              f"{versions} — the artifact paths do not "
@@ -417,6 +625,11 @@ class RouterApp:
             obs.counter_add("knn_fleet_reloads_total",
                             help="coordinated fleet reloads by outcome",
                             outcome="ok")
+            if self.events is not None:
+                self.events.emit(
+                    "coordinated-reload-commit", request_id=request_id,
+                    index_version=next(iter(versions.values()), None),
+                    replicas=len(flipped))
             return {"status": 200, "body": {
                 "index_version": next(iter(versions.values()), None),
                 "replicas": len(flipped),
@@ -493,7 +706,8 @@ class RouterApp:
             self._admin_lock.release()
 
     def promote(self, replica: Optional[str] = None,
-                trigger: str = "manual") -> dict:
+                trigger: str = "manual",
+                request_id: Optional[str] = None) -> dict:
         """Promote ``replica`` (default: the most-caught-up usable
         follower) and hand it the surviving peers to ship to. The
         promote call itself is bounded short — it flips a role in place,
@@ -521,6 +735,12 @@ class RouterApp:
         obs.counter_add("knn_fleet_failovers_total",
                         help="promotions the router drove, by trigger",
                         trigger=trigger)
+        if self.events is not None:
+            self.events.emit(
+                "auto-failover" if trigger == "auto" else "promote",
+                request_id=request_id, replica=target,
+                promoted_at_seq=doc.get("promoted_at_seq"),
+                trigger=trigger)
         self.set.poll_once()  # writes resume as soon as the poll sees it
         return {"status": 200, "body": {**doc, "replica": target,
                                         "trigger": trigger}}
@@ -584,7 +804,106 @@ class RouterApp:
             "failovers": self.failovers,
             "reloads": self.reloads,
             "confirmed_index": self._confirmed_index,
+            "flight_recorder": (self.recorder.stats()
+                                if self.recorder is not None else None),
+            "event_log": (self.events.export()
+                          if self.events is not None else None),
+            "access_log": self.access_log is not None,
         }
+
+    # -- fleet observability -----------------------------------------------
+
+    def federated_metrics(self) -> str:
+        """The whole fleet in ONE scrape: every usable replica's registry
+        snapshot merged with a ``{replica=…}`` label (values stay
+        per-replica — the multihost merge machinery, not a lossy
+        pre-sum), the router's own ``knn_fleet_*`` instruments overlaid
+        unlabeled. A replica that fails its scrape is skipped (and
+        counted) — a slow replica must not take /metrics down with it."""
+        snaps = {}
+        for url in self.set.usable_urls():
+            st, doc, _err = self._admin_call(
+                "GET", url + "/metrics?format=json", None,
+                timeout=self.set.poll_timeout_s)
+            ok = st == 200 and isinstance(doc.get("snapshot"), list)
+            obs.counter_add(
+                "knn_fleet_scrape_total",
+                help="federated /metrics scrapes of replica registries "
+                     "by outcome",
+                replica=url, outcome="ok" if ok else "error")
+            if ok:
+                snaps[url] = doc["snapshot"]
+        merged = aggregate.merge_snapshots(snaps, label="replica")
+        # The router's own registry last: its scrape counters above are
+        # in this snapshot, so the scrape self-reports.
+        aggregate.merge_snapshots(
+            {"router": aggregate.snapshot_registry(obs.registry())},
+            merged, label=None)
+        return merged.to_prometheus()
+
+    def fleet_debug(self) -> dict:
+        """The one-stop incident document (``GET /debug/fleet``): the
+        router's own health/routing state joined with each replica's
+        LIVE healthz / capacity / quality documents and the audit-event
+        tail — what an operator would otherwise assemble by hand from
+        3N curls mid-incident."""
+        doc = self.health()
+        live = {}
+        for url in self.set.urls:
+            entry = {}
+            for name, path in (("healthz", "/healthz"),
+                               ("capacity", "/debug/capacity"),
+                               ("quality", "/debug/quality")):
+                st, body, err = self._admin_call(
+                    "GET", url + path, None,
+                    timeout=self.set.poll_timeout_s)
+                entry[name] = (body if st is not None
+                               else {"error": err})
+                if st is not None and st != 200:
+                    entry[name] = {"status": st, **body} \
+                        if isinstance(body, dict) else {"status": st}
+            live[url] = entry
+        doc["live"] = live
+        doc["events"] = (self.events.recent(32)
+                         if self.events is not None else None)
+        return doc
+
+    def stitched_request(self, request_id: str) -> Optional[dict]:
+        """One request's CROSS-TIER story: the router's own timeline for
+        ``request_id`` plus, fetched LIVE from each replica an attempt
+        touched, that replica's timeline for the same id (hedge losers
+        included — their replica-side work is part of the request's
+        cost). Returns ``{"request_id", "router", "replicas": {url:
+        timeline|None}}`` or None when the router never recorded the id
+        (evicted, or traced before the recorder was enabled)."""
+        if self.recorder is None:
+            return None
+        tl = self.recorder.find(request_id)
+        if tl is None:
+            return None
+        replicas: "dict[str, Optional[dict]]" = {}
+        for a in tl.get("attempts", ()):
+            url = a.get("rung")
+            if not url or url in replicas:
+                continue
+            st, doc, _err = self._admin_call(
+                "GET", url + "/debug/requests?id=" + request_id, None,
+                timeout=self.set.poll_timeout_s)
+            reqs = doc.get("requests") if st == 200 else None
+            replicas[url] = reqs[0] if reqs else None
+        return {"request_id": request_id, "router": tl,
+                "replicas": replicas}
+
+    @staticmethod
+    def stitched_to_chrome_trace(stitched: dict) -> dict:
+        """The :meth:`stitched_request` document as one Perfetto trace:
+        the router tier first, then one process per replica that
+        answered — load at ui.perfetto.dev and the tiers line up on the
+        shared wall clock."""
+        tiers = [("router", [stitched["router"]])]
+        for url, tl in stitched["replicas"].items():
+            tiers.append((url, [tl] if tl else []))
+        return reqtrace.stitch_chrome_trace(tiers)
 
 
 def _json_body(doc: dict) -> bytes:
@@ -649,12 +968,76 @@ class _RouterHandler(BaseHTTPRequestHandler):
             h = self.app.health()
             self._send(200 if h["ready"] else 503, h)
         elif route == "/debug/fleet":
-            self._send(200, self.app.health())
+            self._send(200, self.app.fleet_debug())
+        elif route == "/debug/requests":
+            self._do_debug_requests()
+        elif route == "/debug/events":
+            self._do_debug_events()
         elif route == "/metrics":
-            self._send_raw(200, obs.registry().to_prometheus().encode(),
+            self._send_raw(200, self.app.federated_metrics().encode(),
                            "text/plain; version=0.0.4")
         else:
             self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def _do_debug_requests(self) -> None:
+        """The router tier of per-request debugging: no ``id`` lists the
+        router's own recent timelines (serve's contract); ``?id=`` goes
+        CROSS-TIER — the router timeline joined with the answering (and
+        hedge-losing) replicas' timelines for the same request_id,
+        fetched live; ``&format=perfetto`` renders the stitched trace
+        with one Perfetto process per tier."""
+        rec = self.app.recorder
+        if rec is None:
+            self._send(404, {"error": "request tracing is disabled "
+                                      "(--flight-recorder-size 0)"})
+            return
+        q = parse_qs(urlparse(self.path).query)
+        fmt = q.get("format", ["json"])[0]
+        if fmt not in ("json", "perfetto"):
+            self._send(400, {"error": f"bad format={fmt!r}: want json "
+                                      f"or perfetto"})
+            return
+        rid = q.get("id", [None])[0]
+        if rid is not None:
+            stitched = self.app.stitched_request(rid)
+            if stitched is None:
+                self._send(404, {"error": f"request_id {rid!r} not in "
+                                          f"the router's flight "
+                                          f"recorder (evicted or never "
+                                          f"traced)"})
+                return
+            if fmt == "perfetto":
+                self._send(200,
+                           self.app.stitched_to_chrome_trace(stitched))
+            else:
+                self._send(200, stitched)
+            return
+        try:
+            n = int(q["n"][0]) if "n" in q else None
+        except ValueError:
+            self._send(400, {"error": f"bad n={q['n'][0]!r}: want an "
+                                      f"integer"})
+            return
+        timelines = rec.recent(n)
+        if fmt == "perfetto":
+            self._send(200, rec.to_chrome_trace(timelines))
+        else:
+            self._send(200, {"requests": timelines, **rec.stats()})
+
+    def _do_debug_events(self) -> None:
+        ev = self.app.events
+        if ev is None:
+            self._send(404, {"error": "the fleet event audit log is "
+                                      "disabled (--event-log)"})
+            return
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            n = int(q["n"][0]) if "n" in q else None
+        except ValueError:
+            self._send(400, {"error": f"bad n={q['n'][0]!r}: want an "
+                                      f"integer"})
+            return
+        self._send(200, {"events": ev.recent(n), **ev.export()})
 
     def do_POST(self):  # noqa: N802 — stdlib dispatch name
         if not self._begin():
@@ -670,16 +1053,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
         cls = self.headers.get("x-knn-class")
         if cls is not None:
             headers["x-knn-class"] = cls
+        trace = self._new_trace(route)
         try:
             if route in ("/predict", "/kneighbors"):
                 status, raw, replica = self.app.forward_read(
-                    route, body, headers)
-                self._note(route, status, replica)
+                    route, body, headers, trace=trace)
+                self._note(route, status, replica, trace)
                 self._send_raw(status, raw)
             elif route in ("/insert", "/delete"):
                 status, raw, replica = self.app.forward_write(
-                    route, body, headers)
-                self._note(route, status, replica)
+                    route, body, headers, trace=trace)
+                self._note(route, status, replica, trace)
                 self._send_raw(status, raw)
             elif route == "/admin/promote":
                 self._do_admin(body, self._admin_promote)
@@ -693,15 +1077,69 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                           f"{self.path}"})
         except Exception as e:  # noqa: BLE001 — the router's last line:
             # typed JSON for EVERY terminal outcome, never a traceback.
+            if trace is not None and not trace.finished:
+                trace.annotate(error=f"{type(e).__name__}: {e}")
+                trace.finish("error")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-    def _note(self, route: str, status: int, replica) -> None:
+    def _new_trace(self, route: str):
+        """The router's own timeline for one forwarded request — created
+        when EITHER consumer exists (the flight recorder, or the access
+        log, whose line is derived from the finished trace). Row count
+        is 0: the body is opaque bytes here; the replica's timeline
+        carries the real shape."""
+        if route not in ("/predict", "/kneighbors", "/insert",
+                         "/delete"):
+            return None
+        app = self.app
+        if app.recorder is not None:
+            return app.recorder.new_trace(route.lstrip("/"), 0,
+                                          request_id=self._rid)
+        if app.access_log is not None:
+            return reqtrace.RequestTrace(route.lstrip("/"), 0,
+                                         request_id=self._rid)
+        return None
+
+    def _note(self, route: str, status: int, replica,
+              trace=None) -> None:
         obs.counter_add(
             "knn_fleet_router_requests_total",
             help="client requests answered by the router, by endpoint "
                  "and status",
             endpoint=route, status=str(status),
         )
+        if trace is None:
+            return
+        trace.annotate(status=status, replica=replica)
+        if not trace.finished:
+            trace.finish("ok" if status == 200 else f"http_{status}")
+        log = self.app.access_log
+        if log is not None:
+            tl = trace.to_dict()
+            entry = {
+                "ts": round(time.time(), 6),
+                "request_id": self._rid,
+                "kind": route.lstrip("/"),
+                "status": status,
+                "outcome": tl["outcome"],
+                "ms": tl["request_ms"],
+                "replica": replica,
+                "replicas_tried": len({a["rung"]
+                                       for a in tl["attempts"]}),
+                "hedged": any(e["event"] == "hedge-fired"
+                              for e in tl["events"]),
+            }
+            phases: dict = {}
+            for p in tl["phases"]:
+                phases[p["phase"]] = round(
+                    phases.get(p["phase"], 0.0) + (p["ms"] or 0.0), 3)
+            entry["phases"] = phases
+            if tl["attempts"]:
+                entry["attempts"] = [
+                    f"{a['rung']}:{'ok' if a['ok'] else a.get('error', 'fail')}"
+                    for a in tl["attempts"]
+                ]
+            log.write(entry)
 
     def _do_admin(self, body: bytes, fn) -> None:
         try:
@@ -719,11 +1157,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self._send(result["status"], result["body"])
 
     def _admin_promote(self, doc: dict) -> dict:
-        return self.app.promote(doc.get("replica"), trigger="manual")
+        return self.app.promote(doc.get("replica"), trigger="manual",
+                                request_id=self._rid)
 
     def _admin_reload(self, doc: dict) -> dict:
         return self.app.coordinated_reload(doc.get("index"),
-                                           doc.get("rollback_to"))
+                                           doc.get("rollback_to"),
+                                           request_id=self._rid)
 
     def _admin_compact(self, doc: dict) -> dict:
         return self.app.coordinated_compact(doc.get("replica"))

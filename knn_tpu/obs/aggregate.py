@@ -72,18 +72,26 @@ def snapshot_registry(registry: Optional[MetricsRegistry] = None) -> List[dict]:
 
 
 def merge_snapshots(
-    snapshots: Dict[int, List[dict]],
+    snapshots: Dict,
     registry: Optional[MetricsRegistry] = None,
+    label: Optional[str] = "proc",
 ) -> MetricsRegistry:
-    """Fold per-process snapshots into one registry, adding ``proc=<id>``
-    to every label set. Values stay per-process (a counter from proc 1
-    never adds into proc 0's) — fleet-level sums are a query over the
-    merged registry, not a lossy pre-aggregation."""
+    """Fold per-source snapshots into one registry, adding
+    ``<label>=<source key>`` to every label set. Values stay per-source
+    (a counter from proc 1 never adds into proc 0's) — fleet-level sums
+    are a query over the merged registry, not a lossy pre-aggregation.
+
+    ``label`` names the attribution key: ``"proc"`` for multihost
+    processes (the original use), ``"replica"`` for the fleet router's
+    federated ``/metrics`` scrape (snapshot keys are replica base URLs).
+    ``label=None`` folds records with their labels unchanged — how the
+    router overlays its OWN registry into the same merged document."""
     reg = registry if registry is not None else MetricsRegistry()
     for proc in sorted(snapshots):
         for rec in snapshots[proc]:
             labels = dict(rec["labels"])
-            labels["proc"] = str(proc)
+            if label is not None:
+                labels[label] = str(proc)
             help_ = rec.get("help", "")
             if rec["kind"] == "counter":
                 reg.counter(rec["name"], help=help_, **labels).add(

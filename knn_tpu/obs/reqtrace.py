@@ -278,53 +278,8 @@ class FlightRecorder:
         epoch = min(t.get("start_unix", 0.0) for t in timelines)
         events: List[dict] = []
         for tid, tl in enumerate(timelines, start=1):
-            base_us = (tl.get("start_unix", epoch) - epoch) * 1e6
-            common = {"cat": "knn_tpu.request", "pid": 1, "tid": tid}
-            events.append({
-                "ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
-                "args": {"name": f"req {tl['request_id']}"},
-            })
-            args = {
-                "request_id": tl["request_id"], "kind": tl.get("kind"),
-                "rows": tl.get("rows"), "outcome": tl.get("outcome"),
-                "rung": tl.get("rung"),
-            }
-            # Cost attribution (obs/accounting.py), when the layer is on:
-            # what this request paid rides its Perfetto track too.
-            for extra in ("request_class", "cost"):
-                if extra in tl:
-                    args[extra] = tl[extra]
-            events.append(dict(common, ph="B", name=f"request:{tl.get('outcome')}",
-                               ts=base_us, args=args))
-            for p in tl.get("phases", ()):
-                b = base_us + p["start_ms"] * 1e3
-                events.append(dict(common, ph="B", name=p["phase"], ts=b))
-                events.append(dict(common, ph="E", name=p["phase"],
-                                   ts=b + (p["ms"] or 0.0) * 1e3))
-            # Attempts have no recorded start offset; stack them inside
-            # the dispatch phase in order, back to back.
-            disp = next((p for p in tl.get("phases", ())
-                         if p["phase"] == "dispatch"), None)
-            if disp is not None:
-                cursor = base_us + disp["start_ms"] * 1e3
-                for a in tl.get("attempts", ()):
-                    events.append(dict(
-                        common, ph="B", name=f"attempt:{a['rung']}",
-                        ts=cursor, args={k: v for k, v in a.items()},
-                    ))
-                    cursor += a["ms"] * 1e3
-                    events.append(dict(common, ph="E",
-                                       name=f"attempt:{a['rung']}", ts=cursor))
-            for ev in tl.get("events", ()):
-                events.append(dict(
-                    common, ph="i", s="t", name=ev["event"],
-                    ts=base_us + ev["at_ms"] * 1e3,
-                    args={k: v for k, v in ev.items()},
-                ))
-            events.append(dict(
-                common, ph="E", name=f"request:{tl.get('outcome')}",
-                ts=base_us + (tl.get("request_ms") or 0.0) * 1e3,
-            ))
+            events.extend(timeline_trace_events(tl, pid=1, tid=tid,
+                                                epoch=epoch))
         return events
 
     def to_chrome_trace(self, timelines: Optional[List[dict]] = None) -> dict:
@@ -336,6 +291,115 @@ class FlightRecorder:
             "otherData": {"producer": "knn_tpu.obs.reqtrace",
                           "requests": len(timelines)},
         }
+
+
+# ---------------------------------------------------------------------------
+# Timeline -> trace_event rendering, shared by the in-process recorder and
+# the router's cross-tier stitcher (which only ever holds timeline DICTS —
+# the replica side of a stitched trace arrives over HTTP from the replica's
+# own /debug/requests, not as live RequestTrace objects).
+
+
+def timeline_trace_events(tl: dict, *, pid: int = 1, tid: int = 1,
+                          epoch: Optional[float] = None) -> List[dict]:
+    """One finished timeline dict (:meth:`RequestTrace.to_dict` shape) as
+    Chrome ``trace_event`` records on track ``(pid, tid)``: the request
+    envelope and phases as matched B/E pairs, attempts stacked back to
+    back inside the ``dispatch`` phase, events as instants. ``epoch`` is
+    the shared wall-clock origin (``start_unix`` seconds) timestamps are
+    offset against; defaults to this timeline's own start."""
+    if epoch is None:
+        epoch = tl.get("start_unix", 0.0)
+    base_us = (tl.get("start_unix", epoch) - epoch) * 1e6
+    common = {"cat": "knn_tpu.request", "pid": pid, "tid": tid}
+    events: List[dict] = [{
+        "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+        "args": {"name": f"req {tl['request_id']}"},
+    }]
+    args = {
+        "request_id": tl["request_id"], "kind": tl.get("kind"),
+        "rows": tl.get("rows"), "outcome": tl.get("outcome"),
+        "rung": tl.get("rung"),
+    }
+    # Cost attribution (obs/accounting.py), when the layer is on:
+    # what this request paid rides its Perfetto track too.
+    for extra in ("request_class", "cost"):
+        if extra in tl:
+            args[extra] = tl[extra]
+    events.append(dict(common, ph="B", name=f"request:{tl.get('outcome')}",
+                       ts=base_us, args=args))
+    for p in tl.get("phases", ()):
+        b = base_us + p["start_ms"] * 1e3
+        events.append(dict(common, ph="B", name=p["phase"], ts=b))
+        events.append(dict(common, ph="E", name=p["phase"],
+                           ts=b + (p["ms"] or 0.0) * 1e3))
+    # Attempts have no recorded start offset; stack them inside
+    # the dispatch phase in order, back to back.
+    disp = next((p for p in tl.get("phases", ())
+                 if p["phase"] == "dispatch"), None)
+    if disp is not None:
+        cursor = base_us + disp["start_ms"] * 1e3
+        for a in tl.get("attempts", ()):
+            events.append(dict(
+                common, ph="B", name=f"attempt:{a['rung']}",
+                ts=cursor, args={k: v for k, v in a.items()},
+            ))
+            cursor += a["ms"] * 1e3
+            events.append(dict(common, ph="E",
+                               name=f"attempt:{a['rung']}", ts=cursor))
+    for ev in tl.get("events", ()):
+        events.append(dict(
+            common, ph="i", s="t", name=ev["event"],
+            ts=base_us + ev["at_ms"] * 1e3,
+            args={k: v for k, v in ev.items()},
+        ))
+    events.append(dict(
+        common, ph="E", name=f"request:{tl.get('outcome')}",
+        ts=base_us + (tl.get("request_ms") or 0.0) * 1e3,
+    ))
+    return events
+
+
+def stitch_trace_events(tiers: List[tuple]) -> List[dict]:
+    """Cross-tier stitch: ``tiers`` is an ordered list of ``(tier_name,
+    [timeline dicts])`` — e.g. ``[("router", [router_tl]),
+    ("http://r2:8099", [replica_tl])]``. Each tier becomes one Perfetto
+    PROCESS (pid, named by the tier), each timeline one track inside it,
+    all offset onto one shared wall-clock epoch — so a request's router
+    dispatch and the replica work it forwarded to line up vertically.
+
+    Clock caveat: ``start_unix`` is each process's own ``time.time()``;
+    cross-host skew shifts whole tracks against each other (same-host
+    fleets — the soak topology — line up to NTP noise). Durations within
+    a track are monotonic-clock true regardless."""
+    all_tls = [tl for _, tls in tiers for tl in tls if tl]
+    if not all_tls:
+        return []
+    epoch = min(tl.get("start_unix", 0.0) for tl in all_tls)
+    events: List[dict] = []
+    for pid, (tier, tls) in enumerate(tiers, start=1):
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "args": {"name": str(tier)}})
+        for tid, tl in enumerate(tls, start=1):
+            if not tl:
+                continue
+            events.extend(timeline_trace_events(tl, pid=pid, tid=tid,
+                                                epoch=epoch))
+    return events
+
+
+def stitch_chrome_trace(tiers: List[tuple]) -> dict:
+    """The stitched tiers as a complete Chrome/Perfetto trace document
+    (load at ui.perfetto.dev) — the router's ``/debug/requests?id=...&
+    format=perfetto`` payload and the fleet soak's CI artifact."""
+    return {
+        "traceEvents": stitch_trace_events(tiers),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "knn_tpu.obs.reqtrace",
+            "tiers": [str(name) for name, _ in tiers],
+        },
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -891,6 +891,19 @@ class _Handler(BaseHTTPRequestHandler):
                 # deferred auto-stop finalization (a timed window whose
                 # traffic ceased finalizes on the next scrape).
                 self.app.workload.export()
+            q = parse_qs(urlparse(self.path).query)
+            if q.get("format", [None])[0] == "json":
+                # The machine-readable scrape: this registry as a raw
+                # snapshot (exact histogram bucket counts) — what the
+                # fleet router's federated /metrics merges per-replica
+                # (obs/aggregate.py), same shape the multihost gather
+                # ships.
+                from knn_tpu.obs import aggregate
+
+                self._send(200,
+                           {"snapshot": aggregate.snapshot_registry()},
+                           tag_request_id=False)
+                return
             accept = self.headers.get("Accept", "")
             if "application/openmetrics-text" in accept:
                 self._send_text(
@@ -1605,6 +1618,16 @@ class _Handler(BaseHTTPRequestHandler):
                                                 request_id=self._rid)
             if deadline_ms is not None:
                 trace.annotate(deadline_ms=deadline_ms)
+            hop = self.headers.get("x-knn-hop")
+            if hop is not None:
+                # Cross-tier linkage: WHICH router attempt (first try,
+                # retry, hedge) this replica-side timeline belongs to —
+                # what lets a stitched trace pair each router attempt
+                # slice with the replica work it caused.
+                try:
+                    trace.annotate(upstream_attempt=int(hop))
+                except ValueError:
+                    pass  # a garbled hop header must never fail a read
         try:
             handle = self.app.batcher.submit(x, kind, deadline_ms=deadline_ms,
                                              trace=trace,
@@ -1659,6 +1682,17 @@ class _Handler(BaseHTTPRequestHandler):
             # acknowledged mutations this answer reflects (what the
             # mutable soak's oracle replay verifies against).
             payload["mutation_seq"] = meta["mutation_seq"]
+            fleet = self.app.fleet
+            if fleet is not None:
+                # Read-staleness annotation: a follower that has SEEN
+                # primary seq N but only applied seq M < N is serving an
+                # answer N-M writes behind — the client-visible face of
+                # the replication-lag SLI (0 / primary reads omit it).
+                stale = fleet.staleness_seq()
+                if stale > 0:
+                    payload["staleness_seq"] = stale
+                    if trace is not None:
+                        trace.annotate(staleness_seq=stale)
         self._send(200, payload)
         self._account(kind, 200, "ok", t0, trace=trace,
                       rung=meta.get("rung"), rows=rows,

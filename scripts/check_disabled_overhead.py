@@ -255,6 +255,46 @@ def main() -> int:
     print("disabled-overhead: devprof off-state ok (compile listener, "
           "memory sample, cache tracker all recorded nothing)")
 
+    # -- 1c. the fleet router without flags (PR 16) ------------------------
+    # A router booted with no --event-log / --access-log must construct
+    # ZERO fleet-observability machinery: no FleetEventLog (no ring, no
+    # file handle), no AccessLog (the serve module must not even be
+    # imported for it), no hedge-pool worker threads before a first
+    # forward. This runs AFTER the plain-serve fleet sys.modules
+    # assertions above — importing the router here is the opted-in path.
+    from knn_tpu.fleet.router import RouterApp
+
+    router = RouterApp(["http://127.0.0.1:9"],  # port 9: never listening
+                       health_interval_s=3600.0, poll_timeout_s=0.2)
+    try:
+        if router.events is not None:
+            return fail("RouterApp built a fleet event log with no "
+                        "--event-log — the audit layer must not exist "
+                        "while disabled")
+        if router.access_log is not None:
+            return fail("RouterApp built an access log with no "
+                        "--access-log")
+        if router.recorder is None:
+            return fail("RouterApp dropped its default flight recorder "
+                        "(the serve parity contract: tracing is on, "
+                        "bounded, --flight-recorder-size 0 disables)")
+        if router._pool._threads:
+            return fail(f"{len(router._pool._threads)} hedge-pool "
+                        f"thread(s) started before any forward — the "
+                        f"pool must stay lazy")
+        if router.set.events is not None:
+            return fail("the health poller holds an event log while "
+                        "disabled")
+    finally:
+        router.close()
+    leaked = [i.name for i in obs.registry().instruments()
+              if i.name.startswith("knn_fleet_")]
+    if leaked:
+        return fail(f"router off-state recorded fleet instrument(s) "
+                    f"with obs disabled: {leaked}")
+    print("disabled-overhead: router off-state ok (no event log, no "
+          "access log, lazy hedge pool, zero instruments)")
+
     # -- 2. timing: best-of mins under the budget --------------------------
     # Measured WITH a cost-accounting-enabled ServeApp alive (PR 8) AND a
     # workload-capture window armed (PR 11): both layers live entirely on

@@ -31,6 +31,16 @@ takeover seq is truncated, it catches up over wal-append (digest-checked
 overlap, no divergence), lag drains, and a read served directly by the
 rejoined replica replays bit-identical.
 
+**Forensics — the observability plane audits the incident** (after
+phase 3, same router). Sampled 200 reads must resolve via router
+``GET /debug/requests?id=`` to ONE stitched cross-tier timeline whose
+router-side phases sum to ~the router-observed wall, linked to the
+answering replica's timeline for the same id; the audit log's
+failover-window event must agree with the client-measured 503 span;
+``knn_fleet_replication_lag_seq`` must be back to 0 fleet-wide after
+the rejoin; the stitched Perfetto export lands in ``build/`` as the CI
+artifact.
+
 **Phase 4 — coordinated reload under a crash-stop.** A fresh immutable
 3-replica fleet (hot reload is the immutable-serving operation — the
 mutable tier owns its own artifact lifecycle). One replica is
@@ -206,6 +216,11 @@ class FleetLoad:
         self.acked: list = []        # (seq, rows) the client got a 200 for
         self.writes_ok = 0
         self.writes_503 = 0
+        # Client-observed failover window: first typed 503 -> first 200
+        # after it. The router's own failover-window SLI must agree with
+        # this independent measurement (the forensics phase checks).
+        self.first_503_t = None
+        self.first_ok_after_503_t = None
         self.write_failures: list = []
         self.versions_seen: set = set()
         self.threads: list = []
@@ -242,6 +257,9 @@ class FleetLoad:
                 with self.lock:
                     self.writes_ok += 1
                     self.acked.append((doc["seq"], rows))
+                    if (self.first_503_t is not None
+                            and self.first_ok_after_503_t is None):
+                        self.first_ok_after_503_t = time.monotonic()
             elif st == 503:
                 # The typed failover window / replication-ack timeout.
                 # An applied-but-unconfirmed 503 is NOT an ack: the
@@ -250,6 +268,8 @@ class FleetLoad:
                 if self._typed_or_fail(body, "write 503") is not None:
                     with self.lock:
                         self.writes_503 += 1
+                        if self.first_503_t is None:
+                            self.first_503_t = time.monotonic()
                 time.sleep(0.05)
             elif st in (429, 502):
                 self._typed_or_fail(body, f"write {st}")
@@ -400,11 +420,16 @@ def main() -> int:
         if None in (b1, b2, b3):
             return fail(f"replica boot failed (ready: r1={b1}, r2={b2}, "
                         f"r3={b3})")
+        build_dir = REPO / "build"
+        build_dir.mkdir(exist_ok=True)
+        event_log_path = build_dir / "fleet-soak-events.jsonl"
+        event_log_path.unlink(missing_ok=True)
         router_proc, router_lines = spawn(
             ["route", url["r1"], url["r2"], url["r3"],
              "--port", str(pr), "--health-interval-s", "0.25",
              "--auto-failover", "on", "--failover-after-s", "1.0",
-             "--hedge-ms", "auto"], env)
+             "--hedge-ms", "auto",
+             "--event-log", str(event_log_path)], env)
         router = wait_ready(router_proc, router_lines, "router")
         if router is None:
             return fail(f"router boot failed (rc={router_proc.poll()})")
@@ -495,6 +520,14 @@ def main() -> int:
             writes_at_promote = load.writes_ok
         time.sleep(args.window_s / 3)
         load.finish()
+        # The client-observed failover window (first typed 503 -> first
+        # 200 after it), kept for the forensics phase to reconcile
+        # against the router's own failover-window audit event.
+        client_window_s = None
+        if (load.first_503_t is not None
+                and load.first_ok_after_503_t is not None):
+            client_window_s = (load.first_ok_after_503_t
+                               - load.first_503_t)
         if load.read_failures:
             return fail(f"phase-2 failed reads during primary failover: "
                         f"{load.read_failures[:3]}")
@@ -578,6 +611,141 @@ def main() -> int:
               f"follower, caught up to seq "
               f"{report['phase3']['rejoined_seq']} with no divergence, "
               f"reads bit-identical")
+
+        # ---- forensics: the observability plane audits the incident ------
+        # The router lived through the whole primary-loss incident. Its
+        # observability plane must now tell the story back, and the story
+        # must agree with what the load harness measured independently:
+        #   (a) a sampled 200 read resolves via GET /debug/requests?id=
+        #       to ONE stitched cross-tier timeline whose router-side
+        #       phases sum to ~the router-observed wall, linked to the
+        #       answering replica's own timeline for the SAME id;
+        #   (b) the audit log's failover-window SLI agrees with the
+        #       client-measured 503 span;
+        #   (c) replication lag (knn_fleet_replication_lag_seq) is back
+        #       to 0 fleet-wide after the rejoin catch-up;
+        #   (d) the stitched Perfetto export lands in build/ for CI.
+        import urllib.request as _rq
+
+        def traced_read(rid: str):
+            req = _rq.Request(
+                router + "/kneighbors",
+                data=json.dumps({"instances":
+                                 test.features[:args.rows].tolist()}
+                                ).encode(),
+                headers={"Content-Type": "application/json",
+                         "x-request-id": rid})
+            with _rq.urlopen(req, timeout=60) as r:
+                return r.status, r.read().decode()
+
+        stitched_docs = []
+        for i in range(3):
+            rid = f"soak-forensic-{i:02d}"
+            st, body = traced_read(rid)
+            if st != 200:
+                return fail(f"forensics: traced read {rid} got {st}: "
+                            f"{body[:200]}")
+            st, body = http(router, f"/debug/requests?id={rid}")
+            if st != 200:
+                return fail(f"forensics: /debug/requests?id={rid} -> "
+                            f"{st}: {body[:300]}")
+            doc = json.loads(body)
+            tl = doc["router"]
+            if tl["request_id"] != rid or tl["outcome"] != "ok":
+                return fail(f"forensics: router timeline for {rid} is "
+                            f"wrong: {json.dumps(tl)[:300]}")
+            wall = tl["request_ms"]
+            phase_sum = sum(p["ms"] or 0.0 for p in tl["phases"])
+            if abs(wall - phase_sum) > max(0.25 * wall, 20.0):
+                return fail(f"forensics: {rid}: router phases sum to "
+                            f"{phase_sum:.3f} ms but the router observed "
+                            f"a {wall:.3f} ms wall — the timeline has a "
+                            f"hole")
+            answered = [u for u, r_tl in doc["replicas"].items()
+                        if r_tl is not None
+                        and r_tl.get("request_id") == rid]
+            if not answered:
+                return fail(f"forensics: {rid}: no replica timeline "
+                            f"stitched in — the cross-tier link is "
+                            f"broken ({json.dumps(doc)[:300]})")
+            stitched_docs.append((rid, doc))
+
+        # (d) the Perfetto render of the first sampled read: one process
+        # track per tier, saved as the CI artifact.
+        rid0 = stitched_docs[0][0]
+        st, body = http(router,
+                        f"/debug/requests?id={rid0}&format=perfetto")
+        if st != 200:
+            return fail(f"forensics: perfetto export -> {st}")
+        trace_doc = json.loads(body)
+        pids = {e["pid"] for e in trace_doc.get("traceEvents", [])}
+        if len(pids) < 2:
+            return fail(f"forensics: the stitched Perfetto trace has "
+                        f"{len(pids)} process track(s) — want the router "
+                        f"AND at least one replica tier")
+        trace_path = build_dir / "fleet-soak-trace.json"
+        trace_path.write_text(json.dumps(trace_doc) + "\n")
+
+        # (b) the audit log vs the client's stopwatch.
+        st, body = http(router, "/debug/events")
+        if st != 200:
+            return fail(f"forensics: /debug/events -> {st}: {body[:200]}")
+        events_doc = json.loads(body)
+        windows = [e for e in events_doc["events"]
+                   if e["event"] == "failover-window"]
+        if not windows:
+            return fail("forensics: no failover-window audit event — "
+                        "phase 2's incident left no trace in the log")
+        audit_window_s = windows[0]["window_ms"] / 1e3
+        if client_window_s is None:
+            return fail("forensics: the load harness never bracketed the "
+                        "503 window (no 503 or no recovery 200 observed)")
+        if abs(audit_window_s - client_window_s) > max(
+                2.0, 0.5 * client_window_s):
+            return fail(f"forensics: the audit log claims a "
+                        f"{audit_window_s:.2f}s failover window but the "
+                        f"client measured {client_window_s:.2f}s — the "
+                        f"SLI is lying")
+        promotes = [e for e in events_doc["events"]
+                    if e["event"] in ("promote", "auto-failover")]
+        if not promotes:
+            return fail("forensics: the promote left no audit event")
+        if not event_log_path.exists() or not event_log_path.stat().st_size:
+            return fail(f"forensics: --event-log {event_log_path} was "
+                        f"never written")
+
+        # (c) replication lag is back to 0 fleet-wide. /healthz refreshes
+        # the router's lag gauges from the live role/seq documents; the
+        # federated /metrics then carries every tier's copy.
+        def lag_drained():
+            healthz(router)
+            with _rq.urlopen(router + "/metrics", timeout=30) as r:
+                text = r.read().decode()
+            import re
+            vals = [float(m) for m in re.findall(
+                r'knn_fleet_replication_lag_seq\{[^}]*\}\s+([0-9.e+-]+)',
+                text)]
+            return (vals and all(v == 0.0 for v in vals), len(vals))
+
+        drained = wait_until(lambda: lag_drained()[0], timeout_s=30)
+        if not drained:
+            ok, n = lag_drained()
+            return fail(f"forensics: knn_fleet_replication_lag_seq never "
+                        f"drained to 0 fleet-wide after the rejoin "
+                        f"({n} samples)")
+        report["forensics"] = {
+            "stitched_reads": len(stitched_docs),
+            "audit_failover_window_s": round(audit_window_s, 3),
+            "client_failover_window_s": round(client_window_s, 3),
+            "trace_artifact": str(trace_path),
+            "event_log": str(event_log_path),
+        }
+        print(f"fleet-soak: forensics ok — {len(stitched_docs)} reads "
+              f"resolve to stitched cross-tier timelines (phase sums "
+              f"match walls); audit failover window "
+              f"{audit_window_s:.2f}s vs client {client_window_s:.2f}s; "
+              f"replication lag drained to 0; Perfetto artifact at "
+              f"{trace_path}")
 
         # Tear the mutable fleet down before phase 4.
         for name in ("r1", "r2", "r3"):

@@ -708,10 +708,21 @@ class TestServerSLOAndAccessLog:
               headers={"x-request-id": "log-ok"})
         _post(base, "/predict", {"rows": "bad"},
               headers={"x-request-id": "log-bad"})
-        app.access_log._file.flush()
-        entries = [json.loads(ln) for ln in
-                   log_path.read_text().splitlines()]
-        by_id = {e["request_id"]: e for e in entries}
+        # The handler writes its line AFTER the response goes out, so the
+        # client can observe the response before the line lands — poll
+        # (bounded) instead of reading once.
+        import time as _time
+
+        by_id = {}
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            app.access_log._file.flush()
+            entries = [json.loads(ln) for ln in
+                       log_path.read_text().splitlines()]
+            by_id = {e["request_id"]: e for e in entries}
+            if {"log-ok", "log-bad"} <= by_id.keys():
+                break
+            _time.sleep(0.01)
         ok = by_id["log-ok"]
         assert (ok["status"], ok["outcome"], ok["kind"], ok["rows"]) == \
             (200, "ok", "predict", 2)
@@ -746,7 +757,16 @@ class TestServerSLOAndAccessLog:
             t = threading.Thread(target=park, daemon=True)
             t.start()
             import time as _time
+            # Wait for the parked row to actually be QUEUED before
+            # probing: if the 2-row probe wins admission first, it is the
+            # PARK request that gets rejected (2+1 > bound), the park
+            # thread exits, and no probe can ever overflow an empty
+            # queue — the race this test flaked on under full-suite load.
             deadline = _time.monotonic() + 30
+            while (_time.monotonic() < deadline
+                   and app.batcher.pending_rows() == 0):
+                _time.sleep(0.005)
+            assert app.batcher.pending_rows() == 1
             st = None
             while _time.monotonic() < deadline:
                 st, _, body = _post(
@@ -756,7 +776,17 @@ class TestServerSLOAndAccessLog:
                     break
                 _time.sleep(0.01)
             assert st == 429
-            assert app.slo.burn_rates()["availability"]["1m"] > 0
+            # The SLO record lands on the handler thread AFTER the 429
+            # response goes out (_account keeps bookkeeping off the hot
+            # path) — poll, bounded, instead of asserting instantly.
+            burn = 0.0
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline:
+                burn = app.slo.burn_rates()["availability"]["1m"]
+                if burn > 0:
+                    break
+                _time.sleep(0.01)
+            assert burn > 0
         finally:
             server.shutdown()
             server.server_close()

@@ -663,6 +663,15 @@ class TestServer:
 
             t = threading.Thread(target=park)
             t.start()
+            # Wait for the parked row to actually be QUEUED: if the 2-row
+            # probe wins admission first, the PARK request is the one
+            # rejected (2+1 > bound) and no later probe can overflow an
+            # empty queue — the race this test flaked on under load.
+            parked_by = time.monotonic() + 10
+            while (time.monotonic() < parked_by
+                   and app.batcher.pending_rows() == 0):
+                time.sleep(0.005)
+            assert app.batcher.pending_rows() == 1
             deadline = time.monotonic() + 10
             while time.monotonic() < deadline:
                 st, body = _post(base, "/predict", {
