@@ -433,6 +433,17 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    help="how long a mutation waits for the follower ack "
                    "before returning the typed 503 applied-but-"
                    "unconfirmed outcome")
+    p.add_argument("--bootstrap", choices=["auto", "off"], default="auto",
+                   help="with --follower-of over a BLANK index directory: "
+                   "'auto' (default) pulls the primary's current "
+                   "generation over the chunked, digest-verified "
+                   "/admin/snapshot transfer before boot — 'add a "
+                   "replica under live traffic' is one command; the WAL "
+                   "shipper then catches the replica up from the "
+                   "installed cursor. 'off' restores the old typed "
+                   "refusal on a missing artifact. An EXISTING artifact "
+                   "is never overwritten at boot (a stale replica "
+                   "re-seeds through POST /admin/bootstrap instead)")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -989,6 +1000,27 @@ def _run_serve(args, stdout) -> int:
                   f"URLs, got {args.replicate_to!r}", file=sys.stderr)
             return EXIT_USAGE
     mutable_on = args.mutable == "on"
+    if args.follower_of is not None and args.bootstrap == "auto":
+        # Snapshot bootstrap (docs/SERVING.md §Adding a replica under
+        # live traffic): a blank index directory + --follower-of means
+        # this process is JOINING the fleet — pull the primary's current
+        # generation over the chunked, digest-verified /admin/snapshot
+        # transfer before anything else boots. An existing artifact is
+        # never touched here (a stale replica re-seeds through POST
+        # /admin/bootstrap, where abandoning a lineage is explicit).
+        from knn_tpu.fleet import bootstrap as _bootstrap
+        from knn_tpu.resilience.errors import DataError as _DataError
+
+        if not _bootstrap.artifact_present(args.index):
+            try:
+                doc = _bootstrap.install_snapshot(args.index,
+                                                  args.follower_of)
+            except (_DataError, OSError) as e:
+                print(f"error: snapshot bootstrap from "
+                      f"{args.follower_of} failed: {e}", file=sys.stderr)
+                return EXIT_USAGE
+            print(f"knn-tpu serve: {_bootstrap.summary_line(doc)}",
+                  file=sys.stderr, flush=True)
     if args.follower_of is not None:
         # Rejoin reconciliation (docs/SERVING.md §Running a replica
         # set): BEFORE the engine replays this artifact's WAL, drop the

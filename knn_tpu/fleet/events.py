@@ -17,7 +17,13 @@ typed events plus an optional JSONL file (``--event-log``), exposed at
   carrying the measured typed-503 span in ms;
 - ``hedge-fired``                  — a tail read's backup attempt was
   launched;
-- ``coordinated-reload-begin`` / ``-commit`` / ``-rollback``.
+- ``coordinated-reload-begin`` / ``-commit`` / ``-rollback``;
+- ``reseed-begin`` / ``reseed-complete`` / ``reseed-failed`` — the
+  router drove a snapshot bootstrap on a parked follower (the
+  self-healing leg; ``trigger`` distinguishes auto from operator);
+- ``epoch-retention-hold``         — a coordinated compaction reported
+  deferring WAL epoch pruning because a live follower's cursor still
+  needs those records (the retention floor).
 
 Every event is stamped with the ``request_id`` that triggered it where one
 exists (a hedge, a passive demotion, an operator admin call), so the audit

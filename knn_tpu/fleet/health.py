@@ -43,6 +43,11 @@ class ReplicaState:
         self.applied_seq = 0
         self.promoted_at_seq: Optional[int] = None
         self.compaction_pressure: Optional[int] = None
+        #: The primary's own view of its shippers (``fleet.followers``
+        #: from its /healthz): ``{follower_url: {state, acked_seq}}`` —
+        #: how the router learns a follower parked behind the fold or
+        #: diverged, i.e. the auto-bootstrap trigger.
+        self.followers: Optional[dict] = None
 
     def export(self) -> dict:
         return {
@@ -55,6 +60,7 @@ class ReplicaState:
             "role": self.role,
             "applied_seq": self.applied_seq,
             "compaction_pressure": self.compaction_pressure,
+            "followers": self.followers,
         }
 
 
@@ -130,6 +136,17 @@ class ReplicaSet:
                 s.role = fleet.get("role")
                 s.applied_seq = int(fleet.get("applied_seq") or 0)
                 s.promoted_at_seq = fleet.get("promoted_at_seq")
+                followers = fleet.get("followers")
+                if isinstance(followers, dict):
+                    s.followers = {
+                        u: {"state": d.get("state"),
+                            "acked_seq": d.get("acked_seq"),
+                            "lag": d.get("lag")}
+                        for u, d in followers.items()
+                        if isinstance(d, dict)
+                    }
+                else:
+                    s.followers = None
             mutable = doc.get("mutable")
             if isinstance(mutable, dict):
                 s.compaction_pressure = (int(mutable.get("delta_slots", 0))

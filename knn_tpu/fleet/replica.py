@@ -27,6 +27,7 @@ those seqs name different mutations.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from typing import Optional
@@ -48,6 +49,18 @@ SHIP_DIVERGED = "diverged"        # parked: re-seed the follower
 SHIP_BEHIND_FOLD = "behind_fold"  # parked: re-seed the follower
 SHIP_REJECTED = "rejected"
 
+#: ``knn_fleet_shipper_state`` gauge encoding — the numeric mirror of
+#: the states above so a dashboard can alert on "any follower parked"
+#: without string labels: 0 shipping/idle, 1 unreachable, 2 rejected,
+#: 3 parked awaiting re-seed (behind the fold), 4 parked diverged.
+SHIP_STATE_CODE = {
+    SHIP_OK: 0,
+    SHIP_UNREACHABLE: 1,
+    SHIP_REJECTED: 2,
+    SHIP_BEHIND_FOLD: 3,
+    SHIP_DIVERGED: 4,
+}
+
 #: Replication-lag clock bound: stamped apply instants kept while no
 #: follower has confirmed them (writes-in-flight, not history).
 _MAX_SEQ_STAMPS = 4096
@@ -57,8 +70,10 @@ _MAX_SEQ_STAMPS = 4096
 #: documented recovery work WITHOUT a primary restart: once the operator
 #: re-seeds and reboots the follower, the next probe resyncs (gap-409 →
 #: cursor reset, digest overlap clean) and shipping resumes; until then
-#: each probe is one cheap refused batch per interval.
-TERMINAL_RETRY_S = 30.0
+#: each probe is one cheap refused batch per interval. The env override
+#: exists for the soak/drill harnesses (scripts/fleet_soak.py) which run
+#: whole park→re-seed→resume cycles in seconds, not for production.
+TERMINAL_RETRY_S = float(os.environ.get("KNN_TPU_SHIP_RETRY_S") or 30.0)
 
 
 class WALShipper(threading.Thread):
@@ -125,6 +140,7 @@ class WALShipper(threading.Thread):
                     self.acked_seq = self.fleet.engine.folded_seq
                 self.last_error = str(e)
                 self._note("parked")
+                self._export_state()
                 self._halt.wait(TERMINAL_RETRY_S)
                 self._kick.clear()
             except Exception as e:  # noqa: BLE001 — a shipper must
@@ -132,6 +148,7 @@ class WALShipper(threading.Thread):
                 self.state = SHIP_UNREACHABLE
                 self.last_error = f"{type(e).__name__}: {e}"
                 self._note("error")
+                self._export_state()
 
     def _ship_pending(self) -> None:
         while not self._halt.is_set():
@@ -196,8 +213,19 @@ class WALShipper(threading.Thread):
             help="primary applied_seq minus this follower's acked seq",
             follower=self.url,
         )
+        self._export_state()
+
+    def _export_state(self) -> None:
+        obs.gauge_set(
+            "knn_fleet_shipper_state", SHIP_STATE_CODE.get(self.state, 1),
+            help="per-follower shipper state: 0 shipping/idle, "
+                 "1 unreachable, 2 rejected, 3 parked-reseed (behind "
+                 "the fold), 4 parked-diverged",
+            follower=self.url,
+        )
 
     def export(self) -> dict:
+        self._export_state()
         lag_ms = self.fleet.follower_lag_ms(self.url)
         return {
             "acked_seq": self.acked_seq,
@@ -319,6 +347,23 @@ class FleetReplica:
     def max_follower_seq(self) -> int:
         shippers = list(self._shippers.values())
         return max((s.acked_seq for s in shippers), default=0)
+
+    def retention_floor(self) -> Optional[int]:
+        """The lowest WAL cursor a LIVE follower still needs — the
+        compactor's epoch-pruning floor (``Compactor(retention_floor=
+        ...)``), closing the hazard where a fold silently strands a
+        merely-lagging follower behind the fold point. Parked shippers
+        (diverged / behind the fold) are excluded on purpose: they
+        recover through the snapshot bootstrap path, not the WAL, and
+        holding epochs for them would pin the log forever. None when
+        there is nothing to hold for (follower role, or no shippers)."""
+        if self.role != "primary":
+            return None
+        live = [s.acked_seq for s in self._shippers.values()
+                if s.state not in (SHIP_DIVERGED, SHIP_BEHIND_FOLD)]
+        if not live:
+            return None
+        return min(live)
 
     def wait_replicated(self, seq: int,
                         timeout_s: Optional[float] = None) -> bool:

@@ -25,6 +25,13 @@ Routing rules (each one line of the robustness story):
   by compaction debt (the ``/debug/capacity`` mutable block).
 - ``POST /admin/promote`` (and ``--auto-failover``) promotes the
   most-caught-up usable follower.
+- ``POST /admin/bootstrap`` (and, with ``--auto-failover``, the health
+  poll) drives a parked follower — one the primary reports diverged or
+  behind the fold — through the snapshot bootstrap
+  (``knn_tpu.fleet.bootstrap``): the follower re-seeds from the
+  primary's current generation and its shipper resumes on the next
+  re-probe. This is the self-healing leg: a replica never stays
+  terminally parked while a healthy primary can re-seed it.
 
 The router holds no model and no index — it is restartable at any time
 with zero state loss (its only state is health, a round-robin cursor,
@@ -35,6 +42,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -62,6 +70,18 @@ MAX_BODY_BYTES = 64 * 1024 * 1024
 
 #: Hedge latency ring size (p99 over the last N read forwards).
 _LATENCY_RING = 512
+
+#: Minimum seconds between auto-bootstrap attempts on the SAME follower:
+#: a re-seed that keeps failing (full disk, crashing follower) must not
+#: be re-driven at health-poll rate. Matches the parked shipper's own
+#: 30s re-probe cadence, so a successful re-seed is picked up within one
+#: cooldown anyway. Env override is for the drill harness only.
+_BOOTSTRAP_COOLDOWN_S = float(
+    os.environ.get("KNN_TPU_BOOTSTRAP_COOLDOWN_S") or 30.0)
+
+#: Shipper states that mean "this follower needs a snapshot re-seed, the
+#: WAL alone cannot catch it up" (knn_tpu.fleet.replica park states).
+_PARKED_STATES = frozenset({"behind_fold", "diverged"})
 
 
 class RouterBusy(Exception):
@@ -103,7 +123,7 @@ class RouterApp:
             self.access_log = AccessLog(access_log)
         self.set = ReplicaSet(replicas, interval_s=health_interval_s,
                               poll_timeout_s=poll_timeout_s,
-                              on_poll=self._maybe_failover,
+                              on_poll=self._on_poll,
                               events=self.events)
         self.forward_timeout_s = float(forward_timeout_s)
         self.admin_timeout_s = float(admin_timeout_s)
@@ -127,6 +147,15 @@ class RouterApp:
         self._fo_onset = None
         self.failovers = 0
         self.reloads = 0
+        self.reseeds = 0
+        # Auto-bootstrap state (plain containers — a flagless router
+        # constructs no threads and no instruments for this): which
+        # followers have a re-seed inflight, and when each last started
+        # (the cooldown that keeps a failing bootstrap from hot-looping
+        # at poll rate).
+        self._bootstrap_lock = threading.Lock()
+        self._bootstrap_inflight: "set[str]" = set()
+        self._bootstrap_last: "dict[str, float]" = {}
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="knn-fleet-hedge")
         self.set.start()
@@ -666,7 +695,8 @@ class RouterApp:
                                            f"{out[url]}")
         return out
 
-    def coordinated_compact(self, replica: Optional[str] = None) -> dict:
+    def coordinated_compact(self, replica: Optional[str] = None,
+                            request_id: Optional[str] = None) -> dict:
         """Run one compaction on ONE replica: the named one, else the
         highest compaction debt (delta slots + tombstones from each
         usable replica's ``/debug/capacity``). Serialized fleet-wide —
@@ -700,10 +730,58 @@ class RouterApp:
                              f"transport layer: {err}",
                     "replica": target,
                 }}
-            return {"status": st, "body": {**doc, "replica": target,
-                                           "debts": debts or None}}
+            body = {**doc, "replica": target, "debts": debts or None}
+            if st == 200 and doc.get("compacted"):
+                if int(doc.get("epochs_held") or 0) > 0 \
+                        and self.events is not None:
+                    # The primary deferred WAL pruning for a lagging
+                    # follower — audit it so "why is disk growing"
+                    # joins to the follower holding the floor.
+                    self.events.emit(
+                        "epoch-retention-hold",
+                        request_id=request_id, replica=target,
+                        epochs_held=int(doc["epochs_held"]),
+                        retention_floor=doc.get("retention_floor"),
+                        folded_seq=doc.get("folded_seq"))
+                body["propagated"] = self._propagate_fold(target, doc)
+            return {"status": st, "body": body}
         finally:
             self._admin_lock.release()
+
+    def _propagate_fold(self, compacted: str, doc: dict):
+        """After a PRIMARY compaction, fold the same point into each
+        usable follower whose replication cursor has already passed it
+        (so its own compaction folds a superset — the fleet's fold
+        points advance together instead of each follower carrying an
+        ever-longer WAL tail). Best-effort and per-follower reported: a
+        follower that declines (mid-reload, still behind) just compacts
+        later. Compacting a FOLLOWER propagates nothing."""
+        if self.set.state(compacted).role != "primary":
+            return None
+        fold_seq = doc.get("folded_seq")
+        if fold_seq is None:
+            return None
+        out = {}
+        self.set.poll_once()  # applied_seq must be current, not stale
+        for url in self.set.usable_urls():
+            if url == compacted:
+                continue
+            s = self.set.state(url)
+            if s.role != "follower":
+                continue
+            if s.applied_seq < int(fold_seq):
+                out[url] = {"skipped": f"cursor {s.applied_seq} behind "
+                                       f"fold point {fold_seq}"}
+                continue
+            pst, pdoc, perr = self._admin_call(
+                "POST", url + "/admin/compact", {})
+            out[url] = {"status": pst,
+                        "compacted": bool((pdoc or {}).get("compacted")),
+                        "folded_seq": (pdoc or {}).get("folded_seq")}
+            if pst != 200:
+                out[url]["error"] = perr or (pdoc or {}).get(
+                    "error", f"HTTP {pst}")
+        return out or None
 
     def promote(self, replica: Optional[str] = None,
                 trigger: str = "manual",
@@ -786,6 +864,134 @@ class RouterApp:
         threading.Thread(target=work, daemon=True,
                          name="knn-fleet-failover").start()
 
+    def _on_poll(self) -> None:
+        """The health poller's advisory hook: both self-healing legs run
+        here, each internally gated on ``--auto-failover`` and each
+        moving real work off the poll thread."""
+        self._maybe_failover()
+        self._maybe_bootstrap()
+
+    def _maybe_bootstrap(self) -> None:
+        """Poll hook, the re-seed leg: with ``--auto-failover``, a
+        HEALTHY follower whose shipper the primary reports parked
+        (behind the fold after a compaction outran its cursor, or
+        diverged after a partition) is driven through the snapshot
+        bootstrap. One inflight re-seed per follower, with a cooldown so
+        a bootstrap that keeps failing cannot hot-loop; the work runs
+        off the poll thread — a slow snapshot transfer must never
+        freeze health polling."""
+        if not self.auto_failover:
+            return
+        primary = self.set.primary_url()
+        if primary is None:
+            return  # no source to re-seed from (failover window)
+        followers = self.set.state(primary).followers
+        if not followers:
+            return
+        now = time.monotonic()
+        target = None
+        with self._bootstrap_lock:
+            for url, info in followers.items():
+                u = url.rstrip("/")
+                if not isinstance(info, dict) \
+                        or info.get("state") not in _PARKED_STATES:
+                    continue
+                if u in self._bootstrap_inflight:
+                    continue
+                if now - self._bootstrap_last.get(u, -1e9) \
+                        < _BOOTSTRAP_COOLDOWN_S:
+                    continue
+                # The follower itself must be serving: bootstrap is an
+                # admin call into a LIVE process. A crashed follower is
+                # the operator's problem (or a fresh boot's --bootstrap
+                # auto), not this hook's.
+                if not self.set.is_healthy(u):
+                    continue
+                target = u
+                self._bootstrap_inflight.add(u)
+                self._bootstrap_last[u] = now
+                break
+        if target is None:
+            return
+
+        def work():
+            try:
+                self.bootstrap(follower=target, source=primary,
+                               trigger="auto")
+            finally:
+                with self._bootstrap_lock:
+                    self._bootstrap_inflight.discard(target)
+
+        threading.Thread(target=work, daemon=True,
+                         name="knn-fleet-bootstrap").start()
+
+    def bootstrap(self, follower: Optional[str] = None,
+                  source: Optional[str] = None,
+                  trigger: str = "manual",
+                  request_id: Optional[str] = None) -> dict:
+        """Drive ONE snapshot bootstrap: tell ``follower`` (default: the
+        first follower the primary reports parked) to re-seed itself
+        from ``source`` (default: the healthy primary) via its
+        ``POST /admin/bootstrap``. The transfer and install run inside
+        the follower; this call blocks until it commits (bounded by the
+        admin timeout) and audits begin/complete/failed either way."""
+        src = (source or self.set.primary_url() or "").rstrip("/")
+        if not src:
+            return {"status": 503, "body": {
+                "error": "no healthy primary to bootstrap from",
+            }}
+        target = follower.rstrip("/") if follower else None
+        if target is None:
+            followers = self.set.state(src).followers or {}
+            for url, info in followers.items():
+                if isinstance(info, dict) \
+                        and info.get("state") in _PARKED_STATES:
+                    target = url.rstrip("/")
+                    break
+        if target is None:
+            return {"status": 409, "body": {
+                "error": "no parked follower to re-seed (the primary "
+                         "reports none behind_fold or diverged; name "
+                         'one explicitly with {"follower": URL})',
+            }}
+        if self.events is not None:
+            self.events.emit("reseed-begin", request_id=request_id,
+                             follower=target, source=src,
+                             trigger=trigger)
+        st, doc, err = self._admin_call(
+            "POST", target + "/admin/bootstrap", {"from": src})
+        ok = st == 200
+        obs.counter_add(
+            "knn_fleet_reseeds_total",
+            help="snapshot bootstraps the router drove, by trigger and "
+                 "outcome",
+            trigger=trigger, outcome="ok" if ok else "failed")
+        if self.events is not None:
+            if ok:
+                self.events.emit(
+                    "reseed-complete", request_id=request_id,
+                    follower=target, source=src, trigger=trigger,
+                    generation=doc.get("generation"),
+                    wal_cursor=doc.get("folded_seq"))
+            else:
+                self.events.emit(
+                    "reseed-failed", request_id=request_id,
+                    follower=target, source=src, trigger=trigger,
+                    error=err or doc.get("error", f"HTTP {st}"))
+        if not ok:
+            return {"status": 502 if st is None else st, "body": {
+                "error": f"bootstrap on {target} failed: "
+                         f"{err or doc.get('error', doc)}",
+                "replica": target, "source": src,
+            }}
+        self.reseeds += 1
+        # The re-seeded follower's next shipper re-probe (<=30s) resumes
+        # shipping; the poll below refreshes the router's view now.
+        self.set.poll_once()
+        return {"status": 200, "body": {**doc, "replica": target,
+                                        "source": src,
+                                        "trigger": trigger}}
+
     # -- export ------------------------------------------------------------
 
     def health(self) -> dict:
@@ -803,6 +1009,7 @@ class RouterApp:
             "auto_failover": self.auto_failover,
             "failovers": self.failovers,
             "reloads": self.reloads,
+            "reseeds": self.reseeds,
             "confirmed_index": self._confirmed_index,
             "flight_recorder": (self.recorder.stats()
                                 if self.recorder is not None else None),
@@ -1071,6 +1278,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._do_admin(body, self._admin_reload)
             elif route == "/admin/compact":
                 self._do_admin(body, self._admin_compact)
+            elif route == "/admin/bootstrap":
+                self._do_admin(body, self._admin_bootstrap)
             else:
                 self.close_connection = True
                 self._send(404, {"error": f"no such endpoint: "
@@ -1166,7 +1375,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                            request_id=self._rid)
 
     def _admin_compact(self, doc: dict) -> dict:
-        return self.app.coordinated_compact(doc.get("replica"))
+        return self.app.coordinated_compact(doc.get("replica"),
+                                            request_id=self._rid)
+
+    def _admin_bootstrap(self, doc: dict) -> dict:
+        return self.app.bootstrap(doc.get("follower"),
+                                  source=doc.get("from"),
+                                  trigger="manual",
+                                  request_id=self._rid)
 
 
 class RouterServer(ThreadingHTTPServer):

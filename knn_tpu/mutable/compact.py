@@ -31,6 +31,7 @@ so the chaos tooling can prove the rollback path without timing luck.
 
 from __future__ import annotations
 
+import contextlib
 import shutil
 import threading
 import time
@@ -187,10 +188,19 @@ class Compactor:
     ``rebase_hook()`` inside the batcher's model-swap critical section
     (``ServeApp._mutable_swap`` does); ``warm`` — ``warm(new_model)``
     compiles the serving batch shapes off the serving path.
+
+    ``retention_floor`` — optional zero-arg callable returning the
+    lowest WAL cursor any live follower still needs (or None): epoch
+    files whose records reach past that floor are NOT pruned after the
+    fold, so a merely-lagging follower keeps catching up from the WAL
+    instead of being force-parked behind the fold point
+    (``FleetReplica.retention_floor`` wires this; a non-replicated serve
+    passes nothing and prunes exactly as before).
     """
 
     def __init__(self, engine, *, swap, warm,
-                 threshold: int = 1024, interval_s: float = 30.0):
+                 threshold: int = 1024, interval_s: float = 30.0,
+                 retention_floor=None):
         if threshold < 1:
             raise ValueError(f"compact threshold must be >= 1, got "
                              f"{threshold}")
@@ -202,6 +212,7 @@ class Compactor:
         self.interval_s = float(interval_s)
         self._swap = swap
         self._warm = warm
+        self._retention_floor = retention_floor
         self._lock = threading.Lock()
         self._kick = threading.Event()
         self._stop = threading.Event()
@@ -267,6 +278,22 @@ class Compactor:
                     print(f"warning: compaction failed "
                           f"({type(e).__name__}: {e}); the previous "
                           f"generation keeps serving", flush=True)
+
+    @contextlib.contextmanager
+    def exclusive(self):
+        """Hold the compaction lock WITHOUT compacting — the snapshot
+        bootstrap installer wraps its re-seed swap in this so no
+        concurrent fold can seal the abandoned lineage's state and
+        re-commit it over the freshly installed generation. Raises
+        :class:`CompactionInProgress` (non-blocking, like
+        ``run_once``) when a fold is mid-flight."""
+        if not self._lock.acquire(blocking=False):
+            raise CompactionInProgress(
+                "a compaction is already in progress")
+        try:
+            yield
+        finally:
+            self._lock.release()
 
     # -- one compaction ----------------------------------------------------
 
@@ -336,12 +363,13 @@ class Compactor:
                     "next_stable": int(eng._next_stable),
                     "active_epoch": int(eng._epoch),
                 })
-                self._cleanup(fold_input, generation)
+                cleanup = self._cleanup(fold_input, generation)
             wall_ms = (time.monotonic() - t0) * 1e3
             self.compactions += 1
             detail = {
                 "generation": generation, "index_version": version,
-                "previous_version": previous, **stats,
+                "previous_version": previous,
+                "folded_seq": int(fold_input["seq"]), **cleanup, **stats,
             }
             if ivf_path is not None:
                 # Which IVF branch this fold rode (the compaction
@@ -374,16 +402,55 @@ class Compactor:
         finally:
             self._lock.release()
 
-    def _cleanup(self, fold_input: dict, generation: int) -> None:
+    def _cleanup(self, fold_input: dict, generation: int) -> dict:
         """Best-effort removal of folded epoch files and superseded
         generation directories — AFTER the pointer committed, so a crash
-        during cleanup only leaves redundant (skipped-on-replay) files."""
+        during cleanup only leaves redundant (skipped-on-replay) files.
+
+        Retention floor: an epoch whose records reach past the lowest
+        live follower cursor is HELD, not pruned — the silent hazard
+        this closes is a primary compacting a lagging follower straight
+        into the terminal behind-the-fold park. Held epochs stay
+        eligible (``n <= sealed_epoch``) and are re-examined by the next
+        compaction's cleanup once the floor advances; the hold itself is
+        counted (``knn_fleet_wal_retention_held_total``) and surfaced in
+        the compaction verdict so the router can audit it."""
+        floor = None
+        if self._retention_floor is not None:
+            try:
+                floor = self._retention_floor()
+            except Exception:  # noqa: BLE001 — advisory; prune as before
+                floor = None
+        pruned = held = 0
         for n, path in artifact.list_epochs(self.engine.root):
-            if n <= fold_input["sealed_epoch"]:
+            if n > fold_input["sealed_epoch"]:
+                continue
+            if floor is not None and floor < fold_input["seq"]:
                 try:
-                    path.unlink()
-                except OSError:
-                    pass
+                    records, _torn = artifact.read_epoch_records(
+                        path, tolerate_torn=True)
+                except Exception:  # noqa: BLE001 — unreadable: hold it
+                    records = None
+                if records is None:
+                    last_seq = fold_input["seq"]  # conservative: hold
+                elif not records:
+                    last_seq = -1  # empty file holds nothing: prune
+                else:
+                    last_seq = int(records[-1]["seq"])
+                if last_seq > floor:
+                    held += 1
+                    continue
+            try:
+                path.unlink()
+                pruned += 1
+            except OSError:
+                pass
+        if held:
+            obs.counter_add(
+                "knn_fleet_wal_retention_held_total", held,
+                help="epoch files a compaction deferred pruning because "
+                     "a live follower's WAL cursor still needs them",
+            )
         gen_root = self.engine.root / artifact.GENERATIONS_DIR
         if gen_root.is_dir():
             keep = artifact.generation_path(self.engine.root,
@@ -391,3 +458,7 @@ class Compactor:
             for p in gen_root.iterdir():
                 if p.is_dir() and p.name != keep:
                     shutil.rmtree(p, ignore_errors=True)
+        out = {"epochs_pruned": pruned, "epochs_held": held}
+        if floor is not None:
+            out["retention_floor"] = int(floor)
+        return out

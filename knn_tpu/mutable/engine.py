@@ -642,26 +642,23 @@ class MutableEngine:
         shipping source for the primary's fan-out and for rejoin
         catch-up. Reads the epoch files directly (the appender flushes
         whole lines, and a torn tail is by definition un-acked — skipped
-        this round, shipped the next). Raises a typed :class:`DataError`
-        when ``after_seq`` predates the fold point: those records are
-        compacted into a base generation and their epochs pruned, so
-        that follower cannot catch up from the WAL and must re-seed from
-        a copy of the artifact directory. A file vanishing MID-scan
-        (the compactor's epoch pruning is not coordinated with this
-        lock-free read) is a transient race, re-scanned — and surfaced
-        as a plain ``OSError`` (retry later, NOT the terminal re-seed
-        state) if it somehow persists."""
+        this round, shipped the next). A cursor behind the fold point is
+        still servable while a retention hold (mutable/compact.py) kept
+        the folded epochs on disk: the stream is verified gapless from
+        ``after_seq + 1`` before shipping, and the typed
+        :class:`DataError` re-seed refusal fires only when records are
+        actually missing — compacted into a base generation and their
+        epochs pruned, so that follower must re-seed from a copy of the
+        artifact directory (the snapshot bootstrap path,
+        fleet/bootstrap.py). A file vanishing MID-scan (the compactor's
+        epoch pruning is not coordinated with this lock-free read) is a
+        transient race, re-scanned — and surfaced as a plain ``OSError``
+        (retry later, NOT the terminal re-seed state) if it somehow
+        persists."""
         for _attempt in range(3):
             with self._lock:
                 folded = self._folded_seq
                 own_seq = self._seq
-            if after_seq < folded:
-                raise DataError(
-                    f"cursor seq {after_seq} predates the fold point "
-                    f"{folded}: those records are compacted into a base "
-                    f"generation and their epochs pruned — re-seed the "
-                    f"follower from a copy of the artifact directory"
-                )
             out: "list[dict]" = []
             try:
                 epochs = artifact.list_epochs(self.root)
@@ -681,6 +678,21 @@ class MutableEngine:
                     continue  # pruned mid-scan; re-list and re-read
                 raise
             out.sort(key=lambda r: int(r["seq"]))
+            if after_seq < folded:
+                expect = after_seq
+                for rec in out:
+                    if int(rec["seq"]) != expect + 1:
+                        break
+                    expect += 1
+                else:
+                    if out:  # gapless from the cursor: retention held
+                        return out, own_seq
+                raise DataError(
+                    f"cursor seq {after_seq} predates the fold point "
+                    f"{folded}: those records are compacted into a base "
+                    f"generation and their epochs pruned — re-seed the "
+                    f"follower from a copy of the artifact directory"
+                )
             return out, own_seq
         raise OSError(
             "epoch files kept vanishing mid-scan (compaction churn); "
@@ -817,6 +829,69 @@ class MutableEngine:
             # are immutable) and lazily re-activate at the threshold.
             self._dtail = None
             self._sync_device_tail()
+
+    def reseed(self, new_model, new_base_stable, current: dict,
+               version: Optional[str] = None, commit=None) -> None:
+        """Abandon this engine's entire lineage in favor of a freshly
+        installed snapshot generation (fleet/bootstrap.py). MUST run
+        inside the batcher's model-swap critical section, exactly like
+        :meth:`rebase`. Unlike a rebase nothing survives: delta slots,
+        tombstones, the digest window, and the WAL cursor all reset to
+        the snapshot's fold point — records past it arrive back through
+        the normal replication path (the primary holds them).
+
+        ``commit`` — an optional callable run under the engine lock
+        AFTER validation but BEFORE any state mutates: the bootstrap
+        installer's durable commit (clear old-lineage epochs, atomic
+        CURRENT.json replace). Running it here means no mutation can
+        append to an epoch file that is about to be abandoned, and a
+        raise from it leaves the engine untouched (``swap_model``
+        restores the old model — together a true rollback)."""
+        with self._lock:
+            if new_base_stable is not None:
+                stable = check_stable_ascending(
+                    np.asarray(new_base_stable, np.int64), "reseed")
+            else:
+                stable = np.arange(new_model.train_.num_instances,
+                                   dtype=np.int64)
+            if new_model.train_.num_features != self._d:
+                raise DataError(
+                    f"reseed: snapshot generation has "
+                    f"{new_model.train_.num_features} features but this "
+                    f"replica serves {self._d} — wrong fleet"
+                )
+            folded = int(current.get("folded_seq", 0))
+            if commit is not None:
+                commit()
+            self._model = new_model
+            self._version = version
+            self._base_stable = stable
+            self._base_n = int(stable.shape[0])
+            self._generation = int(current.get("generation", 0))
+            self._folded_seq = folded
+            self._seq = folded
+            self._next_stable = max(
+                int(stable[-1]) + 1 if self._base_n else 0,
+                int(current.get("next_stable", 0)))
+            cap = min(_INITIAL_SLOTS, self.delta_cap)
+            self._features = np.zeros((cap, self._d), np.float32)
+            self._values = np.zeros(cap, np.float32)
+            self._stable = np.zeros(cap, np.int64)
+            self._count = 0
+            self._tomb_stable = frozenset()
+            self._tomb_pos = frozenset()
+            self._rebuild_tomb_arrays()
+            self._digests = {}
+            self._dtail = None
+            self._sync_device_tail()
+            # The old lineage's epoch files are gone (commit cleared
+            # them); rotate to a fresh log so new records land in an
+            # epoch that postdates the installed fold point.
+            self._log.close()
+            epochs = artifact.list_epochs(self.root)
+            self._epoch = (epochs[-1][0] + 1) if epochs else 1
+            self._log = artifact.EpochLog(
+                artifact.epoch_path(self.root, self._epoch))
 
     def note_compaction(self, outcome: str, wall_ms: float,
                         detail: Optional[dict] = None) -> None:

@@ -92,6 +92,11 @@ FAULT_POINTS: Dict[str, str] = {
     # the shipper must back off and re-ship without losing its cursor —
     # follower lag grows, then drains, and no record is skipped.
     "fleet.wal_ship": "io",
+    # The snapshot bootstrap install path (knn_tpu/fleet/bootstrap.py):
+    # fires between download-verify and the atomic CURRENT.json commit,
+    # standing in for a torn chunk / full disk mid-install — the
+    # follower's prior state must keep serving untouched.
+    "fleet.snapshot_ship": "io",
 }
 
 _KINDS = ("data", "compile", "device", "collective", "worker", "io", "oom")

@@ -393,9 +393,16 @@ class ServeApp:
                 self.mutable, swap=self._mutable_swap,
                 warm=self._warm_replacement, threshold=compact_threshold,
                 interval_s=compact_interval_s,
+                # Replicated primaries defer epoch pruning while a live
+                # follower's cursor still needs those records (the
+                # WAL-retention floor); a plain mutable serve passes
+                # nothing and prunes exactly as before.
+                retention_floor=(self.fleet.retention_floor
+                                 if self.fleet is not None else None),
             )
         else:
             self.compactor = None
+        self._bootstrap_lock = threading.Lock()
         self.ready = False
         self.draining = False
         self.started_unix = time.time()
@@ -477,6 +484,89 @@ class ServeApp:
                   f"generation regardless (capacity/probe state refits "
                   f"from live traffic)", flush=True)
         return previous
+
+    def bootstrap_from(self, source_url: str, *,
+                       timeout_s: float = 60.0) -> dict:
+        """``POST /admin/bootstrap``: abandon this replica's lineage and
+        re-seed from ``source_url``'s current generation snapshot, with
+        the old state serving until the atomic flip. Download and
+        whole-file digest verification run entirely OUTSIDE any critical
+        section (reads keep flowing); the durable commit (clear the old
+        lineage's epochs, atomic ``CURRENT.json`` replace) runs inside
+        the engine's reseed under the batcher's model-swap critical
+        section — the same machinery a compaction swap trusts — and
+        with the compaction lock held, so no concurrent fold can seal
+        abandoned state and re-commit it afterwards. Any failure leaves
+        the prior state serving (``swap_model`` restores the model on a
+        hook raise; the staged directory is removed)."""
+        if self.mutable is None:
+            raise DataError(
+                "bootstrap re-seeds the mutable tier; boot with "
+                "`serve INDEX --mutable on`"
+            )
+        if self.fleet is not None and self.fleet.role == "primary":
+            from knn_tpu.mutable.state import MutationConflict
+
+            raise MutationConflict(
+                "this replica is the primary — it is the snapshot "
+                "SOURCE; bootstrap a follower from it instead"
+            )
+        from knn_tpu.fleet import bootstrap
+
+        if not self._bootstrap_lock.acquire(blocking=False):
+            raise ReloadInProgress("a bootstrap is already in progress")
+        try:
+            staged = bootstrap.download_snapshot(
+                source_url, self.mutable.root, timeout_s=timeout_s)
+            try:
+                model = artifact.load_index(staged["tmp_dir"])
+                version = staged["index_version"]
+                _block, stable = artifact.read_mutable_block(
+                    staged["tmp_dir"])
+                self._warm_replacement(model)
+                reseed_current = {
+                    "generation": staged["generation"],
+                    "folded_seq": staged["wal_cursor"],
+                    "next_stable": staged["next_stable"],
+                }
+                committed: dict = {}
+
+                def _commit():
+                    committed.update(bootstrap.commit_snapshot(staged))
+
+                hold = (self.compactor.exclusive()
+                        if self.compactor is not None
+                        else contextlib.nullcontext())
+                with hold:
+                    previous = self._mutable_swap(
+                        model, version,
+                        lambda: self.mutable.reseed(
+                            model, stable, reseed_current,
+                            version=version, commit=_commit),
+                    )
+            except Exception:
+                import shutil
+
+                shutil.rmtree(staged["tmp_dir"], ignore_errors=True)
+                raise
+            obs.counter_add(
+                "knn_fleet_bootstrap_total",
+                help="snapshot bootstrap installs this replica served "
+                     "as the target, by outcome",
+                outcome="ok",
+            )
+            return {"bootstrapped": True, "previous_version": previous,
+                    **committed}
+        except Exception:
+            obs.counter_add(
+                "knn_fleet_bootstrap_total",
+                help="snapshot bootstrap installs this replica served "
+                     "as the target, by outcome",
+                outcome="failed",
+            )
+            raise
+        finally:
+            self._bootstrap_lock.release()
 
     def _seed_capacity_model(self) -> None:
         """Seed the headroom model's affine dispatch-cost fit
@@ -928,6 +1018,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_profile()
         elif route == "/admin/wal-since":
             self._do_wal_since()
+        elif route == "/admin/snapshot":
+            self._do_snapshot()
         else:
             self._send(404, {"error": f"no such endpoint: {self.path}"})
 
@@ -1134,6 +1226,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/admin/promote":
             self._do_promote()
+            return
+        if self.path == "/admin/bootstrap":
+            self._do_bootstrap()
             return
         if self.path in ("/insert", "/delete"):
             with self.app.track_request():
@@ -1366,6 +1461,107 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, {"records": records, "seq": own_seq},
                    tag_request_id=False)
+
+    def _do_snapshot(self):
+        """``GET /admin/snapshot``: the snapshot-shipping export any
+        mutable replica serves from its committed on-disk state
+        (fleet/bootstrap.py). No query → the snapshot manifest (file
+        list with sizes + sha256 digests, the generation, and the WAL
+        cursor a freshly installed follower resumes from);
+        ``?file=NAME&offset=N&length=M&generation=G`` → one raw chunk,
+        409 typed when ``G`` was superseded by a compaction mid-transfer
+        (the client restarts from a fresh manifest). 404 while
+        ``--mutable off``."""
+        if self.app.mutable is None:
+            self._send(404, {"error": "mutable serving is off — there is "
+                                      "no generation artifact to ship"})
+            return
+        from knn_tpu.fleet import bootstrap
+
+        q = parse_qs(urlparse(self.path).query)
+        name = q.get("file", [None])[0]
+        try:
+            if name is None:
+                self._send(200,
+                           bootstrap.snapshot_manifest(self.app.mutable.root),
+                           tag_request_id=False)
+                return
+            offset = int(q.get("offset", ["0"])[0])
+            length = int(q.get("length", [str(bootstrap.CHUNK_BYTES)])[0])
+            generation = int(q.get("generation", ["0"])[0])
+        except ValueError:
+            self._send(400, {"error": f"bad snapshot chunk query: "
+                                      f"{self.path!r}"})
+            return
+        try:
+            chunk = bootstrap.read_chunk(self.app.mutable.root, name,
+                                         offset, length, generation)
+        except DataError as e:
+            self._send(409, {"error": str(e)})
+            return
+        except OSError as e:
+            self._send(503, {"error": f"snapshot read failed: {e}"})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        rid = getattr(self, "_rid", None)
+        if rid is not None:
+            self.send_header("x-request-id", rid)
+        self.send_header("Content-Length", str(len(chunk)))
+        self.end_headers()
+        self.wfile.write(chunk)
+
+    def _do_bootstrap(self):
+        """``POST /admin/bootstrap`` body ``{"from": SOURCE_URL}``:
+        re-seed THIS replica from the source's snapshot while the prior
+        state keeps serving until the atomic flip (the self-healing leg
+        — the router calls this on a follower whose shipper parked
+        behind the fold or diverged). 404 while ``--mutable off``, 409
+        on the primary / while another bootstrap or compaction runs,
+        502 typed when the transfer itself failed — prior state serving
+        in every non-200 case."""
+        if self.app.mutable is None:
+            self.close_connection = True
+            self._send(404, {"error": "mutable serving is off — boot "
+                                      "with `serve INDEX --mutable on`"})
+            return
+        body, err, status = self._read_json_body(required=True)
+        if err is not None:
+            self.close_connection = True
+            self._send(status, {"error": err})
+            return
+        source = body.get("from")
+        if not isinstance(source, str) or not source.startswith(
+                ("http://", "https://")):
+            self._send(400, {"error": '"from" must be the source '
+                                      "replica's base URL"})
+            return
+        from knn_tpu.fleet.bootstrap import SnapshotInstallError
+        from knn_tpu.mutable.compact import CompactionInProgress
+        from knn_tpu.mutable.state import MutationConflict
+
+        timeout_s = float(body.get("timeout_s") or 60.0)
+        try:
+            result = self.app.bootstrap_from(source, timeout_s=timeout_s)
+        except (MutationConflict, ReloadInProgress,
+                CompactionInProgress) as e:
+            self._send(409, {"error": str(e)})
+            return
+        except SnapshotInstallError as e:
+            self._send(502, {"error": str(e), "prior_state_serving": True})
+            return
+        except DataError as e:
+            self._send(409, {"error": str(e), "prior_state_serving": True})
+            return
+        except OSError as e:
+            self._send(502, {"error": f"bootstrap transfer failed: {e}",
+                             "prior_state_serving": True})
+            return
+        except Exception as e:  # noqa: BLE001 — typed JSON, never a
+            self._send(500, {"error": f"{type(e).__name__}: {e}",
+                             "prior_state_serving": True})
+            return
+        self._send(200, result)
 
     def _do_compact(self):
         """``POST /admin/compact``: fold the delta tier + tombstones into

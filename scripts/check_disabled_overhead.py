@@ -152,7 +152,8 @@ def main() -> int:
                         "not exist while disabled")
         for mod in ("knn_tpu.fleet", "knn_tpu.fleet.replica",
                     "knn_tpu.fleet.router", "knn_tpu.fleet.health",
-                    "knn_tpu.fleet.wire"):
+                    "knn_tpu.fleet.wire", "knn_tpu.fleet.bootstrap",
+                    "knn_tpu.fleet.events"):
             if mod in sys.modules:
                 return fail(f"{mod} imported during plain single-process "
                             f"serving — fleet machinery must not "
@@ -285,6 +286,22 @@ def main() -> int:
         if router.set.events is not None:
             return fail("the health poller holds an event log while "
                         "disabled")
+        # Self-healing bootstrap (PR 17): a flagless router (no
+        # --auto-failover) must construct ZERO bootstrap machinery — no
+        # reseed driver threads, nothing inflight, and the poll hook
+        # must bail before touching the replica set.
+        if router._bootstrap_inflight or router._bootstrap_last:
+            return fail("RouterApp tracked bootstrap work with "
+                        "auto-failover off")
+        if router.reseeds != 0:
+            return fail("RouterApp counted a reseed with auto-failover "
+                        "off")
+        router._maybe_bootstrap()  # must be a no-op without the flag
+        boot_threads = [t.name for t in threading.enumerate()
+                        if t.name.startswith("knn-fleet-bootstrap")]
+        if boot_threads:
+            return fail(f"bootstrap driver thread(s) alive on a "
+                        f"flagless router: {boot_threads}")
     finally:
         router.close()
     leaked = [i.name for i in obs.registry().instruments()

@@ -41,13 +41,39 @@ failover-window event must agree with the client-measured 503 span;
 the rejoin; the stitched Perfetto export lands in ``build/`` as the CI
 artifact.
 
-**Phase 4 — coordinated reload under a crash-stop.** A fresh immutable
-3-replica fleet (hot reload is the immutable-serving operation — the
-mutable tier owns its own artifact lifecycle). One replica is
-crash-stopped, then the router is asked to reload: the attempt must fail
-typed with ``rolled_back: true`` and every LIVE replica still on the old
-version (all-or-nothing). The dead replica is rebooted and the retry
-must land every replica on the new version.
+**Phase 5 — blank-follower bootstrap under live traffic.** A replica's
+directory is wiped to NOTHING and the process rebooted
+``--follower-of`` the primary: the CLI pulls the primary's committed
+generation over the chunked, digest-verified ``/admin/snapshot``
+transfer, commits it atomically, then drains the WAL gap through the
+normal shipping path to lag 0 — "add a replica is one command", with
+zero failed reads throughout.
+
+**Phase 6 — rolling-restart upgrade.** Every replica is replaced one at
+a time under closed-loop load (followers behind the router's retry
+shield, the primary via auto-failover). Invariants: ZERO failed reads,
+writes resume after the typed 503 window, and ZERO acknowledged writes
+lost — every client-acked (seq, rows) pair bit-identical in the oracle
+replay of the surviving WAL.
+
+**Phase 7 — partition/rejoin divergence drill.** An isolated follower
+accepts a forged WAL record the primary never shipped, then the fleet
+writes through: same seq, different content. The digest-overlap
+backstop must fire as a typed ``WALDivergence`` (shipper parks
+``diverged``), the router's auto-bootstrap leg must re-seed the
+follower with no operator action and no primary restart
+(``reseed-begin``/``reseed-complete`` in the audit log), and the healed
+follower must answer bit-identically to the true lineage — never a
+divergent 200 outside the bounded, counted divergence window.
+
+**Phase 4 — coordinated reload under a crash-stop** (runs last, on its
+own fleet; the number is historical). A fresh immutable 3-replica fleet
+(hot reload is the immutable-serving operation — the mutable tier owns
+its own artifact lifecycle). One replica is crash-stopped, then the
+router is asked to reload: the attempt must fail typed with
+``rolled_back: true`` and every LIVE replica still on the old version
+(all-or-nothing). The dead replica is rebooted and the retry must land
+every replica on the new version.
 
 Every terminal outcome in every phase must be typed JSON — a traceback
 body anywhere fails the gate. Exit 0 when every invariant holds; 1 with
@@ -366,7 +392,13 @@ def main() -> int:
     ref = Path("/root/reference/datasets")
     train_arff = str((ref if ref.exists() else d) / "medium-train.arff")
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu", KNN_TPU_RETRY_BASE_MS="0")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KNN_TPU_RETRY_BASE_MS="0",
+               # Drill pacing: a parked shipper re-probes every 1s and the
+               # router may re-drive an auto-bootstrap after 2s (production
+               # defaults are 30s each) so the park -> re-seed -> resume
+               # cycle in phases 5-7 completes in seconds.
+               KNN_TPU_SHIP_RETRY_S="1.0",
+               KNN_TPU_BOOTSTRAP_COOLDOWN_S="2.0")
     report: dict = {"fleet_soak": {
         "train_rows": train.num_instances, "writers": args.writers,
         "readers": args.readers, "window_s": args.window_s,
@@ -747,7 +779,362 @@ def main() -> int:
               f"replication lag drained to 0; Perfetto artifact at "
               f"{trace_path}")
 
-        # Tear the mutable fleet down before phase 4.
+        # ---- phase 5: blank-follower bootstrap under live traffic --------
+        # "Adding a replica under live traffic is ONE command"
+        # (docs/SERVING.md): wipe the ex-primary's directory to NOTHING
+        # and reboot it --follower-of the promoted primary. The CLI must
+        # pull the primary's committed generation over the chunked,
+        # digest-verified /admin/snapshot transfer, commit it atomically
+        # (CURRENT.json), then drain the WAL gap through the normal
+        # shipping path until lag is 0 — all while client traffic keeps
+        # flowing through the router with ZERO failed reads.
+        load = FleetLoad(router, test.features, train.num_classes, args)
+        load.start()
+        time.sleep(args.window_s / 4)
+        procgroup.kill_group(procs["r1"])
+        shutil.rmtree(dirs["r1"])
+        dirs["r1"].mkdir()
+        procs["r1"], b1 = boot_follower("r1", promoted)
+        if b1 is None:
+            load.finish()
+            return fail(f"phase-5 blank-follower boot failed "
+                        f"(rc={procs['r1'].poll()})")
+        if not (dirs["r1"] / "CURRENT.json").exists():
+            load.finish()
+            return fail("phase-5: the blank follower booted without a "
+                        "snapshot install (no CURRENT.json committed)")
+
+        def p5_ship():
+            return (healthz(promoted)["fleet"]["followers"]
+                    or {}).get(url["r1"], {})
+
+        def p5_caught_up():
+            return (p5_ship().get("state") == "ok"
+                    and healthz(url["r1"])["mutable"]["seq"]
+                    >= healthz(promoted)["mutable"]["seq"])
+
+        if not wait_until(p5_caught_up, timeout_s=45):
+            load.finish()
+            return fail(f"phase-5: the blank follower never drained lag "
+                        f"to 0 (ship {p5_ship()})")
+        time.sleep(args.window_s / 4)
+        load.finish()
+        if load.read_failures:
+            return fail(f"phase-5 failed reads during the blank-follower "
+                        f"bootstrap: {load.read_failures[:3]}")
+        if load.write_failures:
+            return fail(f"phase-5 write violations: "
+                        f"{load.write_failures[:3]}")
+        if load.reads_ok < 50 or load.writes_ok < 10:
+            return fail(f"too little load to trust phase 5 "
+                        f"({load.reads_ok} reads, {load.writes_ok} "
+                        f"writes)")
+        mirror = build_wal_mirror(model.train_.features, model.k,
+                                  model.metric, promoted)
+        bad, _ = verify_against_wal(load, mirror, v0, "phase-5")
+        if bad:
+            return fail("; ".join(bad[:3]))
+        st, body = http(url["r1"], "/kneighbors",
+                        {"instances": test.features[:args.rows].tolist()})
+        if st != 200:
+            return fail(f"phase-5 read on the re-seeded replica: {st}")
+        doc = json.loads(body)
+        bad = mirror.verify_reads(
+            [(test.features[:args.rows], doc["mutation_seq"],
+              doc["index_version"], doc["distances"], doc["indices"])],
+            {v0: ()}, "phase-5 direct read")
+        if bad:
+            return fail("; ".join(bad))
+        report["phase5"] = {
+            "reads_verified": len(load.reads), "reads_ok": load.reads_ok,
+            "acked_writes": len(load.acked),
+            "bootstrapped_seq": healthz(url["r1"])["mutable"]["seq"],
+        }
+        print(f"fleet-soak: phase 5 ok — blank-dir follower bootstrapped "
+              f"from the primary's snapshot under live load, drained lag "
+              f"to 0 at seq {report['phase5']['bootstrapped_seq']}; "
+              f"{load.reads_ok} reads, ZERO failed; {len(load.reads)} "
+              f"replayed bit-identical")
+
+        # ---- phase 6: rolling-restart upgrade under load -----------------
+        # Replace EVERY replica one at a time under closed-loop load —
+        # the zero-downtime upgrade drill. Followers restart behind the
+        # router's retry shield (zero failed reads); the primary's turn
+        # rides auto-failover (typed 503 window, then writes resume);
+        # afterwards the oracle replay of the surviving WAL must hold
+        # every client-acked (seq, rows) pair bit-identical — a rolling
+        # upgrade may never lose an acknowledged write.
+        name_of = {u: n for n, u in url.items()}
+        current_primary = promoted
+        load = FleetLoad(router, test.features, train.num_classes, args)
+        load.start()
+        time.sleep(args.window_s / 4)
+        restart_order = [n for n in ("r1", "r2", "r3")
+                         if url[n] != current_primary]
+        for name in restart_order:
+            procgroup.kill_group(procs[name])
+            procs[name], b = boot_follower(name, current_primary)
+            if b is None:
+                load.finish()
+                return fail(f"phase-6 {name} restart failed "
+                            f"(rc={procs[name].poll()})")
+            if not wait_until(
+                    lambda n=name, p=current_primary: (
+                        healthz(url[n])["mutable"]["seq"]
+                        >= healthz(p)["mutable"]["seq"]),
+                    timeout_s=45):
+                load.finish()
+                return fail(f"phase-6: restarted follower {name} never "
+                            f"caught up")
+            if not wait_until(lambda: healthz(router)["usable"] == 3,
+                              timeout_s=20):
+                load.finish()
+                return fail(f"phase-6: router never saw 3 usable "
+                            f"replicas after restarting {name} — the "
+                            f"restart was not rolling")
+        # The primary's own turn: kill it, let auto-failover promote,
+        # reboot the ex-primary as a follower of the new primary.
+        old_primary = current_primary
+        procgroup.kill_group(procs[name_of[old_primary]])
+
+        def p6_new_primary():
+            p = healthz(router).get("primary")
+            return p if p and p != old_primary else None
+
+        current_primary = wait_until(p6_new_primary, timeout_s=30)
+        t_promote6 = time.monotonic()
+        if current_primary is None:
+            load.finish()
+            return fail("phase-6: auto-failover never promoted a "
+                        "survivor after the primary's restart turn")
+        with load.lock:
+            writes_at_promote6 = load.writes_ok
+        procs[name_of[old_primary]], b = boot_follower(
+            name_of[old_primary], current_primary)
+        if b is None:
+            load.finish()
+            return fail(f"phase-6 ex-primary reboot failed "
+                        f"(rc={procs[name_of[old_primary]].poll()})")
+        if not wait_until(
+                lambda: (healthz(old_primary)["mutable"]["seq"]
+                         >= healthz(current_primary)["mutable"]["seq"]),
+                timeout_s=45):
+            load.finish()
+            return fail("phase-6: the restarted ex-primary never caught "
+                        "up")
+        if not wait_until(lambda: healthz(router)["usable"] == 3,
+                          timeout_s=20):
+            load.finish()
+            return fail("phase-6: router never recovered 3 usable "
+                        "replicas after the rolling restart")
+        time.sleep(args.window_s / 4)
+        load.finish()
+        if load.read_failures:
+            return fail(f"phase-6 failed reads during the rolling "
+                        f"restart: {load.read_failures[:3]}")
+        if load.write_failures:
+            return fail(f"phase-6 write violations: "
+                        f"{load.write_failures[:3]}")
+        if load.writes_503 < 1:
+            return fail("phase-6 never saw the typed 503 window — the "
+                        "primary's restart turn landed outside the "
+                        "write path?")
+        if load.writes_ok <= writes_at_promote6:
+            return fail(f"phase-6: writes never resumed after the "
+                        f"promote ({load.writes_ok} total, "
+                        f"{writes_at_promote6} pre-promote)")
+        cap6 = healthz(current_primary)["fleet"]["promoted_at_seq"]
+        if cap6 is None:
+            return fail("phase-6 promoted replica reports no "
+                        "promoted_at_seq")
+        mirror = build_wal_mirror(model.train_.features, model.k,
+                                  model.metric, current_primary)
+        bad, excluded6 = verify_against_wal(
+            load, mirror, v0, "phase-6",
+            exclude=lambda seq, t: seq > cap6 and t < t_promote6)
+        if bad:
+            return fail("; ".join(bad[:3]))
+        report["phase6"] = {
+            "replicas_replaced": 3,
+            "promoted": current_primary,
+            "takeover_seq": cap6,
+            "reads_verified": len(load.reads) - excluded6,
+            "reads_excluded_unreplicated_tail": excluded6,
+            "writes_503_window": load.writes_503,
+            "acked_writes": len(load.acked),
+        }
+        print(f"fleet-soak: phase 6 ok — rolling restart replaced all 3 "
+              f"replicas under load: ZERO failed reads, "
+              f"{load.writes_503} typed-503 writes in the primary's "
+              f"turn, zero acked writes lost, "
+              f"{len(load.reads) - excluded6} reads replay bit-identical "
+              f"({excluded6} pre-ack tail reads excluded)")
+
+        # ---- phase 7: partition/rejoin divergence drill ------------------
+        # An isolated follower accepts a WAL record the primary never
+        # shipped (the partitioned-writer hazard), then the fleet writes
+        # through: the primary assigns the SAME seq to DIFFERENT content.
+        # The digest-overlap backstop must fire as a typed WALDivergence
+        # (shipper parks "diverged" — never a silent skip), the router's
+        # self-healing leg must re-seed the follower over /admin/snapshot
+        # with NO operator action and NO primary restart, and the healed
+        # follower must answer bit-identically to the true lineage.
+        import numpy as np
+
+        p7_primary = current_primary
+        victim = [n for n in ("r1", "r2", "r3")
+                  if url[n] != p7_primary][0]
+        vurl = url[victim]
+        if not wait_until(
+                lambda: (healthz(vurl)["mutable"]["seq"]
+                         == healthz(p7_primary)["mutable"]["seq"]),
+                timeout_s=30):
+            return fail("phase-7: the fleet never quiesced before the "
+                        "divergence drill")
+        s_div = healthz(p7_primary)["mutable"]["seq"]
+        st, body = http(vurl,
+                        f"/admin/wal-since?seq={max(0, s_div - 1)}"
+                        f"&limit=8")
+        if st != 200:
+            return fail(f"phase-7 wal-since on the victim: {st}: "
+                        f"{body[:200]}")
+        recs = json.loads(body)["records"]
+        if not recs:
+            return fail("phase-7: no WAL record to clone for the forged "
+                        "write")
+        template = recs[-1]
+        d_width = len(template["rows"][0])
+        # The forged record: same validated shape, same lineage position
+        # (seq s_div+1), content the primary will never ship. Rows sit at
+        # coordinate ~1000 — far outside the dataset — so a direct probe
+        # there separates "serving the forged row" from "healed".
+        forged = dict(template)
+        forged["seq"] = s_div + 1
+        forged["rows"] = [[1000.0 + j] * d_width
+                          for j in range(len(template["rows"]))]
+        st, body = http(vurl, "/admin/wal-append",
+                        {"records": [forged], "primary_seq": s_div + 1})
+        if st != 200:
+            return fail(f"phase-7: the forged record was refused ({st}: "
+                        f"{body[:200]}) — the drill could not create "
+                        f"divergence")
+        probe = [[1000.0] * d_width]
+        st, body = http(vurl, "/kneighbors", {"instances": probe})
+        if st != 200:
+            return fail(f"phase-7 pre-heal probe on the victim: {st}")
+        div_answer = json.loads(body)
+        st, body = http(p7_primary, "/kneighbors", {"instances": probe})
+        if st != 200:
+            return fail(f"phase-7 probe on the primary: {st}")
+        pri_answer = json.loads(body)
+        if div_answer["distances"] == pri_answer["distances"]:
+            return fail("phase-7: the forged record did not change the "
+                        "victim's answers — the drill proves nothing")
+        load = FleetLoad(router, test.features, train.num_classes, args)
+        load.start()
+
+        def p7_ship():
+            return (healthz(p7_primary)["fleet"]["followers"]
+                    or {}).get(vurl, {})
+
+        parked = wait_until(
+            lambda: (p7_ship()
+                     if p7_ship().get("state") == "diverged" else None),
+            timeout_s=30)
+        if parked is None:
+            load.finish()
+            return fail(f"phase-7: the same-seq/different-digest "
+                        f"backstop never fired — shipper state never "
+                        f"reached 'diverged' (ship {p7_ship()})")
+        if "diverg" not in str(parked.get("last_error", "")).lower():
+            load.finish()
+            return fail(f"phase-7: the park was not a typed "
+                        f"WALDivergence refusal: {parked}")
+
+        def p7_healed():
+            return (p7_ship().get("state") == "ok"
+                    and healthz(vurl)["mutable"]["seq"] >= s_div)
+
+        if not wait_until(p7_healed, timeout_s=60):
+            load.finish()
+            return fail(f"phase-7: the diverged follower never healed "
+                        f"via auto-bootstrap (ship {p7_ship()})")
+        t_heal = time.monotonic()
+        time.sleep(args.window_s / 4)
+        load.finish()
+        if load.read_failures:
+            return fail(f"phase-7 failed reads during the divergence "
+                        f"drill: {load.read_failures[:3]}")
+        if load.write_failures:
+            return fail(f"phase-7 write violations: "
+                        f"{load.write_failures[:3]}")
+        # The audit log must tell the self-healing story: reseed-begin +
+        # reseed-complete on the victim, driven by the auto trigger.
+        st, body = http(router, "/debug/events")
+        if st != 200:
+            return fail(f"phase-7 /debug/events -> {st}")
+        p7_events = json.loads(body)["events"]
+        begins = [e for e in p7_events if e["event"] == "reseed-begin"
+                  and e.get("follower") == vurl]
+        completes = [e for e in p7_events
+                     if e["event"] == "reseed-complete"
+                     and e.get("follower") == vurl]
+        if not begins or not completes:
+            return fail(f"phase-7: the re-seed left no audit trail "
+                        f"(begins={len(begins)}, "
+                        f"completes={len(completes)})")
+        if completes[0].get("trigger") != "auto":
+            return fail(f"phase-7: the re-seed was not auto-triggered: "
+                        f"{completes[0]}")
+        # The healed follower: the forged row must be GONE and its
+        # answer at the probe must replay bit-identical against the
+        # oracle of the primary's durable WAL.
+        st, body = http(vurl, "/kneighbors", {"instances": probe})
+        if st != 200:
+            return fail(f"phase-7 post-heal probe on the victim: {st}")
+        healed_answer = json.loads(body)
+        if healed_answer["distances"] == div_answer["distances"]:
+            return fail("phase-7: the healed follower still serves the "
+                        "forged row — the re-seed did not abandon the "
+                        "divergent lineage")
+        mirror = build_wal_mirror(model.train_.features, model.k,
+                                  model.metric, p7_primary)
+        bad = mirror.verify_reads(
+            [(np.asarray(probe, np.float32),
+              healed_answer["mutation_seq"],
+              healed_answer["index_version"], healed_answer["distances"],
+              healed_answer["indices"])],
+            {v0: ()}, "phase-7 healed probe")
+        if bad:
+            return fail("; ".join(bad))
+        # Never a divergent 200 through the router: every read outside
+        # the bounded divergence window (claimed seq past the fork,
+        # served before the heal) must replay bit-identical; window
+        # reads are excluded AND counted, exactly like phase 2's
+        # read-uncommitted accounting.
+        bad, excluded7 = verify_against_wal(
+            load, mirror, v0, "phase-7",
+            exclude=lambda seq, t: seq > s_div and t < t_heal)
+        if bad:
+            return fail("; ".join(bad[:3]))
+        report["phase7"] = {
+            "forked_at_seq": s_div,
+            "parked_error": str(parked.get("last_error"))[:160],
+            "reseed_trigger": completes[0].get("trigger"),
+            "reads_verified": len(load.reads) - excluded7,
+            "reads_excluded_divergence_window": excluded7,
+            "acked_writes": len(load.acked),
+        }
+        print(f"fleet-soak: phase 7 ok — forged same-seq record parked "
+              f"the shipper as typed WALDivergence at seq {s_div + 1}; "
+              f"auto-bootstrap re-seeded {victim} with no operator "
+              f"action; healed answers replay bit-identical "
+              f"({len(load.reads) - excluded7} reads verified, "
+              f"{excluded7} divergence-window reads excluded)")
+
+        # Tear the mutable fleet down before phase 4 (the immutable
+        # coordinated-reload drill keeps its historical number; it runs
+        # last because it boots its own fleet).
         for name in ("r1", "r2", "r3"):
             procgroup.kill_group(procs[name])
         procgroup.kill_group(router_proc)

@@ -234,12 +234,22 @@ class TestApplyReplicated:
 
     def test_records_since_behind_fold_is_typed(self, rng, tmp_path):
         """A cursor older than the oldest surviving record means the
-        follower must re-seed — typed, never a partial ship."""
+        follower must re-seed — typed, never a partial ship. But while
+        the records BELOW the fold still exist on disk (the retention
+        floor held them for exactly this lagging cursor), the same call
+        serves them — behind-the-fold is about missing records, not the
+        fold point itself."""
+        from knn_tpu.serve import artifact
+
         model, eng = self._engine(rng, tmp_path, "idx")
         root = _artifact(model, tmp_path, "idx")
         try:
             eng.apply_insert(np.ones((1, 4), np.float32), [0], 0)
             eng._folded_seq = 1  # as a compaction commit would set it
+            records, seq = eng.records_since(0)  # epoch retained: serves
+            assert [r["seq"] for r in records] == [1] and seq == 1
+            for _n, path in artifact.list_epochs(root):
+                path.unlink()  # now the pre-fold records are GONE
             with pytest.raises(DataError, match="re-seed"):
                 eng.records_since(0)
         finally:
