@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak fleet-soak capacity-probe replay-gate bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak fleet-soak shard-soak capacity-probe replay-gate bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -153,6 +153,15 @@ mutable-soak:
 fleet-soak:
 	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/fleet_soak.py \
 		--short --json-out build/fleet-soak-verdict.json
+
+# Mesh-sharded serving held to its contracts (docs/SERVING.md §Sharded
+# serving): a --shards 2 serve vs an unsharded twin under closed-loop
+# load (bit-identity live, not just in tests), mutation lockstep over
+# the sharded delta tail, straggler gauges on every surface, and the
+# shard-group kill drill behind the router.
+shard-soak:
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/shard_soak.py \
+		--short --json-out build/shard-soak-verdict.json
 
 # The cost & capacity gate (docs/OBSERVABILITY.md §Cost & capacity): boot
 # serve with cost accounting on and assert (1) every 200's timeline

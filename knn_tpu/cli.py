@@ -133,7 +133,10 @@ def build_parser() -> argparse.ArgumentParser:
 def _add_route_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("replicas", nargs="+", metavar="REPLICA_URL",
                    help="replica base URLs (e.g. http://127.0.0.1:8099); "
-                   "at least one")
+                   "at least one. Join N cooperating serve processes "
+                   "into one shard group with '+': url1+url2 forwards "
+                   "to url1 and treats the pair as usable only while "
+                   "BOTH are healthy (docs/SERVING.md §Sharded serving)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8098,
                    help="TCP port (0 picks an ephemeral port, reported "
@@ -276,6 +279,15 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    "construction, invalidated by reload/compaction, "
                    "knn_cache_* counters. 0 (default) constructs "
                    "nothing; leave it off for high-entropy query streams")
+    p.add_argument("--shards", default=None, metavar="N|auto",
+                   help="mesh-sharded serving (docs/SERVING.md §Sharded "
+                   "serving): partition the index across N shards of the "
+                   "device mesh — train rows for the exact rungs, whole "
+                   "IVF cells for the approximate rung, delta slots for "
+                   "the mutable tail — answering bit-identically to the "
+                   "single-device ladder from one serve process. 'auto' "
+                   "shards one per visible device; unset (default) "
+                   "constructs no shard machinery at all")
     p.add_argument("--platform", default=os.environ.get("KNN_TPU_PLATFORM"),
                    help="force a JAX platform (e.g. cpu, tpu) before model "
                    "warmup")
@@ -986,6 +998,23 @@ def _run_serve(args, stdout) -> int:
         if err is not None:
             print(f"error: {err}", file=sys.stderr)
             return EXIT_USAGE
+    # Resolve --shards AFTER the platform applies: 'auto' means one
+    # shard per device the configured platform actually exposes.
+    shards = None
+    if args.shards is not None:
+        if str(args.shards).lower() == "auto":
+            import jax
+
+            shards = len(jax.devices())
+        else:
+            try:
+                shards = int(args.shards)
+                if shards < 1:
+                    raise ValueError
+            except ValueError:
+                print(f"error: --shards wants a positive integer or "
+                      f"'auto', got {args.shards!r}", file=sys.stderr)
+                return EXIT_USAGE
     from knn_tpu.serve import artifact
     from knn_tpu.serve.server import ServeApp, make_server, serve_forever
 
@@ -1115,6 +1144,7 @@ def _run_serve(args, stdout) -> int:
             follower_of=args.follower_of, replicate_to=replicate_to,
             replicate_ack=args.replicate_ack,
             replicate_ack_timeout_s=args.replicate_ack_timeout_s,
+            shards=shards,
         )
     except OSError as e:  # an unwritable --access-log / --capture-dir path
         print(f"error: {e}", file=sys.stderr)
@@ -1160,6 +1190,12 @@ def _run_serve(args, stdout) -> int:
                          if role == "follower"
                          else f" -> {len(replicate_to or ())} follower(s)"
                               f" ack={args.replicate_ack}"))
+    shard_note = ""
+    if app.shards is not None:
+        plan = app.model.shard_plan_
+        shard_note = (f", shards={plan.num_shards}"
+                      + ("/cells" if getattr(app.model, 'ivf_', None)
+                         is not None else ""))
     bucket_note = ""
     if batch_buckets is not None:
         bucket_note = f", buckets={'/'.join(str(b) for b in batch_buckets)}"
@@ -1170,7 +1206,7 @@ def _run_serve(args, stdout) -> int:
         f"(family={app.family}, k={model.k}, "
         f"train_rows={model.train_.num_instances}, "
         f"index_version={version}{ivf_note}{mutable_note}{fleet_note}"
-        f"{bucket_note}, warmed={sorted(warmed)})",
+        f"{shard_note}{bucket_note}, warmed={sorted(warmed)})",
         file=stdout, flush=True,
     )
     return serve_forever(server, drain_timeout_s=args.drain_timeout_s)
@@ -1202,11 +1238,16 @@ def _run_route(args, stdout) -> int:
         if bad:
             print(f"error: {msg}", file=sys.stderr)
             return EXIT_USAGE
-    for url in args.replicas:
-        if not url.startswith(("http://", "https://")):
-            print(f"error: replica URL {url!r} must start with http:// "
-                  f"or https://", file=sys.stderr)
+    for spec in args.replicas:
+        members = [u for u in spec.split("+") if u]
+        if not members:
+            print(f"error: empty replica spec {spec!r}", file=sys.stderr)
             return EXIT_USAGE
+        for url in members:
+            if not url.startswith(("http://", "https://")):
+                print(f"error: replica URL {url!r} must start with "
+                      f"http:// or https://", file=sys.stderr)
+                return EXIT_USAGE
     from knn_tpu.fleet.router import (
         RouterApp,
         make_router_server,

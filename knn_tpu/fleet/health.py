@@ -14,6 +14,16 @@ paths, one promotion path:
   connect, not a poll interval later;
 - a replica only becomes usable again through a successful poll (a lucky
   forward is not evidence of health — the poll reads the whole document).
+
+**Shard groups** (mesh-sharded serving, docs/SERVING.md §Sharded
+serving): a replica spec ``url1+url2+...`` declares that one logical
+"replica" is N cooperating serve processes (a multi-process shard
+group). The FIRST member is the head — the only URL the router forwards
+to — and the group is usable only while EVERY member's poll is healthy:
+a shard group missing one process cannot answer from its whole index,
+so a partial group must look down to routing (the kill-one-member
+drill degrades typed instead of serving wrong-shard answers). Role,
+seq, and version read from the head's document.
 """
 
 from __future__ import annotations
@@ -70,9 +80,23 @@ class ReplicaSet:
         if not urls:
             raise ValueError("a replica set needs at least one replica "
                              "base URL")
-        self.urls = [u.rstrip("/") for u in urls]
-        if len(set(self.urls)) != len(self.urls):
-            raise ValueError(f"duplicate replica URLs: {self.urls}")
+        #: head url -> every member url (heads included), for specs of
+        #: the ``url1+url2`` shard-group form; singleton replicas are
+        #: absent (the common case pays one dict miss, nothing else).
+        self.groups: "dict[str, tuple[str, ...]]" = {}
+        heads, members_all = [], []
+        for spec in urls:
+            members = [u.rstrip("/") for u in str(spec).split("+") if u]
+            if not members:
+                raise ValueError(f"empty replica spec in {urls!r}")
+            heads.append(members[0])
+            members_all.extend(members)
+            if len(members) > 1:
+                self.groups[members[0]] = tuple(members)
+        self.urls = heads
+        if len(set(members_all)) != len(members_all):
+            raise ValueError(f"duplicate replica URLs: {members_all}")
+        self._members = members_all
         self.interval_s = float(interval_s)
         self.poll_timeout_s = float(poll_timeout_s)
         self._on_poll = on_poll
@@ -81,7 +105,7 @@ class ReplicaSet:
         #: events; steady states are not.
         self.events = events
         self._lock = threading.Lock()
-        self._states = {u: ReplicaState(u) for u in self.urls}
+        self._states = {u: ReplicaState(u) for u in self._members}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -108,7 +132,7 @@ class ReplicaSet:
     # -- polling -----------------------------------------------------------
 
     def poll_once(self) -> None:
-        for url in self.urls:
+        for url in self._members:
             self._poll(url)
         cb = self._on_poll
         if cb is not None:
@@ -196,10 +220,20 @@ class ReplicaSet:
         self._mark_down(url.rstrip("/"), err, event="passive-demote",
                         request_id=request_id)
 
+    def _group_ok(self, head: str) -> bool:
+        """Caller holds ``self._lock``. A shard group is usable only
+        while EVERY member is healthy — a partial group cannot answer
+        from its whole index."""
+        return all(self._states[m].healthy
+                   for m in self.groups.get(head, (head,)))
+
     def is_healthy(self, url: str) -> bool:
+        url = url.rstrip("/")
         with self._lock:
-            s = self._states.get(url.rstrip("/"))
-            return bool(s is not None and s.healthy)
+            if url not in self._states:
+                return False
+            return self._group_ok(url) if url in self.groups \
+                else self._states[url].healthy
 
     def _export_gauge(self, url: str) -> None:
         obs.gauge_set(
@@ -218,7 +252,7 @@ class ReplicaSet:
         """Healthy replicas, rotated by ``start`` (the router's
         round-robin cursor) so consecutive reads spread the load."""
         with self._lock:
-            up = [u for u in self.urls if self._states[u].healthy]
+            up = [u for u in self.urls if self._group_ok(u)]
         if not up:
             return []
         k = start % len(up)
@@ -232,7 +266,7 @@ class ReplicaSet:
     def primaries(self) -> "list[str]":
         with self._lock:
             return [u for u in self.urls
-                    if self._states[u].healthy
+                    if self._group_ok(u)
                     and self._states[u].role == "primary"]
 
     def down_primary(self) -> Optional[str]:
@@ -241,7 +275,7 @@ class ReplicaSet:
         exists)."""
         with self._lock:
             healthy_primary = any(
-                self._states[u].healthy
+                self._group_ok(u)
                 and self._states[u].role == "primary" for u in self.urls)
             if healthy_primary:
                 return None
@@ -258,7 +292,7 @@ class ReplicaSet:
         with self._lock:
             candidates = [
                 (self._states[u].applied_seq, u) for u in self.urls
-                if u not in exclude and self._states[u].healthy
+                if u not in exclude and self._group_ok(u)
                 and self._states[u].role == "follower"
             ]
         if not candidates:
@@ -268,6 +302,15 @@ class ReplicaSet:
     def export(self) -> dict:
         with self._lock:
             states = {u: self._states[u].export() for u in self.urls}
+            for head, members in self.groups.items():
+                states[head]["shard_group"] = {
+                    "members": list(members),
+                    "unhealthy": [m for m in members
+                                  if not self._states[m].healthy],
+                }
+                # The exported health of a grouped replica is the
+                # GROUP's usability, not just the head's poll.
+                states[head]["healthy"] = self._group_ok(head)
         primaries = [u for u, s in states.items()
                      if s["healthy"] and s["role"] == "primary"]
         primary_seq = max((s["applied_seq"] for s in states.values()

@@ -82,6 +82,33 @@ def lexicographic_topk(dists: np.ndarray, indices: np.ndarray, k: int):
     return d_out, i_out
 
 
+def lexicographic_topk_jax(dists, indices, k: int, *payload):
+    """The DEVICE realization of the same contract (traced; callers jit):
+    one two-key ``lax.sort`` over (distance, index), ascending, equal
+    distances breaking to the lowest index — exactly
+    :func:`lexicographic_topk`'s order, on device arrays.
+
+    ``dists``/``indices`` — ``[..., M]`` candidate arrays (indices must be
+    a sortable integer dtype); ``k`` — static slice width (clamped to M by
+    the slice itself); ``payload`` — extra ``[..., M]`` operands carried
+    through the permutation WITHOUT participating in the key (the
+    train-sharded merge rides its gathered labels here). Returns the
+    sorted ``k``-prefix of every operand: ``(d, i)`` or
+    ``(d, i, *payload)``.
+
+    This is the one definition the in-kernel consumers share —
+    ``ops/segment_score.margin_select``'s exact tie branch and
+    ``parallel/train_sharded.merge_candidates_vote`` both select through
+    it — and it is pinned against the host twin on adversarial tie
+    plateaus by tests/test_shard.py.
+    """
+    from jax import lax
+
+    ordered = lax.sort((dists, indices, *payload), dimension=-1,
+                       num_keys=2)
+    return tuple(o[..., :k] for o in ordered)
+
+
 def _packed_topk_f32(dists: np.ndarray, indices: np.ndarray, k: int,
                      shared: bool):
     """The vectorized realization: uint64 keys ``(f32 bits << 32) | idx``.

@@ -170,6 +170,54 @@ def straggler_gauges(
     return out
 
 
+def local_straggler_gauges(path: str,
+                           walls_ms: Dict[int, float]) -> Optional[dict]:
+    """The IN-PROCESS twin of :func:`straggler_gauges`: derive the same
+    ``knn_shard_dispatch_ms_max/min`` + ``knn_shard_dispatch_skew``
+    family from one sharded serve dispatch's per-shard walls
+    (``{shard: wall_ms}``) and set them on the default registry. One
+    metric family for both topologies — a dashboard watching shard skew
+    does not care whether the shards are logical (one process, PR 18's
+    ``serve --shards``) or whole processes (the multihost launcher).
+    Returns ``{"max_ms", "min_ms", "skew", "max_shard", "shards"}`` or
+    None when obs is off / no walls."""
+    from knn_tpu import obs
+
+    if not walls_ms or not obs.enabled():
+        return None
+    vals = list(walls_ms.values())
+    mx, mn = max(vals), min(vals)
+    # Same finite-skew clamp as the fleet derivation above.
+    skew = 1.0 if mx == 0 else mx / max(mn, 0.001)
+    obs.gauge_set(
+        "knn_shard_dispatch_ms_max",
+        round(mx, 3),
+        help="slowest process's sharded dispatch->fetch wall ms",
+        path=path,
+    )
+    obs.gauge_set(
+        "knn_shard_dispatch_ms_min",
+        round(mn, 3),
+        help="fastest process's sharded dispatch->fetch wall ms",
+        path=path,
+    )
+    obs.gauge_set(
+        "knn_shard_dispatch_skew",
+        round(skew, 4),
+        help="straggler ratio: max/min sharded dispatch wall across "
+             "processes (1.0 = balanced; min clamped to the 0.001 ms "
+             "rounding floor so the gauge stays finite)",
+        path=path,
+    )
+    return {
+        "max_ms": round(mx, 3),
+        "min_ms": round(mn, 3),
+        "skew": round(skew, 4),
+        "max_shard": max(walls_ms, key=walls_ms.get),
+        "shards": len(walls_ms),
+    }
+
+
 def aggregate_multihost(
     registry: Optional[MetricsRegistry] = None,
 ) -> Tuple[Optional[MetricsRegistry], Dict[str, dict]]:

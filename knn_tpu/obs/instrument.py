@@ -248,6 +248,44 @@ def record_shard_dispatch(path: str, t0_monotonic: float) -> None:
         )
 
 
+def record_shard_wall(path: str, shard: int, wall_ms: float) -> None:
+    """One logical shard's dispatch->resolve wall within a sharded serve
+    process (``knn_shard_dispatch_ms{path=..., shard=N}``, last call
+    wins) — the in-process twin of :func:`record_shard_dispatch`'s
+    per-process gauge. ``obs/aggregate.local_straggler_gauges`` derives
+    the same ``knn_shard_dispatch_ms_max/min`` + skew family from these
+    walls that the fleet path derives from merged snapshots."""
+    if obs.enabled():
+        obs.gauge_set(
+            "knn_shard_dispatch_ms", round(wall_ms, 3),
+            help="this process's last sharded dispatch->fetch wall ms "
+                 "(the fleet straggler signal — obs/aggregate.py)",
+            path=path, shard=str(shard),
+        )
+
+
+def record_shard_candidates(path: str, shard: int, rows: int,
+                            nbytes: int) -> None:
+    """Per-shard candidate/byte counters for one sharded dispatch
+    (``knn_shard_candidates_total`` / ``knn_shard_bytes_total``): how
+    many survivor candidate rows each shard contributed to the
+    cross-shard merge and the host bytes those survivors carried —
+    the imbalance signal /debug/capacity's shard block surfaces beside
+    the dispatch-wall skew."""
+    if not obs.enabled():
+        return
+    obs.counter_add(
+        "knn_shard_candidates_total", int(rows),
+        help="survivor candidate rows contributed to cross-shard merges "
+             "per shard", path=path, shard=str(shard),
+    )
+    obs.counter_add(
+        "knn_shard_bytes_total", int(nbytes),
+        help="host bytes of per-shard survivor candidates merged "
+             "cross-shard", path=path, shard=str(shard),
+    )
+
+
 def record_collective(path: str, op: str, nbytes: int) -> None:
     """Count modeled collective-traffic bytes for one sharded predict call.
 

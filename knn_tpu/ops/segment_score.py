@@ -74,9 +74,11 @@ def margin_select(d, ids, kk: int, row_ok=None):
     import jax.numpy as jnp
     from jax import lax
 
+    from knn_tpu.models.ordering import lexicographic_topk_jax
+
     def exact(_):
-        sd, si = lax.sort((d, ids), dimension=-1, num_keys=2)
-        return sd[:, :kk], si[:, :kk]
+        sd, si = lexicographic_topk_jax(d, ids, kk)
+        return sd, si
 
     if kk >= d.shape[1]:
         return exact(None)

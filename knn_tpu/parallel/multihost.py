@@ -251,6 +251,114 @@ def predict_query_sharded_global(
     return preds
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_global_train_sharded_fn(k, num_classes, precision, query_tile,
+                                    train_tile):
+    """Train-sharded twin of :func:`_cached_global_fn`: a 1-D ``t`` mesh
+    over ALL processes' devices, queries replicated, train rows
+    scattered — the per-shard body, all-gather merge, and tie contract
+    are the single-controller ``build_train_sharded_fn`` verbatim, so
+    the launcher path cannot drift from the tested one."""
+    import jax
+    from jax.sharding import Mesh
+
+    from knn_tpu.parallel.train_sharded import build_train_sharded_fn
+
+    mesh = Mesh(np.array(jax.devices()), axis_names=("t",))
+    fn = build_train_sharded_fn(
+        mesh, k, num_classes, precision, query_tile, train_tile,
+        q_axis=None, t_axis="t",
+    )
+    return mesh, fn
+
+
+def predict_train_sharded_global(
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    test_x: np.ndarray,
+    k: int,
+    num_classes: int,
+    precision: str = "exact",
+    query_tile: int = 64,
+    train_tile: int = 2048,
+) -> np.ndarray:
+    """Train-sharded classify over ALL devices of ALL processes: the
+    index that does not fit one device, under the real launcher.
+
+    Call identically from every process with identical (replicated) host
+    arrays; returns the full prediction vector on every process (the
+    out-spec is replicated — ``MPI_Allgatherv`` rather than the
+    query-sharded path's scatter/gather). The row partition is
+    ``knn_tpu.shard.plan.plan_rows_uniform`` — the serve tier's plan
+    module — with the stride from ``train_sharded.xla_shard_layout``,
+    so the launcher and the mesh-sharded serve path agree on what a
+    shard boundary is. XLA tiled-scan engine only: the stripe kernel's
+    transposed column sharding is a single-controller layout
+    (``stripe_prepare_sharded``); callers wanting stripe use
+    ``--strategy query-sharded``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from knn_tpu.parallel.train_sharded import xla_shard_layout
+    from knn_tpu.shard.plan import plan_rows_uniform
+    from knn_tpu.utils.padding import pad_axis_to_multiple
+
+    q, n = test_x.shape[0], train_x.shape[0]
+    n_dev = len(jax.devices())
+    train_tile, shard_rows = xla_shard_layout(n, n_dev, train_tile, k)
+    plan = plan_rows_uniform(n, n_dev, shard_rows)
+    mesh, fn = _cached_global_train_sharded_fn(
+        k, num_classes, precision, query_tile, train_tile
+    )
+    tx, _ = pad_axis_to_multiple(
+        train_x.astype(np.float32), shard_rows * n_dev, axis=0
+    )
+    ty, _ = pad_axis_to_multiple(
+        train_y.astype(np.int32), shard_rows * n_dev, axis=0
+    )
+    qx, _ = pad_axis_to_multiple(test_x.astype(np.float32), query_tile, axis=0)
+
+    def make_global(host_arr: np.ndarray, spec: P):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            host_arr.shape, sharding, lambda idx: host_arr[idx]
+        )
+
+    g_train_x = make_global(np.ascontiguousarray(tx), P("t"))
+    g_train_y = make_global(np.ascontiguousarray(ty), P("t"))
+    g_test_x = make_global(np.ascontiguousarray(qx), P())
+    g_nv = make_global(np.asarray(n, np.int32), P())
+
+    from knn_tpu import obs
+    from knn_tpu.obs.instrument import record_collective, record_shard_dispatch
+    from knn_tpu.resilience.retry import guarded_call
+
+    if obs.enabled():
+        from knn_tpu.parallel.comm_audit import model_train_sharded_bytes
+
+        record_collective(
+            "train-sharded", "all_gather",
+            model_train_sharded_bytes(qx.shape[0], k, plan.num_shards),
+        )
+
+    import time
+
+    t0 = time.monotonic()
+    out = guarded_call(
+        "collective.step", lambda: fn(g_train_x, g_train_y, g_test_x, g_nv)
+    )
+
+    def fetch():
+        if out.is_fully_addressable:
+            return np.asarray(out)[:q]
+        return np.asarray(out.addressable_data(0))[:q]
+
+    preds = guarded_call("collective.step", fetch)
+    record_shard_dispatch("train-sharded", t0)
+    return preds
+
+
 def _worker_main(argv) -> int:
     """SPMD worker body — one copy per process (see module docstring)."""
     import argparse
@@ -264,6 +372,13 @@ def _worker_main(argv) -> int:
     p.add_argument("--engine", default="auto", choices=["auto", "stripe", "xla"],
                    help="per-shard candidate kernel (auto: stripe on real TPU "
                    "for exact narrow-feature problems)")
+    p.add_argument("--strategy", default="query-sharded",
+                   choices=["query-sharded", "train-sharded"],
+                   help="what the global mesh scatters: queries "
+                   "(MPI_Scatter of test rows, the reference's layout) or "
+                   "train rows (the index that does not fit one device; "
+                   "all-gathered top-k merge, docs/SERVING.md §Sharded "
+                   "serving). train-sharded is XLA-engine only")
     p.add_argument("--dump-predictions", default=None,
                    help="rank 0 writes the prediction vector here (npy)")
     p.add_argument("--metrics-out", default=None,
@@ -274,6 +389,13 @@ def _worker_main(argv) -> int:
                    "obs/aggregate.py. Implies enabling knn_tpu.obs on "
                    "every process")
     args = p.parse_args(argv)
+    if args.strategy == "train-sharded" and args.engine == "stripe":
+        # The stripe kernel's transposed column sharding is a
+        # single-controller layout; see predict_train_sharded_global.
+        print("error: --strategy train-sharded implements the xla engine "
+              "only; drop --engine stripe or use --strategy query-sharded",
+              file=sys.stderr)
+        return 2
 
     import jax
 
@@ -352,12 +474,19 @@ def _worker_main(argv) -> int:
 
     try:
         with RegionTimer() as t:
-            preds = predict_query_sharded_global(
-                train.features, train.labels, test.features, args.k,
-                train.num_classes,
-                query_tile=args.query_tile, train_tile=args.train_tile,
-                engine=args.engine,
-            )
+            if args.strategy == "train-sharded":
+                preds = predict_train_sharded_global(
+                    train.features, train.labels, test.features, args.k,
+                    train.num_classes,
+                    query_tile=args.query_tile, train_tile=args.train_tile,
+                )
+            else:
+                preds = predict_query_sharded_global(
+                    train.features, train.labels, test.features, args.k,
+                    train.num_classes,
+                    query_tile=args.query_tile, train_tile=args.train_tile,
+                    engine=args.engine,
+                )
     except ResilienceError as e:
         # A mid-collective failure with peers already joined: degrading N
         # processes to N solo runs would duplicate the rank-0 report, so

@@ -66,9 +66,18 @@ def xla_shard_layout(
 def merge_candidates_vote(
     d: jnp.ndarray, i: jnp.ndarray, l: jnp.ndarray, k: int, num_classes: int
 ) -> jnp.ndarray:
-    """[Q, C>=k] candidate triples -> [Q] predictions, tie-stable."""
-    s_d, s_i, s_l = lax.sort((d, i, l), dimension=-1, num_keys=2)
-    return vote(s_l[..., :k], num_classes)
+    """[Q, C>=k] candidate triples -> [Q] predictions, tie-stable.
+
+    The cross-shard merge selects through
+    ``models/ordering.lexicographic_topk_jax`` — THE (distance, index)
+    contract's device realization — with the gathered labels riding the
+    sort as payload, so shard boundaries can never reorder equal
+    distances differently from the single-device rungs (pinned on
+    adversarial tie plateaus by tests/test_shard.py)."""
+    from knn_tpu.models.ordering import lexicographic_topk_jax
+
+    _s_d, _s_i, s_l = lexicographic_topk_jax(d, i, k, l)
+    return vote(s_l, num_classes)
 
 
 def build_train_sharded_fn(

@@ -997,8 +997,18 @@ class MicroBatcher:
             engine = model._retrieval_engine()
         else:
             engine = model.engine
+        sharded = getattr(model, "shard_plan_", None) is not None
 
         def fast(feats, prefetched=None, merge_tail=None):
+            if sharded:
+                # Mesh-sharded model (knn_tpu/shard/): the fast rung IS
+                # the fanned-out dispatch — per-shard device retrieval,
+                # cross-shard lexicographic merge, bit-identical to the
+                # single-device rung. The stager's whole-train prefetch
+                # does not apply (each shard uploads its own slice); the
+                # xla rung below stays single-device, so a shard-layer
+                # failure degrades to the unsharded ladder, typed.
+                return model.sharded_kneighbors(np.asarray(feats))
             if self._stager is not None or merge_tail is not None:
                 # Bucketed serving: dispatch DEFERRED (device work +
                 # result copies in flight when _kneighbors_arrays
@@ -1092,6 +1102,23 @@ class MicroBatcher:
 
             return merged_ivf
         tview = getattr(mview, "device", None)
+        if (name == "fast"
+                and getattr(model, "shard_plan_", None) is not None):
+            if (tview is not None and mview.tomb_base.size == 0
+                    and model.metric in (None, "euclidean")):
+                # Sharded fused merge: each shard carries its slice of
+                # the device tail in its own dispatch (the sharded
+                # dispatch forces the XLA engine — merge_tail is an
+                # XLA-path hook), survivors re-rank through the same
+                # host exact pass as the single-device fused path.
+                def merged_shard(feats, prefetched=None):
+                    return model.sharded_kneighbors(
+                        np.asarray(feats, np.float32), view=mview)
+
+                return merged_shard
+            # Tombstoned-base / non-euclidean views: fall through to the
+            # host merge below — ``fn`` is already the sharded base
+            # dispatch, and the host merge is topology-blind.
         if (tview is not None and name in ("fast", "xla")
                 and mview.tomb_base.size == 0
                 and model.metric in (None, "euclidean")

@@ -187,7 +187,8 @@ class ServeApp:
                  batch_buckets=None, result_cache_rows: int = 0,
                  follower_of: Optional[str] = None,
                  replicate_to=None, replicate_ack: str = "any",
-                 replicate_ack_timeout_s: float = 5.0):
+                 replicate_ack_timeout_s: float = 5.0,
+                 shards: Optional[int] = None):
         self._previous_buckets = None
         self._installed_buckets = False
         if batch_buckets is not None:
@@ -206,6 +207,17 @@ class ServeApp:
             self._previous_buckets = query_buckets()
             set_query_buckets(batch_buckets)
             self._installed_buckets = True
+        # Mesh-sharded serving (knn_tpu/shard/, docs/SERVING.md §Sharded
+        # serving): --shards partitions the index across the device mesh
+        # behind the same rung ladder. None (the default) constructs
+        # NOTHING — no shard package import, no wrapped model, no
+        # knn_shard_* instruments (scripts/check_disabled_overhead.py
+        # pins it). The count arrives RESOLVED (the CLI maps "auto" to
+        # the device count).
+        self.shards: Optional[int] = None
+        if shards is not None:
+            model = self._wrap_shards_new(model, int(shards))
+            self.shards = model.shard_plan_.num_shards
         self.model = model
         self.family = (
             "classifier" if isinstance(model, KNNClassifier) else "regressor"
@@ -449,11 +461,32 @@ class ServeApp:
         self.ready = True
         return self.warmup_ms
 
+    @staticmethod
+    def _wrap_shards_new(model, shards: int):
+        from knn_tpu.shard.model import make_sharded
+
+        return make_sharded(model, shards)
+
+    def _wrap_shards(self, model):
+        """Shard a REPLACEMENT model (compaction fold, hot reload,
+        bootstrap) when this app serves sharded. Memoized on the
+        unsharded instance so the warm pass and the swap share one
+        wrapped twin (and its per-shard executable caches) — wrapping
+        twice would throw the warmup compiles away."""
+        if self.shards is None or getattr(
+                model, "shard_plan_", None) is not None:
+            return model
+        tw = getattr(model, "_sharded_twin", None)
+        if tw is None:
+            tw = self._wrap_shards_new(model, self.shards)
+            model._sharded_twin = tw
+        return tw
+
     def _warm_replacement(self, model) -> dict:
         """Compile a compaction's replacement model at the serving batch
         shapes, OFF the serving path (the reload warmup rule)."""
         return artifact.warmup(
-            model,
+            self._wrap_shards(model),
             batch_sizes=self._warm_sizes or (1, self.batcher.max_batch),
             kinds=("predict",),
         )
@@ -464,6 +497,10 @@ class ServeApp:
         the old or the new (model, version, view) triple — the
         atomic-swap assertion of the mutable soak), then the app-level
         bookkeeping hot reload also does."""
+        # The engine rebases onto the UNSHARDED replacement (they share
+        # the train dataset instance); serving dispatch swaps to the
+        # sharded twin — the same twin _warm_replacement compiled.
+        model = self._wrap_shards(model)
         previous = self.batcher.swap_model(model, version,
                                            hook=rebase_hook)
         # Past this point the swap HAPPENED (run_once reports a failure
@@ -651,6 +688,7 @@ class ServeApp:
                     f"IVF partition — rebuild it with `save-index "
                     f"--ivf-cells N` or redeploy exact-only"
                 )
+            model = self._wrap_shards(model)
             # Warm in the background sense: the OLD index keeps serving
             # while these compiles run — they touch only the new model's
             # device cache.
@@ -844,6 +882,10 @@ class ServeApp:
             # state — while --capture-dir is unset.
             "workload": (self.workload.export()
                          if self.workload is not None else None),
+            # The shard topology + last-dispatch walls/stragglers
+            # (knn_tpu/shard/). None — the distinct "unsharded" state —
+            # while --shards is unset.
+            "shard": self.shard_block(),
             # The replication role (knn_tpu/fleet/replica.py): role,
             # applied_seq, follower cursors/lag on a primary, the
             # takeover point after a promotion. None — the distinct
@@ -854,6 +896,15 @@ class ServeApp:
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
         return h
+
+    def shard_block(self) -> "Optional[dict]":
+        """The sharded-serving summary for ``/healthz`` and
+        ``/debug/capacity``: the frozen plan, per-shard walls of the last
+        fanned-out dispatch, and the straggler derivation — what the
+        skew-triage runbook (docs/SERVING.md) reads. None while
+        --shards is unset (the model then has no shard surface at all)."""
+        export = getattr(self.model, "shard_export", None)
+        return export() if export is not None else None
 
     def quality_block(self) -> dict:
         """The answer-quality summary for ``/healthz`` (and the core of
@@ -1080,6 +1131,10 @@ class _Handler(BaseHTTPRequestHandler):
             # page an operator sizes replicas from. None while off.
             "mutable": (self.app.mutable.export()
                         if self.app.mutable is not None else None),
+            # The shard fanout is a capacity lever too: per-shard
+            # candidate/byte spend and the straggler skew bound the
+            # win from adding shards. None while --shards is unset.
+            "shard": self.app.shard_block(),
             "index_version": self.app.index_version,
         }
         # No request_id stamped into a payload about OTHER requests (the

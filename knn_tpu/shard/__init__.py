@@ -1,0 +1,24 @@
+"""Mesh-sharded serving: one serve process, one index spread over shards.
+
+The distributed IVF of Johnson et al.'s billion-scale search (PAPERS.md)
+realized inside the serving ladder: the train matrix (exact rungs) and
+the IVF cell permutation (approximate rung) partition into deterministic
+contiguous shards (:mod:`knn_tpu.shard.plan`), each shard dispatches the
+existing per-device retrieval — the XLA tiled scan or PR 13's fused
+segment gather+score+select — and the per-shard survivors merge through
+``models/ordering.lexicographic_topk`` followed by the existing host
+exact re-rank, so the sharded answer is bit-identical to the
+single-device rungs on the same artifact (:mod:`knn_tpu.shard.model`).
+
+The mutable delta tail shards with the base: the WAL stays the single
+ordered truth, each shard fuses its contiguous slice of the
+device-resident delta (``mutable/device_tail.slice_view``) into its own
+dispatch, and compaction re-partitions deterministically because the
+plan is a pure function of (row count, shard count).
+
+Everything here is imported lazily — ``serve --shards`` unset constructs
+none of it (``scripts/check_disabled_overhead.py`` pins the module out
+of ``sys.modules`` on a default boot).
+"""
+
+from __future__ import annotations

@@ -1,0 +1,176 @@
+"""Per-shard dispatch + cross-shard merge — the sharded retrieval core.
+
+One serving dispatch fans the query block out to every shard's slice of
+the index (all device work in flight before the first resolve — the
+shards pipeline through the device queue), then merges the per-shard
+survivors on the host through ``models/ordering.lexicographic_topk``:
+
+- **exact rungs** (:func:`exact_sharded`): each shard runs the ordinary
+  ``models/knn._kneighbors_arrays`` over its contiguous row slice. The
+  per-pair subtraction-form distance reduces over the FEATURE axis only,
+  so a pair's distance is bit-identical whether the train operand is the
+  full matrix or a shard slice — which makes the cross-shard
+  lexicographic merge of per-shard exact top-k EXACTLY the single-device
+  answer, distances included. No re-rank is needed; the merge is the
+  proof.
+- **mutable exact rungs** (``view=`` given): each shard fuses its
+  contiguous delta-tail slice (``mutable/device_tail.slice_view``) into
+  its own dispatch via ``make_merge_tail``, per-shard survivors carry
+  the RERANK_PAD margin, and the existing host exact re-rank
+  (``mutable/device_tail.rerank_merged``) restores the bit-exact merged
+  answer — the same margin + re-rank contract the single-device fused
+  path makes.
+- the **ivf rung** lives on :class:`knn_tpu.shard.model.ShardedIVFIndex`
+  (per-shard segment scorer + the existing ``_exact_rerank``), but its
+  cross-shard merge comes back through :func:`merge_survivors` here.
+
+Per-shard walls/candidates feed the ``knn_shard_*`` instruments and the
+straggler gauges (``obs/aggregate.local_straggler_gauges``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+#: The label every serving shard instrument carries — the in-process
+#: logical-shard topology, distinct from the multihost per-process paths
+#: (obs/instrument.STRATEGY_PATHS).
+SERVE_PATH = "serve-sharded"
+
+
+def note_shard_metrics(walls_ms: dict, parts_d, parts_i,
+                       path: str = SERVE_PATH) -> Optional[dict]:
+    """Record per-shard instruments for one fanned-out dispatch: the
+    per-shard wall gauges + candidate/byte counters, then the derived
+    max/min/skew straggler family. Returns the straggler summary (or
+    None when obs is off)."""
+    from knn_tpu import obs
+
+    if not obs.enabled():
+        return None
+    from knn_tpu.obs import aggregate, instrument
+
+    for s, wall in walls_ms.items():
+        instrument.record_shard_wall(path, s, wall)
+        d, i = parts_d[s], parts_i[s]
+        instrument.record_shard_candidates(
+            path, s, int(d.shape[0] * d.shape[1]),
+            int(d.nbytes + i.nbytes))
+    return aggregate.local_straggler_gauges(path, walls_ms)
+
+
+def merge_survivors(parts_d, parts_i, keep: int):
+    """Cross-shard top-``keep``: concatenate every shard's survivor
+    columns (ragged widths fine — small shards contribute fewer) and
+    select under THE (distance, index) contract. Ids must already be
+    GLOBAL and sentinel-sanitized by the caller."""
+    from knn_tpu.models.ordering import lexicographic_topk
+
+    all_d = np.concatenate(parts_d, axis=1)
+    all_i = np.concatenate(parts_i, axis=1)
+    return lexicographic_topk(all_d, all_i, keep)
+
+
+def _sanitize_fused(d, i, r0: int, n_s: int, slice_stop: int, view):
+    """Host fixups for one shard's fused (base + delta-slice) survivors:
+
+    - local base ids (``< n_s``) offset to global rows;
+    - the SLICE sentinel (``view.base_n + slice_stop`` — a real slot id
+      of the NEXT shard when the slice stops short of the parent count,
+      see ``device_tail.slice_view``) remaps to the parent sentinel with
+      +inf distance, so a dead-slot marker from shard ``s`` can never be
+      re-scored as a live delta row of shard ``s+1``.
+
+    Base ids after the offset stay strictly below ``view.base_n`` and
+    genuine delta ids strictly below the slice sentinel, so the equality
+    rewrite can never touch a real candidate."""
+    i = np.asarray(i, np.int64)
+    d = np.asarray(d, np.float32)
+    base = i < n_s
+    i = np.where(base, i + r0, i)
+    slice_sent = view.base_n + slice_stop
+    stale = i == slice_sent
+    if stale.any():
+        i = np.where(stale, view.sentinel, i)
+        d = np.where(stale, np.inf, d)
+    return d, i
+
+
+def exact_sharded(state, feats: np.ndarray, k: int, metric: str,
+                  engine: str, view=None):
+    """The sharded exact retrieval: ``(dists [Q,k], idx [Q,k])``
+    bit-identical to the single-device exact rungs on the same train
+    matrix (see the module docstring for why). ``state`` is the
+    :class:`knn_tpu.shard.model._ShardState`; ``view`` a live
+    :class:`~knn_tpu.mutable.state.MutableView` carrying a device tail
+    (the caller — ``serve/batcher.py`` — guarantees fused eligibility:
+    device tail present, no base tombstones, euclidean metric)."""
+    from knn_tpu.models.knn import _kneighbors_arrays
+    from knn_tpu.ops.segment_score import RERANK_PAD
+
+    feats = np.ascontiguousarray(feats, np.float32)
+    plan = state.plan
+    fused = view is not None
+    if fused:
+        engine = "xla"  # merge_tail is an XLA-path hook
+        tails, slices = state.merge_tails(view, k)
+    else:
+        tails, slices = (None,) * plan.num_shards, None
+
+    from knn_tpu import obs
+
+    if obs.enabled():
+        from knn_tpu.obs import devprof
+
+        # The fanout itself is part of what compiles: N per-shard
+        # executables per bucket, keyed so a sharded boot never reads as
+        # cache aliasing with an unsharded one.
+        devprof.record_executable_lookup("retrieval", (
+            "sharded-fanout", plan.num_shards, feats.shape[0], k,
+            bool(fused)))
+
+    # Dispatch EVERY shard deferred before resolving any: device work for
+    # shard s+1 queues behind shard s instead of waiting on its host sync.
+    resolves = []
+    for s in range(plan.num_shards):
+        r0, r1 = plan.rows(s)
+        k_s = min(k, r1 - r0)
+        resolves.append(_kneighbors_arrays(
+            state.features[s], feats, k_s, metric=metric, engine=engine,
+            cache=state.caches[s], deferred=True, merge_tail=tails[s],
+        ))
+
+    parts_d, parts_i, walls = [], [], {}
+    t0 = time.monotonic()
+    for s, resolve in enumerate(resolves):
+        d, i = resolve()
+        walls[s] = (time.monotonic() - t0) * 1e3
+        r0, r1 = plan.rows(s)
+        if fused:
+            d, i = _sanitize_fused(d, i, r0, r1 - r0, slices[s][1], view)
+        else:
+            d, i = np.asarray(d, np.float32), np.asarray(i, np.int64) + r0
+        parts_d.append(d)
+        parts_i.append(i)
+
+    stragglers = note_shard_metrics(walls, parts_d, parts_i)
+    state.note_dispatch(walls, stragglers)
+
+    if not fused:
+        return merge_survivors(parts_d, parts_i, k)
+
+    # Mutable merge: survivors selected by DEVICE distances with the
+    # RERANK_PAD margin, then the existing host exact re-rank — base
+    # candidates keep their pass-through rung distances, delta rows
+    # re-score through the oracle einsum (device_tail.rerank_merged),
+    # exactly the single-device fused contract.
+    from knn_tpu.mutable.device_tail import rerank_merged
+
+    width = sum(p.shape[1] for p in parts_d)
+    md, mi = merge_survivors(parts_d, parts_i,
+                             min(k + RERANK_PAD, width))
+    return rerank_merged(view, state.train_features, feats, mi, k,
+                         metric, base_d=md)

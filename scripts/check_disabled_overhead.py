@@ -141,6 +141,23 @@ def main() -> int:
                             f"mutable-off serving — the device-IVF/"
                             f"delta-tail machinery must not construct "
                             f"while disabled")
+        # Mesh-sharded serving (PR 18): --shards unset (ServeApp's
+        # shards=None) must construct ZERO shard machinery — no sharded
+        # twin wrapping the model, no per-shard executable caches, no
+        # knn_shard_* instruments; the whole knn_tpu.shard package is a
+        # lazy import only the opted-in path pulls in.
+        if app.shards is not None:
+            return fail("ServeApp resolved a shard count with --shards "
+                        "unset")
+        if hasattr(app.model, "shard_plan_"):
+            return fail("ServeApp wrapped the model in a sharded twin "
+                        "with --shards unset")
+        for mod in ("knn_tpu.shard", "knn_tpu.shard.plan",
+                    "knn_tpu.shard.model", "knn_tpu.shard.dispatch"):
+            if mod in sys.modules:
+                return fail(f"{mod} imported during unsharded serving — "
+                            f"shard machinery must not construct while "
+                            f"disabled")
         # Fleet replication (PR 15): plain single-process serving (no
         # --follower-of, no --replicate-to, no router) must construct
         # ZERO fleet machinery — no FleetReplica, no WAL shippers, no
@@ -221,7 +238,7 @@ def main() -> int:
                                     "knn_cost_", "knn_capacity_",
                                     "knn_ivf_", "knn_mutable_",
                                     "knn_workload_", "knn_cache_",
-                                    "knn_fleet_"))]
+                                    "knn_fleet_", "knn_shard_"))]
     if leaked:
         return fail(f"quality/drift/cost/capacity/ivf/mutable/workload "
                     f"instrument(s) recorded while disabled: {leaked}")

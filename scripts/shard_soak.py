@@ -1,0 +1,548 @@
+"""Shard-soak gate (`make shard-soak`): mesh-sharded serving held to its
+contracts (docs/SERVING.md §Sharded serving).
+
+**Phase 1 — bit-identity under closed-loop load.** A ``--shards 2``
+serve and an unsharded twin boot from byte-identical artifacts;
+concurrent readers fire the SAME ``/kneighbors`` and ``/predict``
+bodies at both and every answer must be bit-identical — sharding is a
+device-memory topology, never an answer change. Afterwards the sharded
+``/healthz`` and ``/debug/capacity`` must expose the frozen plan plus
+the per-shard walls of the last dispatch with the max/min/skew
+straggler family, ``/metrics`` must carry the ``knn_shard_*``
+instruments, and the twin must report ``shard: null`` with ZERO
+``knn_shard_*`` series (the disabled-overhead contract, live).
+
+**Phase 2 — mutation lockstep.** The same inserts (and a base delete)
+land on both servers in the same order, acks awaited, with a paired
+read after every step: bit-identical answers at every ``mutation_seq``
+— the delta tail shards with the plan and the fused sentinel fixups
+never leak a dead-slot marker across a shard boundary.
+
+**Phase 3 — shard-group kill drill behind the router.** A
+``head+member`` shard group (the head itself serving ``--shards 2``)
+and a singleton replica register behind ``knn_tpu route``; the group's
+NON-head member is SIGKILLed under read load. Invariants: ZERO failed
+reads (the router fails over to the singleton), every routed answer
+bit-identical to a direct read of the singleton oracle, and the router
+demotes the WHOLE group — ``healthy: false`` on the head with the
+corpse listed in ``shard_group.unhealthy``, usable dropping to 1 —
+even though the head itself still answers polls. Rebooting the member
+restores usable=2.
+
+Every invariant violation exits 1 with a diagnosis; PASS prints the
+verdict JSON (also written to ``--json-out`` for CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import procgroup  # noqa: E402 — scripts-dir sibling (process-group
+# spawn + atexit kill sweep: a failed assertion can never strand a server)
+from mutable_soak import (  # noqa: E402 — shared soak machinery
+    BOOT_TIMEOUT_S,
+    READY_RE,
+    http,
+)
+
+STRAGGLER_KEYS = ("max_ms", "min_ms", "skew", "max_shard", "shards")
+METRIC_NAMES = ("knn_shard_dispatch_ms", "knn_shard_candidates_total",
+                "knn_shard_bytes_total", "knn_shard_dispatch_ms_max",
+                "knn_shard_dispatch_ms_min", "knn_shard_dispatch_skew")
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--short", action="store_true",
+                   help="CI preset: ~6 s load windows")
+    p.add_argument("--window-s", type=float, default=None)
+    p.add_argument("--readers", type=int, default=3)
+    p.add_argument("--rows", type=int, default=4)
+    p.add_argument("--shards", type=int, default=2)
+    p.add_argument("--mutation-steps", type=int, default=12)
+    p.add_argument("--seed", type=int, default=23)
+    p.add_argument("--json-out", default=None, metavar="FILE")
+    args = p.parse_args()
+    if args.window_s is None:
+        args.window_s = 6.0 if args.short else 15.0
+    return args
+
+
+def fail(msg: str) -> int:
+    print(f"shard-soak: FAIL: {msg}", file=sys.stderr)
+    return 1  # procgroup's atexit sweep reaps every spawned group
+
+
+def free_ports(n: int) -> "list[int]":
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def spawn(cmd, env):
+    proc = procgroup.popen_group(
+        [sys.executable, "-m", "knn_tpu.cli", *cmd],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO,
+    )
+    import queue
+
+    lines: "queue.Queue[str]" = queue.Queue()
+    threading.Thread(
+        target=lambda: [lines.put(ln) for ln in proc.stdout], daemon=True,
+    ).start()
+    return proc, lines
+
+
+def wait_ready(proc, lines, what: str):
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=min(1.0, max(
+                0.01, deadline - time.monotonic())))
+        except Exception:  # noqa: BLE001 — queue.Empty
+            if proc.poll() is not None:
+                return None
+            continue
+        m = READY_RE.search(line)
+        if m:
+            print(f"shard-soak: {what}: {line.rstrip()}")
+            return m.group(1)
+    return None
+
+
+def healthz(base) -> dict:
+    _st, body = http(base, "/healthz")
+    return json.loads(body)
+
+
+def wait_until(pred, timeout_s: float, every_s: float = 0.2):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            v = pred()
+        except Exception:  # noqa: BLE001 — target mid-reboot
+            v = None
+        if v:
+            return v
+        time.sleep(every_s)
+    return None
+
+
+def metrics_text(base: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        return r.read().decode()
+
+
+class PairLoad:
+    """Closed-loop readers firing the SAME body at two servers (or one
+    server and an oracle twin) and requiring bit-identical JSON answers.
+    The two responses were serialized by the same ``tolist()`` +
+    ``json.dumps`` pipeline, so list equality of the parsed documents IS
+    float bit-identity (repr round-trips doubles exactly)."""
+
+    def __init__(self, a: str, b: str, test_x, args, endpoints=(
+            "kneighbors", "predict")):
+        import numpy as np
+
+        self.np = np
+        self.a = a
+        self.b = b
+        self.test_x = test_x
+        self.args = args
+        self.endpoints = endpoints
+        self.stop = threading.Event()
+        self.lock = threading.Lock()
+        self.reads_ok = 0
+        self.failures: list = []
+        self.mismatches: list = []
+        self.threads: list = []
+
+    @staticmethod
+    def compare_docs(ep: str, da: dict, db: dict):
+        if ep == "predict":
+            return ("predictions",) if (
+                da["predictions"] != db["predictions"]) else ()
+        bad = []
+        if da["distances"] != db["distances"]:
+            bad.append("distances")
+        if da["indices"] != db["indices"]:
+            bad.append("indices")
+        return tuple(bad)
+
+    def _reader(self, rid: int):
+        rng = self.np.random.default_rng(self.args.seed * 3000 + rid)
+        q = self.test_x.shape[0]
+        r = self.args.rows
+        while not self.stop.is_set():
+            lo = int(rng.integers(0, max(1, q - r)))
+            body = {"instances": self.test_x[lo:lo + r].tolist()}
+            ep = self.endpoints[int(rng.integers(0, len(self.endpoints)))]
+            docs = []
+            ok = True
+            for base in (self.a, self.b):
+                try:
+                    st, raw = http(base, "/" + ep, body)
+                except Exception as e:  # noqa: BLE001 — server died
+                    with self.lock:
+                        self.failures.append(f"{base}/{ep} transport: {e}")
+                    ok = False
+                    break
+                if st != 200:
+                    with self.lock:
+                        self.failures.append(
+                            f"{base}/{ep} status {st}: {raw[:200]}")
+                    ok = False
+                    break
+                docs.append(json.loads(raw))
+            if not ok:
+                continue
+            bad = self.compare_docs(ep, docs[0], docs[1])
+            with self.lock:
+                if bad:
+                    self.mismatches.append(
+                        f"/{ep} rows [{lo}:{lo + r}] diverged on "
+                        f"{'+'.join(bad)}")
+                else:
+                    self.reads_ok += 1
+
+    def start(self):
+        self.threads = [
+            threading.Thread(target=self._reader, args=(r,), daemon=True)
+            for r in range(self.args.readers)]
+        for t in self.threads:
+            t.start()
+
+    def finish(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=90)
+            if t.is_alive():
+                self.failures.append("a load thread hung")
+
+
+def check_shard_block(sb, num_shards: int, train_rows: int):
+    """The /healthz + /debug/capacity shard block contract after at
+    least one sharded dispatch. Returns an error string or None."""
+    if sb is None:
+        return "shard block is null on the sharded server"
+    if sb.get("num_shards") != num_shards:
+        return f"num_shards {sb.get('num_shards')} (want {num_shards})"
+    if sum(sb.get("rows_per_shard", [])) != train_rows:
+        return (f"rows_per_shard {sb.get('rows_per_shard')} does not "
+                f"cover the {train_rows}-row train matrix")
+    if sb.get("dispatches", 0) < 1:
+        return "no sharded dispatch was ever recorded"
+    last = sb.get("serve-sharded") or sb.get("serve-sharded-ivf")
+    if not last:
+        return "no per-shard walls for the last dispatch"
+    if len(last.get("walls_ms", {})) != num_shards:
+        return (f"last dispatch recorded walls for "
+                f"{len(last.get('walls_ms', {}))} shard(s), want "
+                f"{num_shards}")
+    stragglers = last.get("stragglers")
+    if not stragglers:
+        return "no straggler summary on the last dispatch"
+    missing = [k for k in STRAGGLER_KEYS if k not in stragglers]
+    if missing:
+        return f"straggler summary missing {missing}"
+    if stragglers["skew"] < 1.0:
+        return f"straggler skew {stragglers['skew']} < 1.0"
+    return None
+
+
+def main() -> int:
+    args = parse_args()
+    import numpy as np
+    from bench import _load_medium  # noqa: E402 — repo-root import
+
+    train, test = _load_medium()
+    d = Path(__file__).parent.parent / "build" / "fixtures"
+    ref = Path("/root/reference/datasets")
+    train_arff = str((ref if ref.exists() else d) / "medium-train.arff")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KNN_TPU_RETRY_BASE_MS="0")
+    report: dict = {"shard_soak": {
+        "train_rows": train.num_instances, "shards": args.shards,
+        "readers": args.readers, "window_s": args.window_s,
+    }}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        seed_idx = tmp / "seed"
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             str(seed_idx), "--k", "5"],
+            env=env, capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: "
+                        f"{build.stderr}")
+        dirs = {}
+        for name in ("sharded", "twin", "g1", "g2", "r0"):
+            dirs[name] = tmp / name
+            shutil.copytree(seed_idx, dirs[name])
+
+        p_s, p_t = free_ports(2)
+        serve_common = ["--max-batch", "32", "--max-wait-ms", "1",
+                        "--mutable", "on", "--compact-interval-s", "0",
+                        "--compact-threshold", "1000000"]
+        proc_s, lines_s = spawn(
+            ["serve", str(dirs["sharded"]), "--port", str(p_s),
+             *serve_common, "--shards", str(args.shards)], env)
+        proc_t, lines_t = spawn(
+            ["serve", str(dirs["twin"]), "--port", str(p_t),
+             *serve_common], env)
+        sharded = wait_ready(proc_s, lines_s, "sharded")
+        twin = wait_ready(proc_t, lines_t, "twin")
+        if None in (sharded, twin):
+            return fail(f"boot failed (sharded={sharded}, twin={twin})")
+        h = healthz(sharded)
+        if (h.get("shard") or {}).get("num_shards") != args.shards:
+            return fail(f"sharded /healthz shard block wrong before any "
+                        f"load: {h.get('shard')}")
+        if healthz(twin).get("shard") is not None:
+            return fail("the UNSHARDED twin reports a shard block — the "
+                        "unset state must stay null")
+        v0 = h["index_version"]
+        if healthz(twin)["index_version"] != v0:
+            return fail("the twin booted a different index_version — "
+                        "the artifact copies diverged")
+
+        # ---- phase 1: bit-identity under closed-loop load ----------------
+        load = PairLoad(sharded, twin, test.features, args)
+        load.start()
+        time.sleep(args.window_s)
+        load.finish()
+        if load.failures:
+            return fail(f"phase-1 request failures: {load.failures[:3]}")
+        if load.mismatches:
+            return fail(f"phase-1 sharded answers DIVERGED from the "
+                        f"unsharded twin: {load.mismatches[:3]}")
+        if load.reads_ok < 50:
+            return fail(f"too little load to trust phase 1 "
+                        f"({load.reads_ok} paired reads)")
+
+        # The straggler surface after the window: /healthz and
+        # /debug/capacity agree, /metrics carries the instruments.
+        err = check_shard_block(healthz(sharded).get("shard"),
+                                args.shards, train.num_instances)
+        if err:
+            return fail(f"phase-1 /healthz shard block: {err}")
+        st, body = http(sharded, "/debug/capacity")
+        if st != 200:
+            return fail(f"/debug/capacity on the sharded server: {st}")
+        err = check_shard_block(json.loads(body).get("shard"),
+                                args.shards, train.num_instances)
+        if err:
+            return fail(f"phase-1 /debug/capacity shard block: {err}")
+        text = metrics_text(sharded)
+        missing = [m for m in METRIC_NAMES if m + "{" not in text]
+        if missing:
+            return fail(f"phase-1 /metrics is missing {missing}")
+        if "knn_shard_" in metrics_text(twin):
+            return fail("phase-1: the UNSHARDED twin leaked knn_shard_* "
+                        "series — the disabled-overhead contract broke "
+                        "live")
+        report["phase1"] = {"paired_reads": load.reads_ok}
+        print(f"shard-soak: phase 1 ok — {load.reads_ok} paired reads "
+              f"bit-identical sharded-vs-unsharded; straggler gauges "
+              f"live on /healthz, /debug/capacity and /metrics; twin "
+              f"stayed shard-free")
+
+        # ---- phase 2: mutation lockstep ----------------------------------
+        rng = np.random.default_rng(args.seed)
+        dcols = test.features.shape[1]
+        probe = {"instances": test.features[:args.rows].tolist()}
+        deleted = False
+        for step in range(args.mutation_steps):
+            m = int(rng.integers(1, 3))
+            rows = rng.uniform(0, 4, (m, dcols)).astype(np.float32)
+            labels = rng.integers(0, train.num_classes, m).tolist()
+            payload = {"rows": rows.tolist(), "labels": labels}
+            seqs = {}
+            for name, base in (("sharded", sharded), ("twin", twin)):
+                st, raw = http(base, "/insert", payload)
+                if st != 200:
+                    return fail(f"phase-2 step {step}: insert on {name} "
+                                f"-> {st}: {raw[:200]}")
+                seqs[name] = json.loads(raw)["seq"]
+            if seqs["sharded"] != seqs["twin"]:
+                return fail(f"phase-2 step {step}: lockstep seqs "
+                            f"diverged: {seqs}")
+            if step == args.mutation_steps // 2:
+                for name, base in (("sharded", sharded), ("twin", twin)):
+                    st, raw = http(base, "/delete", {"ids": [7]})
+                    if st != 200:
+                        return fail(f"phase-2 base delete on {name} -> "
+                                    f"{st}: {raw[:200]}")
+                deleted = True
+            docs = {}
+            for name, base in (("sharded", sharded), ("twin", twin)):
+                st, raw = http(base, "/kneighbors", probe)
+                if st != 200:
+                    return fail(f"phase-2 step {step}: read on {name} "
+                                f"-> {st}: {raw[:200]}")
+                docs[name] = json.loads(raw)
+            if (docs["sharded"]["mutation_seq"]
+                    != docs["twin"]["mutation_seq"]):
+                return fail(f"phase-2 step {step}: reads observed "
+                            f"different mutation_seqs")
+            bad = PairLoad.compare_docs("kneighbors", docs["sharded"],
+                                        docs["twin"])
+            if bad:
+                return fail(f"phase-2 step {step} (seq "
+                            f"{docs['sharded']['mutation_seq']}): "
+                            f"sharded answer diverged on "
+                            f"{'+'.join(bad)}")
+        if not deleted:
+            return fail("phase-2 never exercised the base-delete leg")
+        # A final paired sweep over a spread of query windows, both
+        # endpoints, against the mutated state.
+        load = PairLoad(sharded, twin, test.features, args)
+        load.start()
+        time.sleep(args.window_s / 3)
+        load.finish()
+        if load.failures or load.mismatches:
+            return fail(f"phase-2 post-mutation sweep: "
+                        f"{(load.failures + load.mismatches)[:3]}")
+        report["phase2"] = {
+            "mutation_steps": args.mutation_steps,
+            "final_seq": healthz(sharded)["mutable"]["seq"],
+            "post_mutation_paired_reads": load.reads_ok,
+        }
+        print(f"shard-soak: phase 2 ok — {args.mutation_steps} lockstep "
+              f"inserts + a base delete to seq "
+              f"{report['phase2']['final_seq']}: every paired read "
+              f"bit-identical ({load.reads_ok} more in the sweep)")
+        procgroup.kill_group(proc_s)
+        procgroup.kill_group(proc_t)
+
+        # ---- phase 3: shard-group kill drill behind the router -----------
+        q1, q2, q3, qr = free_ports(4)
+        url = {"g1": f"http://127.0.0.1:{q1}",
+               "g2": f"http://127.0.0.1:{q2}",
+               "r0": f"http://127.0.0.1:{q3}"}
+        immut = ["--max-batch", "16", "--max-wait-ms", "1"]
+
+        def boot(name, extra=()):
+            proc, lines = spawn(
+                ["serve", str(dirs[name]), "--port",
+                 url[name].rsplit(":", 1)[1], *immut, *extra], env)
+            return proc, wait_ready(proc, lines, name)
+
+        procs = {}
+        procs["g1"], b1 = boot("g1", ("--shards", str(args.shards)))
+        procs["g2"], b2 = boot("g2")
+        procs["r0"], b3 = boot("r0")
+        if None in (b1, b2, b3):
+            return fail(f"phase-3 boot failed (g1={b1}, g2={b2}, "
+                        f"r0={b3})")
+        router_proc, router_lines = spawn(
+            ["route", f"{url['g1']}+{url['g2']}", url["r0"],
+             "--port", str(qr), "--health-interval-s", "0.25"], env)
+        router = wait_ready(router_proc, router_lines, "router")
+        if router is None:
+            return fail(f"phase-3 router boot failed "
+                        f"(rc={router_proc.poll()})")
+        if not wait_until(lambda: healthz(router)["usable"] == 2,
+                          timeout_s=20):
+            return fail("phase-3: router never saw the group AND the "
+                        "singleton usable")
+        reps = healthz(router)["replicas"]
+        if set(reps) != {url["g1"], url["r0"]}:
+            return fail(f"phase-3: the router's replica view lists "
+                        f"{sorted(reps)} — want heads only ({url['g1']} "
+                        f"and {url['r0']})")
+        group = reps[url["g1"]].get("shard_group")
+        if (group is None
+                or set(group["members"]) != {url["g1"], url["g2"]}):
+            return fail(f"phase-3: the head's shard_group block is "
+                        f"wrong: {group}")
+
+        # Routed answers must be bit-identical to a direct read of the
+        # singleton oracle — whichever "replica" answers, group or not.
+        load = PairLoad(router, url["r0"], test.features, args,
+                        endpoints=("kneighbors",))
+        load.start()
+        time.sleep(args.window_s / 3)
+        procgroup.kill_group(procs["g2"])  # the NON-head member
+        kill_t = time.monotonic()
+
+        def group_demoted():
+            h = healthz(router)
+            s = h["replicas"][url["g1"]]
+            return (h["usable"] == 1 and not s["healthy"]
+                    and s["shard_group"]["unhealthy"] == [url["g2"]])
+
+        if not wait_until(group_demoted, timeout_s=20):
+            load.finish()
+            h = healthz(router)
+            return fail(f"phase-3: the router never demoted the WHOLE "
+                        f"group after the member SIGKILL "
+                        f"({time.monotonic() - kill_t:.1f}s; head state "
+                        f"{h['replicas'][url['g1']]})")
+        time.sleep(args.window_s / 3)
+        procs["g2"], b2 = boot("g2")
+        if b2 is None:
+            load.finish()
+            return fail(f"phase-3 member reboot failed "
+                        f"(rc={procs['g2'].poll()})")
+        if not wait_until(lambda: healthz(router)["usable"] == 2,
+                          timeout_s=20):
+            load.finish()
+            return fail("phase-3: the group never rejoined after the "
+                        "member reboot")
+        time.sleep(args.window_s / 4)
+        load.finish()
+        if load.failures:
+            return fail(f"phase-3 failed reads during the group kill "
+                        f"drill: {load.failures[:3]}")
+        if load.mismatches:
+            return fail(f"phase-3 routed answers diverged from the "
+                        f"singleton oracle: {load.mismatches[:3]}")
+        if load.reads_ok < 50:
+            return fail(f"too little load to trust phase 3 "
+                        f"({load.reads_ok} paired reads)")
+        report["phase3"] = {
+            "paired_reads": load.reads_ok,
+            "group_members": group["members"],
+        }
+        print(f"shard-soak: phase 3 ok — member SIGKILL demoted the "
+              f"whole group (usable 2 -> 1) with ZERO failed reads "
+              f"through the router; reboot restored usable=2; "
+              f"{load.reads_ok} routed reads bit-identical to the "
+              f"singleton oracle")
+
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.json_out:
+        Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json_out).write_text(out + "\n")
+    print("shard-soak: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

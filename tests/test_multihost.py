@@ -65,6 +65,60 @@ def test_two_process_launch_matches_oracle(tmp_path):
     np.testing.assert_array_equal(got, want)
 
 
+def test_two_process_train_sharded_matches_oracle(tmp_path):
+    # --strategy train-sharded: the global mesh scatters TRAIN rows (the
+    # index that does not fit one device) instead of queries; per-shard
+    # top-k all-gathered and lexicographically merged — the serve tier's
+    # shard/plan partition under the real launcher (VERDICT seam #1's
+    # train-sharded half).
+    from knn_tpu.backends.oracle import knn_oracle
+    from knn_tpu.data.arff import load_arff
+
+    datasets = fixtures.datasets_dir()
+    dump = tmp_path / "preds.npy"
+    proc = subprocess.run(
+        [
+            sys.executable, "scripts/launch_multihost.py",
+            "-np", "2", "--devices-per-proc", "2",
+            str(datasets / "small-train.arff"),
+            str(datasets / "small-test.arff"),
+            "5", "--strategy", "train-sharded",
+            "--dump-predictions", str(dump),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    _skip_if_cpu_multiprocess_unsupported(proc)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Accuracy was" in proc.stdout
+    train = load_arff(str(datasets / "small-train.arff"))
+    test = load_arff(str(datasets / "small-test.arff"))
+    want = knn_oracle(
+        train.features, train.labels, test.features, 5, train.num_classes
+    )
+    np.testing.assert_array_equal(np.load(dump), want)
+
+
+def test_train_sharded_stripe_engine_is_a_usage_error():
+    # No coordinator needed: the contradiction is rejected before any
+    # backend touch, with the serve exit-code contract (2 = usage).
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "knn_tpu.parallel.multihost",
+            "train.arff", "test.arff", "5",
+            "--strategy", "train-sharded", "--engine", "stripe",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 2, proc.stderr[-500:]
+    assert "xla engine only" in proc.stderr
+
+
 def test_two_process_stripe_engine_matches_oracle(tmp_path):
     # The same 2-process launch forced through the lane-striped Pallas
     # engine (interpret mode on the CPU processes): the full mpiexec

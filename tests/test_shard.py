@@ -1,0 +1,466 @@
+"""Mesh-sharded serving (``knn_tpu/shard/``, PR 18).
+
+Pins the tentpole contract and its satellites:
+
+- shard plans are pure, deterministic functions of (size, shards) with
+  contiguous boundaries — incl. the degenerates (1 shard, shards >
+  cells/rows, empty delta slices);
+- ``models/ordering.lexicographic_topk_jax`` — the device twin every
+  cross-shard merge selects through — is pinned against the host
+  contract on adversarial tie plateaus (the satellite-1 rebase of
+  ``train_sharded.merge_candidates_vote``);
+- sharded retrieval is BIT-identical to the single-device rungs across
+  families × exact/ivf × mutable on/off, on the tie/NaN fixtures;
+- a ``url1+url2`` fleet shard group is usable only while EVERY member
+  is healthy (the kill-one-member drill's routing contract);
+- ``ServeApp(shards=N)`` serves the sharded twin; ``shards=None``
+  constructs no shard machinery at all.
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.index.ivf import IVF_ATTR, IVFIndex, IVFServing
+from knn_tpu.models.knn import KNNClassifier, KNNRegressor
+from knn_tpu.models.ordering import lexicographic_topk
+from knn_tpu.mutable.engine import MutableEngine
+from knn_tpu.serve.artifact import save_index
+from knn_tpu.serve.batcher import MicroBatcher
+from knn_tpu.shard import plan as plan_mod
+from knn_tpu.shard.model import make_sharded
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _tie_problem(rng, n=400, d=6, q=24):
+    """Grid-valued features -> plentiful exact distance ties, plus an
+    exact-match query and a NaN query (the adversarial corners) — the
+    same fixture shape test_device_path.py pins the device scorer on."""
+    x = rng.integers(0, 4, (n, d)).astype(np.float32)
+    h = min(10, n // 4)
+    x[4 * h - h:4 * h] = x[0:h]  # duplicate rows: exact ties across shards
+    qx = rng.integers(0, 4, (q, d)).astype(np.float32)
+    qx[1] = x[min(17, n - 1)]  # exact match (distance 0 ties)
+    qx[3, 2] = np.nan   # NaN query -> all +inf, ties broken by index
+    return x, qx
+
+
+def _qds(qx):
+    """Queries as a Dataset (the model-layer API takes datasets)."""
+    return Dataset(qx, np.zeros(qx.shape[0], np.int32))
+
+
+def _assert_bitwise(a, b, what=""):
+    d1, i1 = a
+    d2, i2 = b
+    np.testing.assert_array_equal(i1, i2, err_msg=f"{what}: indices")
+    assert (np.asarray(d1, np.float32).view(np.uint32)
+            == np.asarray(d2, np.float32).view(np.uint32)).all(), \
+        f"{what}: distances not bit-identical"
+
+
+class TestShardPlan:
+    def test_plan_rows_balanced_and_deterministic(self):
+        p = plan_mod.plan_rows(10, 3)
+        assert p.row_starts == (0, 4, 7, 10)
+        assert p == plan_mod.plan_rows(10, 3)  # pure function
+        widths = [p.rows(s)[1] - p.rows(s)[0] for s in range(p.num_shards)]
+        assert max(widths) - min(widths) <= 1
+        assert p.export()["rows_per_shard"] == [4, 3, 3]
+
+    def test_plan_rows_degenerates(self):
+        assert plan_mod.plan_rows(5, 1).row_starts == (0, 5)
+        # shards > rows collapses to one-row shards, never empty ones
+        p = plan_mod.plan_rows(3, 500)
+        assert p.num_shards == 3
+        assert p.row_starts == (0, 1, 2, 3)
+        assert plan_mod.plan_rows(0, 4).row_starts == (0, 0)
+        with pytest.raises(ValueError):
+            plan_mod.plan_rows(10, 0)
+
+    def test_plan_cells_owns_whole_cells(self, rng):
+        sizes = rng.integers(1, 40, 17)
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        p = plan_mod.plan_cells(offsets, 5)
+        assert p.cell_starts[0] == 0 and p.cell_starts[-1] == 17
+        for s in range(p.num_shards):
+            c0, c1 = p.cells(s)
+            assert c1 > c0  # every shard keeps >= 1 cell
+            # row boundary sits exactly on a cell boundary: a probed
+            # cell belongs WHOLLY to one shard.
+            assert p.rows(s) == (int(offsets[c0]), int(offsets[c1]))
+        assert p == plan_mod.plan_cells(offsets, 5)
+
+    def test_plan_cells_more_shards_than_cells(self):
+        offsets = np.array([0, 3, 5, 9])
+        p = plan_mod.plan_cells(offsets, 64)
+        assert p.num_shards == 3  # clamped: one cell per shard
+        assert p.cell_starts == (0, 1, 2, 3)
+        assert p.row_starts == (0, 3, 5, 9)
+
+    def test_plan_delta_quota_and_empty_slices(self):
+        assert plan_mod.plan_delta(7, 3) == ((0, 3), (3, 5), (5, 7))
+        # shards past the live count get EMPTY slices, the plan never
+        # shrinks with the delta fill level
+        assert plan_mod.plan_delta(2, 4) == ((0, 1), (1, 2), (2, 2), (2, 2))
+        assert plan_mod.plan_delta(0, 2) == ((0, 0), (0, 0))
+
+    def test_plan_rows_uniform_matches_device_clip_rule(self):
+        for n, stride, shards in [(403, 51, 8), (6, 51, 8), (0, 4, 3),
+                                  (4096, 512, 8)]:
+            p = plan_mod.plan_rows_uniform(n, shards, stride)
+            for s in range(shards):
+                r0, r1 = p.rows(s)
+                want = int(np.clip(n - s * stride, 0, stride))
+                assert r1 - r0 == want, (n, stride, s)
+        with pytest.raises(ValueError):
+            plan_mod.plan_rows_uniform(10, 0, 4)
+        with pytest.raises(ValueError):
+            plan_mod.plan_rows_uniform(10, 2, 0)
+
+    def test_effective_shards_clamps(self):
+        assert plan_mod.effective_shards(8, 3) == 3
+        assert plan_mod.effective_shards(2, 100) == 2
+        assert plan_mod.effective_shards(4, 0) == 1
+        with pytest.raises(ValueError):
+            plan_mod.effective_shards(0, 5)
+
+
+class TestLexicographicDeviceTwin:
+    """The satellite-1 pin: the device realization of the (distance,
+    index) contract — which every cross-shard merge selects through —
+    equals the host helper on adversarial tie plateaus."""
+
+    def _plateau(self, rng, q=8, m=96):
+        # Three-valued distances -> huge plateaus; +inf padding rows;
+        # an all-equal row (total plateau); shuffled global indices.
+        d = rng.choice(np.array([0.0, 1.0, np.inf], np.float32),
+                       (q, m), p=[0.45, 0.45, 0.1])
+        d[0] = 1.0
+        idx = np.stack([rng.permutation(m) for _ in range(q)]).astype(
+            np.int32)
+        return d, idx
+
+    def test_device_equals_host_on_plateaus(self, rng):
+        import jax
+
+        from knn_tpu.models.ordering import lexicographic_topk_jax
+
+        d, idx = self._plateau(rng)
+        for k in (1, 5, 64, 96):
+            hd, hi = lexicographic_topk(d, idx, k)
+            dd, di = jax.jit(
+                lambda a, b, kk=k: lexicographic_topk_jax(a, b, kk)
+            )(d, idx)
+            _assert_bitwise((hd, hi), (np.asarray(dd), np.asarray(di)),
+                            f"k={k}")
+
+    def test_payload_rides_the_same_permutation(self, rng):
+        import jax
+
+        from knn_tpu.models.ordering import lexicographic_topk_jax
+
+        d, idx = self._plateau(rng)
+        labels = (idx % 7).astype(np.int32)
+        dd, di, dl = jax.jit(
+            lambda a, b, c: lexicographic_topk_jax(a, b, 10, c)
+        )(d, idx, labels)
+        np.testing.assert_array_equal(np.asarray(dl),
+                                      np.asarray(di) % 7)
+
+    def test_merge_candidates_vote_is_shard_order_invariant(self, rng):
+        # The same candidate multiset split at different shard
+        # boundaries must vote identically — and identically to the
+        # host contract's top-k labels.
+        import jax.numpy as jnp
+
+        from knn_tpu.ops.vote import vote
+        from knn_tpu.parallel.train_sharded import merge_candidates_vote
+
+        d, idx = self._plateau(rng, q=6, m=60)
+        labels = (idx % 4).astype(np.int32)
+        k, C = 7, 4
+        hd, hi = lexicographic_topk(d, idx, k)
+        want = np.asarray(vote(jnp.asarray((hi % 4).astype(np.int32)), C))
+        for perm_seed in range(3):
+            order = np.random.default_rng(perm_seed).permutation(60)
+            got = merge_candidates_vote(
+                jnp.asarray(d[:, order]), jnp.asarray(idx[:, order]),
+                jnp.asarray(labels[:, order]), k, C)
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestShardedExactBitIdentity:
+    def test_classifier_matrix_vs_single_device(self, rng):
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=5, engine="xla").fit(Dataset(x, y))
+        qds = _qds(qx)
+        want = model.kneighbors(qds)
+        want_pred = model.predict(qds)
+        for s in (1, 2, 3, 7):
+            sm = make_sharded(model, s)
+            assert sm.shard_plan_.num_shards == s
+            _assert_bitwise(want, sm.kneighbors(qds), f"shards={s}")
+            np.testing.assert_array_equal(sm.predict(qds), want_pred)
+
+    def test_regressor_vs_single_device(self, rng):
+        x, qx = _tie_problem(rng)
+        y = rng.standard_normal(x.shape[0]).astype(np.float32)
+        model = KNNRegressor(k=5, engine="xla").fit(Dataset(x, y))
+        sm = make_sharded(model, 3)
+        qds = _qds(qx)
+        _assert_bitwise(model.kneighbors(qds), sm.kneighbors(qds),
+                        "regressor")
+        np.testing.assert_array_equal(sm.predict(qds), model.predict(qds))
+
+    def test_shards_exceed_rows(self, rng):
+        x, qx = _tie_problem(rng, n=10, q=6)
+        y = rng.integers(0, 2, 10).astype(np.int32)
+        model = KNNClassifier(k=3, engine="xla").fit(Dataset(x, y))
+        sm = make_sharded(model, 500)  # clamps to one-row shards
+        assert sm.shard_plan_.num_shards == 10
+        _assert_bitwise(model.kneighbors(_qds(qx)), sm.kneighbors(_qds(qx)),
+                        "shards>rows")
+
+    def test_k_exceeds_per_shard_candidates(self, rng):
+        x, qx = _tie_problem(rng, n=30, q=8)
+        y = rng.integers(0, 2, 30).astype(np.int32)
+        model = KNNClassifier(k=20, engine="xla").fit(Dataset(x, y))
+        sm = make_sharded(model, 7)  # ~4 rows/shard << k
+        _assert_bitwise(model.kneighbors(_qds(qx)), sm.kneighbors(_qds(qx)),
+                        "k>per-shard rows")
+
+    def test_unfitted_model_raises(self):
+        with pytest.raises(RuntimeError, match="fit"):
+            make_sharded(KNNClassifier(k=3), 2)
+
+    def test_shard_metrics_registered(self, rng):
+        from knn_tpu import obs
+
+        x, qx = _tie_problem(rng, n=120, q=4)
+        y = rng.integers(0, 2, 120).astype(np.int32)
+        model = KNNClassifier(k=3, engine="xla").fit(Dataset(x, y))
+        obs.reset()
+        obs.enable()
+        try:
+            make_sharded(model, 3).kneighbors(_qds(qx))
+            names = set(obs.registry().to_json())
+        finally:
+            obs.disable()
+            obs.reset()
+        for want in ("knn_shard_dispatch_ms", "knn_shard_dispatch_ms_max",
+                     "knn_shard_dispatch_ms_min", "knn_shard_dispatch_skew",
+                     "knn_shard_candidates_total", "knn_shard_bytes_total"):
+            assert want in names, (want, sorted(names))
+
+
+class TestShardedIVFBitIdentity:
+    def test_device_scorer_matrix(self, rng, monkeypatch):
+        monkeypatch.setenv("KNN_TPU_IVF_SCORER", "device")
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=5, engine="xla").fit(Dataset(x, y))
+        setattr(model, IVF_ATTR, IVFIndex.build(x, 16, seed=0))
+        for s in (1, 3, 50):  # 50 > 16 cells: clamps to one-cell shards
+            sm = make_sharded(model, s)
+            if s > 16:
+                assert sm.ivf_.shard_plan.num_shards == 16
+            for k, nprobe in [(1, 1), (5, 4), (10, 16)]:
+                want = model.ivf_.search(x, qx, k, nprobe, scorer="host")
+                got = sm.ivf_.search(x, qx, k, nprobe, scorer="device")
+                _assert_bitwise(want[:2], got[:2],
+                                f"shards={s} k={k} nprobe={nprobe}")
+
+    def test_serving_rung_through_sharded_model(self, rng, monkeypatch):
+        monkeypatch.setenv("KNN_TPU_IVF_SCORER", "device")
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=5, engine="xla").fit(Dataset(x, y))
+        setattr(model, IVF_ATTR, IVFIndex.build(x, 16, seed=0))
+        serving = IVFServing(4, 16)
+        want = serving.kneighbors(model, qx)
+        got = serving.kneighbors(make_sharded(model, 3), qx)
+        _assert_bitwise(want, got, "ivf serving rung")
+
+
+def _sharded_vs_plain_batchers(model, num_shards, tmp_path, **kw):
+    """Two MicroBatchers over byte-identical artifacts: the sharded twin
+    vs the plain model, each with its own device-tail mutable engine —
+    the live end-to-end bit-identity harness."""
+    root_a = tmp_path / "idx-sharded"
+    save_index(model, root_a, ivf=getattr(model, IVF_ATTR, None))
+    root_b = tmp_path / "idx-plain"
+    shutil.copytree(root_a, root_b)
+    eng_a = MutableEngine(model, root_a, delta_cap=256,
+                          device_tail="on", **kw)
+    eng_b = MutableEngine(model, root_b, delta_cap=256,
+                          device_tail="on", **kw)
+    b_sh = MicroBatcher(make_sharded(model, num_shards), max_batch=64,
+                        max_wait_ms=0.0, mutable=eng_a)
+    b_pl = MicroBatcher(model, max_batch=64, max_wait_ms=0.0,
+                        mutable=eng_b)
+    return b_sh, b_pl
+
+
+class TestShardedMutableBitIdentity:
+    def test_merged_serving_matches_single_device(self, rng, tmp_path):
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=5, engine="xla").fit(Dataset(x, y))
+        b_sh, b_pl = _sharded_vs_plain_batchers(model, 3, tmp_path)
+        try:
+            # immutable baseline first
+            _assert_bitwise(b_pl.kneighbors(qx, timeout=60),
+                            b_sh.kneighbors(qx, timeout=60), "no delta")
+            # insert -> fused delta-tail shards ride the dispatch
+            rows = rng.standard_normal((30, x.shape[1])).astype(np.float32)
+            vals = rng.integers(0, 3, 30).astype(np.float32)
+            for b in (b_sh, b_pl):
+                b.submit_mutation("insert",
+                                  {"rows": rows, "values": vals}).result(
+                    timeout=60)
+            _assert_bitwise(b_pl.kneighbors(qx, timeout=60),
+                            b_sh.kneighbors(qx, timeout=60), "insert")
+            np.testing.assert_array_equal(b_sh.predict(qx, timeout=60),
+                                          b_pl.predict(qx, timeout=60))
+            # delta delete: the dead slot is masked on whichever shard
+            # owns its slice
+            for b in (b_sh, b_pl):
+                b.submit_mutation("delete",
+                                  {"ids": [x.shape[0] + 1]}).result(
+                    timeout=60)
+            d1, i1 = b_sh.kneighbors(qx, timeout=60)
+            _assert_bitwise(b_pl.kneighbors(qx, timeout=60), (d1, i1),
+                            "delta delete")
+            assert not (np.asarray(i1) == x.shape[0] + 1).any()
+            # base tombstone: documented host-merge fallback, still
+            # bit-identical end to end
+            for b in (b_sh, b_pl):
+                b.submit_mutation("delete", {"ids": [17]}).result(
+                    timeout=60)
+            d2, i2 = b_sh.kneighbors(qx, timeout=60)
+            _assert_bitwise(b_pl.kneighbors(qx, timeout=60), (d2, i2),
+                            "base tombstone")
+            assert not (np.asarray(i2) == 17).any()
+        finally:
+            b_sh.close()
+            b_pl.close()
+
+    def test_ivf_fused_delta_matches_single_device(self, rng, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("KNN_TPU_IVF_SCORER", "device")
+        x, qx = _tie_problem(rng)
+        y = rng.integers(0, 3, x.shape[0]).astype(np.int32)
+        model = KNNClassifier(k=4, engine="xla").fit(Dataset(x, y))
+        setattr(model, IVF_ATTR, IVFIndex.build(x, 12, seed=0))
+        sm = make_sharded(model, 4)
+        root = tmp_path / "idx"
+        save_index(model, root, ivf=model.ivf_)
+        eng = MutableEngine(model, root, delta_cap=256, device_tail="on")
+        rows = rng.standard_normal((30, x.shape[1])).astype(np.float32)
+        eng.apply_insert(rows, rng.integers(0, 3, 30).astype(np.float32),
+                         time.monotonic_ns())
+        view = eng.snapshot()
+        serving = IVFServing(4, 12)
+        want = serving.kneighbors(model, qx, view=view)
+        got = serving.kneighbors(sm, qx, view=view)
+        _assert_bitwise(want, got, "sharded ivf fused delta")
+
+
+class TestFleetShardGroups:
+    def _set(self, specs):
+        from knn_tpu.fleet.health import ReplicaSet
+
+        return ReplicaSet(specs, interval_s=999, poll_timeout_s=1)
+
+    def test_spec_parsing_heads_and_members(self):
+        rs = self._set(["http://a:1+http://a:2", "http://b:1"])
+        assert rs.urls == ["http://a:1", "http://b:1"]
+        assert rs.groups == {"http://a:1": ("http://a:1", "http://a:2")}
+        assert set(rs._states) == {"http://a:1", "http://a:2",
+                                   "http://b:1"}
+
+    def test_duplicate_member_across_specs_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            self._set(["http://x:1+http://x:2", "http://x:2"])
+
+    def test_group_usable_only_when_every_member_healthy(self):
+        rs = self._set(["http://a:1+http://a:2", "http://b:1"])
+        for s in rs._states.values():
+            s.healthy = True
+        assert rs.usable_urls() == ["http://a:1", "http://b:1"]
+        # kill the NON-head member: the head's own poll stays 200, but
+        # the group must look down to routing (a partial shard group
+        # cannot answer from its whole index)
+        rs._states["http://a:2"].healthy = False
+        assert not rs.is_healthy("http://a:1")
+        assert rs.usable_urls() == ["http://b:1"]
+        rs._states["http://a:2"].healthy = True
+        assert rs.is_healthy("http://a:1")
+
+    def test_group_gates_failover_queries_and_export(self):
+        rs = self._set(["http://a:1+http://a:2", "http://b:1"])
+        for s in rs._states.values():
+            s.healthy = True
+        rs._states["http://a:1"].role = "primary"
+        rs._states["http://b:1"].role = "follower"
+        assert rs.primary_url() == "http://a:1"
+        rs._states["http://a:2"].healthy = False
+        assert rs.primaries() == []
+        assert rs.down_primary() == "http://a:1"  # failover trigger
+        assert rs.most_caught_up() == "http://b:1"
+        doc = rs.export()
+        head = doc["replicas"]["http://a:1"]
+        assert head["shard_group"]["members"] == ["http://a:1",
+                                                  "http://a:2"]
+        assert head["shard_group"]["unhealthy"] == ["http://a:2"]
+        assert head["healthy"] is False  # the GROUP's usability
+        assert doc["usable"] == 1
+        assert "shard_group" not in doc["replicas"]["http://b:1"]
+
+
+class TestServeAppSharding:
+    def test_sharded_app_serves_bit_identical(self, rng):
+        from knn_tpu.serve.server import ServeApp
+
+        x, qx = _tie_problem(rng, n=200, q=8)
+        y = rng.integers(0, 3, 200).astype(np.int32)
+        model = KNNClassifier(k=4, engine="xla").fit(Dataset(x, y))
+        plain = KNNClassifier(k=4, engine="xla").fit(Dataset(x, y))
+        app = ServeApp(model, max_batch=16, max_wait_ms=0.0, shards=2)
+        ref = ServeApp(plain, max_batch=16, max_wait_ms=0.0)
+        try:
+            assert app.shards == 2
+            np.testing.assert_array_equal(
+                app.batcher.predict(qx, timeout=60),
+                ref.batcher.predict(qx, timeout=60))
+            block = app.health()["shard"]
+            assert block["num_shards"] == 2
+            assert sum(block["rows_per_shard"]) == 200
+        finally:
+            app.close()
+            ref.close()
+
+    def test_unsharded_app_constructs_nothing(self, rng):
+        from knn_tpu.serve.server import ServeApp
+
+        x, _ = _tie_problem(rng, n=60, q=4)
+        y = rng.integers(0, 2, 60).astype(np.int32)
+        model = KNNClassifier(k=3, engine="xla").fit(Dataset(x, y))
+        app = ServeApp(model, max_batch=8, max_wait_ms=0.0)
+        try:
+            assert app.shards is None
+            assert app.health()["shard"] is None
+            assert not hasattr(app.model, "shard_plan_")
+        finally:
+            app.close()
