@@ -7,7 +7,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 LIB_DIR  := knn_tpu/native/lib
 
-.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak fleet-soak shard-soak capacity-probe replay-gate bench bench-gate parity device-parity ref-diff clean
+.PHONY: all native main multi-thread mpi tpu datasets test verify chaos serve-smoke chaos-soak quality-soak ivf-soak mutable-soak fleet-soak shard-soak overload-soak capacity-probe replay-gate bench bench-gate parity device-parity ref-diff clean
 
 all: native main multi-thread mpi tpu datasets
 
@@ -162,6 +162,22 @@ fleet-soak:
 shard-soak:
 	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/shard_soak.py \
 		--short --json-out build/shard-soak-verdict.json
+
+# The overload gate (docs/RESILIENCE.md §Degradation order): the control
+# plane under fire, both halves. Phase 1 drives one replica past its
+# queue bound with mixed-class clients and asserts the ladder engages in
+# order and reverses — bulk sheds with the typed policy 429 (interactive
+# never does), every overload response carries Retry-After >= 1 s, the
+# brownout ladder applies then fully reverts (apply == revert, level 0),
+# the admission cutoff restores, and the SLO layer counted the sheds in
+# policy_sheds. Phase 2 puts a router with --scale-cmd over two live
+# replicas plus an empty slot and asserts the autoscaler drives `up` at
+# the slot under load and `down` at a live non-primary replica when the
+# load stops, with the full begin/complete audit trail in the fleet
+# event log. The verdict JSON lands in build/ (CI uploads it).
+overload-soak:
+	JAX_PLATFORMS=cpu KNN_TPU_RETRY_BASE_MS=0 python3 scripts/overload_soak.py \
+		--short --json-out build/overload-soak-verdict.json
 
 # The cost & capacity gate (docs/OBSERVABILITY.md §Cost & capacity): boot
 # serve with cost accounting on and assert (1) every 200's timeline

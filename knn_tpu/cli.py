@@ -183,6 +183,28 @@ def _add_route_args(p: argparse.ArgumentParser) -> None:
                    "auto-failover/rejoin/hedge-fired/reload events as "
                    "JSON lines) to PATH ('-' = stderr), also served at "
                    "/debug/events (default: off — nothing constructed)")
+    p.add_argument("--scale-cmd", default=None, metavar="CMD",
+                   help="fleet autoscaler (docs/SERVING.md §Surviving "
+                   "an overload): when offered load approaches the "
+                   "usable fleet's summed sustainable QPS, run "
+                   "`CMD up URL` to boot the next registered-but-down "
+                   "replica (snapshot bootstrap catches it up under "
+                   "live traffic); when load recedes well under "
+                   "capacity, run `CMD down URL` to drain a surplus "
+                   "non-primary back out. Scale decisions land in the "
+                   "fleet audit log (--event-log) and "
+                   "knn_fleet_scale_total. Unset (default): zero "
+                   "autoscaler machinery")
+    p.add_argument("--scale-min", type=int, default=1,
+                   help="autoscaler floor: never drain below this many "
+                   "usable replicas (default 1)")
+    p.add_argument("--scale-max", type=int, default=None,
+                   help="autoscaler ceiling: never boot past this many "
+                   "usable replicas (default: every registered replica)")
+    p.add_argument("--scale-cooldown-s", type=float, default=60.0,
+                   help="freeze between autoscale actions (a booted "
+                   "replica needs time to bootstrap, warm, and show up "
+                   "in the capacity sum before the next decision)")
 
 
 def _add_replay_args(p: argparse.ArgumentParser) -> None:
@@ -456,6 +478,40 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    "refusal on a missing artifact. An EXISTING artifact "
                    "is never overwritten at boot (a stale replica "
                    "re-seeds through POST /admin/bootstrap instead)")
+    p.add_argument("--priority", default=None,
+                   metavar="CLASS=LEVEL,...",
+                   help="priority admission (docs/RESILIENCE.md "
+                   "§Degradation order): map request classes to shed "
+                   "priority levels (e.g. 'interactive=0,batch=1,"
+                   "bulk=2'; LOWER = more protected). Past the knee "
+                   "(headroom under the floor, or availability/latency "
+                   "burn over threshold) the HIGHEST levels shed first "
+                   "with a typed 429 + headroom-derived Retry-After, "
+                   "walking down tier by tier; level-0 classes are "
+                   "never shed by policy. Unclassified requests shed at "
+                   "the 'default' class's level (0 if unmapped). Needs "
+                   "--cost-accounting on (the class parser). Unset "
+                   "(default): zero admission machinery")
+    p.add_argument("--brownout", choices=["on", "off"], default="off",
+                   help="reversible brownout ladder (knn_tpu/control/"
+                   "brownout.py): under sustained pressure walk "
+                   "quality/cost knobs down one cooldown at a time — "
+                   "shadow/drift sampling rates, ivf nprobe to base, "
+                   "deadline tightening — each step audited and walked "
+                   "back on recovery; compaction and shadow scoring "
+                   "defer while measured headroom is negative. Needs at "
+                   "least one such knob enabled. 'off' (default): no "
+                   "controller thread, nothing constructed")
+    p.add_argument("--autotune-interval-s", type=float, default=None,
+                   metavar="S",
+                   help="adaptive batching (knn_tpu/control/autotune.py)"
+                   ": every S seconds capture a short live-arrival "
+                   "window, sweep max_wait_ms candidates through the "
+                   "what-if frontier, and apply the best one ONLY after "
+                   "captured-workload replay verifies bit-identical "
+                   "answers (refusals audited). Needs --capture-dir and "
+                   "--cost-accounting on. Unset (default): max_wait_ms "
+                   "stays the operator's static setting")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -936,9 +992,30 @@ def _run_serve(args, stdout) -> int:
         (args.replicate_ack_timeout_s <= 0,
          f"--replicate-ack-timeout-s must be > 0, got "
          f"{args.replicate_ack_timeout_s}"),
+        (args.priority is not None and args.cost_accounting != "on",
+         "--priority sheds by request class, and classes are only "
+         "parsed with --cost-accounting on"),
+        (args.autotune_interval_s is not None
+         and args.autotune_interval_s <= 0,
+         f"--autotune-interval-s must be > 0, got "
+         f"{args.autotune_interval_s}"),
+        (args.autotune_interval_s is not None
+         and (args.capture_dir is None or args.cost_accounting != "on"),
+         "--autotune-interval-s tunes from captured arrivals against "
+         "the fitted dispatch model; it needs --capture-dir and "
+         "--cost-accounting on"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
+            return EXIT_USAGE
+    priority_map = None
+    if args.priority is not None:
+        from knn_tpu.control.admission import parse_priority_map
+
+        try:
+            priority_map = parse_priority_map(args.priority)
+        except ValueError as e:
+            print(f"error: --priority: {e}", file=sys.stderr)
             return EXIT_USAGE
     slo_windows = None
     if args.slo_windows is not None:
@@ -1145,6 +1222,9 @@ def _run_serve(args, stdout) -> int:
             replicate_ack=args.replicate_ack,
             replicate_ack_timeout_s=args.replicate_ack_timeout_s,
             shards=shards,
+            priority_map=priority_map,
+            brownout=(args.brownout == "on"),
+            autotune_interval_s=args.autotune_interval_s,
         )
     except OSError as e:  # an unwritable --access-log / --capture-dir path
         print(f"error: {e}", file=sys.stderr)
@@ -1201,12 +1281,26 @@ def _run_serve(args, stdout) -> int:
         bucket_note = f", buckets={'/'.join(str(b) for b in batch_buckets)}"
     if args.result_cache_rows > 0:
         bucket_note += f", result_cache_rows={args.result_cache_rows}"
+    control_note = ""
+    if app.control_block() is not None:
+        parts = []
+        if app.admission is not None:
+            parts.append("priority=" + "/".join(
+                f"{c}:{level}"
+                for c, level in sorted(priority_map.items())))
+        if app.brownout is not None:
+            parts.append("brownout="
+                         + "+".join(s.name for s in app.brownout.steps))
+        if app.autotune is not None:
+            parts.append(f"autotune={args.autotune_interval_s:g}s")
+        control_note = ", " + ", ".join(parts)
     print(
         f"knn-tpu serve: ready on http://{host}:{port} "
         f"(family={app.family}, k={model.k}, "
         f"train_rows={model.train_.num_instances}, "
         f"index_version={version}{ivf_note}{mutable_note}{fleet_note}"
-        f"{shard_note}{bucket_note}, warmed={sorted(warmed)})",
+        f"{shard_note}{bucket_note}{control_note}, "
+        f"warmed={sorted(warmed)})",
         file=stdout, flush=True,
     )
     return serve_forever(server, drain_timeout_s=args.drain_timeout_s)
@@ -1234,6 +1328,17 @@ def _run_route(args, stdout) -> int:
          f"{args.flight_recorder_size}"),
         (args.slowest_k < 0,
          f"--slowest-k must be >= 0, got {args.slowest_k}"),
+        (args.scale_min < 1,
+         f"--scale-min must be >= 1, got {args.scale_min}"),
+        (args.scale_max is not None and args.scale_max < args.scale_min,
+         f"--scale-max ({args.scale_max}) must be >= --scale-min "
+         f"({args.scale_min})"),
+        (args.scale_cooldown_s <= 0,
+         f"--scale-cooldown-s must be > 0, got {args.scale_cooldown_s}"),
+        (args.scale_cmd is None
+         and (args.scale_min != 1 or args.scale_max is not None),
+         "--scale-min/--scale-max bound the autoscaler; they need "
+         "--scale-cmd"),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
@@ -1271,6 +1376,10 @@ def _run_route(args, stdout) -> int:
             slowest_k=args.slowest_k,
             access_log=args.access_log,
             event_log=args.event_log,
+            scale_cmd=args.scale_cmd,
+            scale_min=args.scale_min,
+            scale_max=args.scale_max,
+            scale_cooldown_s=args.scale_cooldown_s,
         )
     except ValueError as e:  # bad --hedge-ms / duplicate replica URLs
         print(f"error: {e}", file=sys.stderr)
@@ -1287,10 +1396,15 @@ def _run_route(args, stdout) -> int:
         return EXIT_RUNTIME
     host, port = server.server_address[:2]
     usable = app.set.export()["usable"]
+    scale_note = ""
+    if args.scale_cmd is not None:
+        scale_note = (f", scale={args.scale_min}.."
+                      f"{args.scale_max or len(args.replicas)}")
     print(
         f"knn-tpu route: ready on http://{host}:{port} "
         f"(replicas={len(args.replicas)}, usable={usable}, "
-        f"hedge={args.hedge_ms}, auto_failover={args.auto_failover})",
+        f"hedge={args.hedge_ms}, auto_failover={args.auto_failover}"
+        f"{scale_note})",
         file=stdout, flush=True,
     )
     return router_forever(server)

@@ -23,7 +23,11 @@ typed events plus an optional JSONL file (``--event-log``), exposed at
   self-healing leg; ``trigger`` distinguishes auto from operator);
 - ``epoch-retention-hold``         — a coordinated compaction reported
   deferring WAL epoch pruning because a live follower's cursor still
-  needs those records (the retention floor).
+  needs those records (the retention floor);
+- ``scale-up-begin`` / ``scale-down-begin`` / ``-complete`` /
+  ``-failed`` — the autoscaler (``--scale-cmd``) drove the operator's
+  scale command at a replica slot; the begin event carries the
+  offered/sustainable QPS comparison that justified the move.
 
 Every event is stamped with the ``request_id`` that triggered it where one
 exists (a hedge, a passive demotion, an operator admin call), so the audit

@@ -53,6 +53,10 @@ class ReplicaState:
         self.applied_seq = 0
         self.promoted_at_seq: Optional[int] = None
         self.compaction_pressure: Optional[int] = None
+        #: The replica's self-reported read capacity (its /healthz
+        #: ``capacity.sustainable_qps``, from the fitted cost model) —
+        #: the supply side of the router's autoscale comparison.
+        self.sustainable_qps: Optional[float] = None
         #: The primary's own view of its shippers (``fleet.followers``
         #: from its /healthz): ``{follower_url: {state, acked_seq}}`` —
         #: how the router learns a follower parked behind the fold or
@@ -70,6 +74,7 @@ class ReplicaState:
             "role": self.role,
             "applied_seq": self.applied_seq,
             "compaction_pressure": self.compaction_pressure,
+            "sustainable_qps": self.sustainable_qps,
             "followers": self.followers,
         }
 
@@ -175,6 +180,9 @@ class ReplicaSet:
             if isinstance(mutable, dict):
                 s.compaction_pressure = (int(mutable.get("delta_slots", 0))
                                          + int(mutable.get("tombstones", 0)))
+            capacity = doc.get("capacity")
+            if isinstance(capacity, dict):
+                s.sustainable_qps = capacity.get("sustainable_qps")
             if status == 200:
                 s.healthy = True
                 s.consecutive_failures = 0

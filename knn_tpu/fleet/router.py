@@ -32,6 +32,17 @@ Routing rules (each one line of the robustness story):
   primary's current generation and its shipper resumes on the next
   re-probe. This is the self-healing leg: a replica never stays
   terminally parked while a healthy primary can re-seed it.
+- ``--scale-cmd`` arms the fleet autoscaler
+  (:mod:`knn_tpu.control.autoscale`): each health poll compares the
+  router's 30s offered read load against the fleet's summed
+  self-reported ``sustainable_qps`` and, past the hysteresis bands,
+  runs the operator's scale command to boot or drain one replica slot
+  — the FIRST rung of the degradation order (docs/RESILIENCE.md):
+  grow the fleet before any replica sheds or browns out.
+- every 429/503 overload answer (relayed or originated) carries a
+  ``Retry-After`` hint, and the access log records the request's
+  admission ``class`` — the client-facing half of the overload
+  control plane (docs/SERVING.md §Surviving an overload).
 
 The router holds no model and no index — it is restartable at any time
 with zero state loss (its only state is health, a round-robin cursor,
@@ -43,6 +54,7 @@ from __future__ import annotations
 import concurrent.futures
 import json
 import os
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -99,7 +111,11 @@ class RouterApp:
                  failover_after_s: float = 3.0,
                  flight_recorder_size: int = 256, slowest_k: int = 32,
                  access_log: Optional[str] = None,
-                 event_log=None):
+                 event_log=None,
+                 scale_cmd: Optional[str] = None,
+                 scale_min: int = 1,
+                 scale_max: Optional[int] = None,
+                 scale_cooldown_s: float = 60.0):
         # The fleet event audit log: None unless asked for — a router
         # booted without --event-log constructs no writer, no ring
         # (the zero-cost-when-off contract the overhead check pins).
@@ -156,6 +172,30 @@ class RouterApp:
         self._bootstrap_lock = threading.Lock()
         self._bootstrap_inflight: "set[str]" = set()
         self._bootstrap_last: "dict[str, float]" = {}
+        # Fleet autoscaler (knn_tpu/control/autoscale.py,
+        # docs/SERVING.md §Surviving an overload): the DEGRADATION-ORDER
+        # first resort — grow the fleet before any replica sheds or
+        # browns out. No --scale-cmd (the default) constructs NOTHING:
+        # no control import, no offered-load ring, no autoscale state
+        # (scripts/check_disabled_overhead.py pins it).
+        self.scale_cmd = scale_cmd
+        self.scales = 0
+        if scale_cmd is not None:
+            from knn_tpu.control.autoscale import AutoscalePolicy
+            from knn_tpu.obs.slo import SecondRing
+
+            self.autoscale = AutoscalePolicy(
+                scale_min, scale_max or len(self.set.urls),
+                cooldown_s=scale_cooldown_s)
+            # Offered READ load, counted at forward time (before any
+            # shed/failure): the demand side the fleet's summed
+            # sustainable QPS is compared against.
+            self._offered = SecondRing(1, 60)
+            self._scale_lock = threading.Lock()
+            self._scale_inflight = False
+        else:
+            self.autoscale = None
+            self._offered = None
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="knn-fleet-hedge")
         self.set.start()
@@ -275,6 +315,10 @@ class RouterApp:
         whole forward walk) — with one attempt record per replica tried,
         so the phase walls sum to ~the router-observed request wall (the
         invariant the fleet soak's forensics phase pins)."""
+        if self._offered is not None:
+            # Offered-load sample for the autoscaler: counted before any
+            # shed/failure so demand the fleet turned away still counts.
+            self._offered.add(1)
         if trace is not None:
             trace.phase_start("route")
         candidates = self.set.usable_urls(start=self._next_rr())
@@ -870,6 +914,7 @@ class RouterApp:
         moving real work off the poll thread."""
         self._maybe_failover()
         self._maybe_bootstrap()
+        self._maybe_autoscale()
 
     def _maybe_bootstrap(self) -> None:
         """Poll hook, the re-seed leg: with ``--auto-failover``, a
@@ -924,6 +969,106 @@ class RouterApp:
 
         threading.Thread(target=work, daemon=True,
                          name="knn-fleet-bootstrap").start()
+
+    def _fleet_capacity(self):
+        """Sum the fleet's self-reported read capacity: each usable
+        replica's ``sustainable_qps`` (its /healthz capacity block,
+        captured by the health poller). Returns ``(sum_or_None,
+        usable_count)`` — None until at least one replica has a fitted
+        capacity model, so the autoscaler holds instead of acting on a
+        cold fleet."""
+        total = None
+        usable = 0
+        for url in self.set.usable_urls():
+            usable += 1
+            qps = self.set.state(url).sustainable_qps
+            if qps is not None:
+                total = (total or 0.0) + float(qps)
+        return total, usable
+
+    def _maybe_autoscale(self) -> None:
+        """Poll hook, the capacity leg (``--scale-cmd``): compare the
+        30s offered read load against the fleet's summed sustainable
+        QPS and walk the fleet size toward demand — the FIRST rung of
+        the degradation order (grow before any replica sheds). One
+        scale op inflight at a time, cooldown inside the policy; the
+        operator's command runs off the poll thread."""
+        if self.autoscale is None or self._scale_inflight:
+            return
+        offered = self._offered.window_sums(30)[0] / 30.0
+        sustainable, usable = self._fleet_capacity()
+        direction = self.autoscale.decide(offered, sustainable, usable)
+        if direction is None:
+            return
+        target = (self._scale_up_target() if direction == "up"
+                  else self._scale_down_target())
+        if target is None:
+            return
+        with self._scale_lock:
+            if self._scale_inflight:
+                return
+            self._scale_inflight = True
+        if self.events is not None:
+            self.events.emit(f"scale-{direction}-begin", replica=target,
+                             offered_qps=round(offered, 2),
+                             sustainable_qps=(
+                                 None if sustainable is None
+                                 else round(sustainable, 2)),
+                             usable=usable)
+
+        def work():
+            ok = False
+            err = None
+            try:
+                from knn_tpu.control.autoscale import run_scale_cmd
+                run_scale_cmd(self.scale_cmd, direction, target,
+                              timeout_s=self.admin_timeout_s)
+                ok = True
+            except Exception as e:  # the operator's command, any failure
+                err = str(e)
+            finally:
+                obs.counter_add(
+                    "knn_fleet_scale_total",
+                    help="autoscaler scale operations by direction and "
+                         "outcome",
+                    direction=direction,
+                    outcome="ok" if ok else "failed")
+                if self.events is not None:
+                    if ok:
+                        self.events.emit(f"scale-{direction}-complete",
+                                         replica=target)
+                    else:
+                        self.events.emit(f"scale-{direction}-failed",
+                                         replica=target, error=err)
+                if ok:
+                    self.scales += 1
+                    self.set.poll_once()
+                with self._scale_lock:
+                    self._scale_inflight = False
+
+        threading.Thread(target=work, daemon=True,
+                         name="knn-control-autoscale").start()
+
+    def _scale_up_target(self) -> Optional[str]:
+        """The slot to fill: the first REGISTERED url that is not
+        currently usable — the router's replica list is the fleet's
+        address space, so scale-up re-animates a down slot (the scale
+        command boots a process there; --bootstrap auto seeds it)."""
+        usable = set(self.set.usable_urls())
+        for url in self.set.urls:
+            if url not in usable:
+                return url
+        return None
+
+    def _scale_down_target(self) -> Optional[str]:
+        """The replica to drain: the LAST usable non-primary — never
+        the primary (writes), never below the policy floor (the policy
+        already enforced min)."""
+        primary = self.set.primary_url()
+        for url in reversed(self.set.usable_urls()):
+            if url != primary:
+                return url
+        return None
 
     def bootstrap(self, follower: Optional[str] = None,
                   source: Optional[str] = None,
@@ -1016,7 +1161,30 @@ class RouterApp:
             "event_log": (self.events.export()
                           if self.events is not None else None),
             "access_log": self.access_log is not None,
+            # The autoscaler's operating point; None (the DISTINCT
+            # "no autoscaler" state) while --scale-cmd is unset.
+            "autoscale": self._autoscale_block(),
         }
+
+    def _autoscale_block(self) -> Optional[dict]:
+        if self.autoscale is None:
+            return None
+        offered = self._offered.window_sums(30)[0] / 30.0
+        sustainable, usable = self._fleet_capacity()
+        return dict(self.autoscale.export(),
+                    offered_qps=round(offered, 2),
+                    sustainable_qps=(None if sustainable is None
+                                     else round(sustainable, 2)),
+                    usable=usable,
+                    inflight=self._scale_inflight,
+                    scales=self.scales)
+
+    def overload_retry_after_s(self) -> float:
+        """Retry-After for the router's own overload answers (zero
+        usable replicas, no primary): a small jittered constant — the
+        router has no queue model of its own, and the jitter de-syncs a
+        thundering herd of retriers."""
+        return 1.0 + random.random()
 
     # -- fleet observability -----------------------------------------------
 
@@ -1130,12 +1298,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
         pass  # /metrics is the log (the serve handler's rule)
 
     def _send_raw(self, status: int, raw: bytes,
-                  content_type="application/json"):
+                  content_type="application/json",
+                  retry_after: "Optional[float]" = None):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         rid = getattr(self, "_rid", None)
         if rid is not None:
             self.send_header("x-request-id", rid)
+        if retry_after is not None:
+            # RFC 9110 delay-seconds: integral, floor 1 — a client that
+            # honors it backs off instead of hammering an overloaded
+            # fleet.
+            self.send_header("Retry-After",
+                             str(max(1, int(round(retry_after)))))
         self.send_header("Content-Length", str(len(raw)))
         self.end_headers()
         self.wfile.write(raw)
@@ -1265,13 +1440,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
             if route in ("/predict", "/kneighbors"):
                 status, raw, replica = self.app.forward_read(
                     route, body, headers, trace=trace)
-                self._note(route, status, replica, trace)
-                self._send_raw(status, raw)
+                self._note(route, status, replica, trace, req_class=cls)
+                self._send_raw(status, raw,
+                               retry_after=self._retry_after(status))
             elif route in ("/insert", "/delete"):
                 status, raw, replica = self.app.forward_write(
                     route, body, headers, trace=trace)
-                self._note(route, status, replica, trace)
-                self._send_raw(status, raw)
+                self._note(route, status, replica, trace, req_class=cls)
+                self._send_raw(status, raw,
+                               retry_after=self._retry_after(status))
             elif route == "/admin/promote":
                 self._do_admin(body, self._admin_promote)
             elif route == "/admin/reload":
@@ -1309,8 +1486,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                          request_id=self._rid)
         return None
 
+    def _retry_after(self, status: int) -> "Optional[float]":
+        """Retry-After for every overload/unavailable answer the router
+        relays or originates (429 shed/rejected at a replica, 503 zero
+        usable / failover window) — the forward path strips replica
+        headers, so the router re-derives the hint here."""
+        if status not in (429, 503):
+            return None
+        return self.app.overload_retry_after_s()
+
     def _note(self, route: str, status: int, replica,
-              trace=None) -> None:
+              trace=None, req_class=None) -> None:
         obs.counter_add(
             "knn_fleet_router_requests_total",
             help="client requests answered by the router, by endpoint "
@@ -1338,6 +1524,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 "hedged": any(e["event"] == "hedge-fired"
                               for e in tl["events"]),
             }
+            if req_class is not None:
+                # Which admission class asked — overload forensics needs
+                # to join sheds back to the traffic that drove them.
+                entry["class"] = req_class
             phases: dict = {}
             for p in tl["phases"]:
                 phases[p["phase"]] = round(

@@ -99,6 +99,7 @@ class ProbePolicy:
         self._current = self.base
         self._last_eval_ns = 0
         self._last_move_ns = 0
+        self._brownout = False
         self.moves = {"widen": 0, "narrow": 0}
         self.last_burn = 0.0
 
@@ -112,6 +113,13 @@ class ProbePolicy:
             return self._current
         now = time.monotonic_ns()
         with self._lock:
+            if self._brownout:
+                # The control plane's brownout holds the operating point
+                # at base: widening spends exactly the dispatch cost the
+                # brownout exists to reclaim. Quality burn accrued while
+                # held is the brownout's documented trade; the policy
+                # resumes control the tick the brownout reverts.
+                return self._current
             if (now - self._last_eval_ns) < self.eval_ms * 1e6:
                 return self._current
             self._last_eval_ns = now
@@ -166,6 +174,23 @@ class ProbePolicy:
 
     # -- lifecycle / read side ---------------------------------------------
 
+    def set_brownout(self, active: bool) -> None:
+        """Engage/release the control plane's brownout clamp
+        (:mod:`knn_tpu.control.brownout`): engaging snaps the operating
+        point to ``base`` (giving back every widened probe's dispatch
+        cost) and freezes the policy; releasing unfreezes it — the next
+        ``current()`` re-reads the burn signal and re-widens if the
+        quality budget still demands it (no saved state to restore: the
+        burn signal IS the state)."""
+        with self._lock:
+            active = bool(active)
+            if active == self._brownout:
+                return
+            self._brownout = active
+            if active and self._current != self.base:
+                self._move("narrow", self.base, self.last_burn,
+                           time.monotonic_ns())
+
     def set_num_cells(self, num_cells: int) -> None:
         """Re-bound after a hot reload (a new index may have a different
         cell count); the operating point and base clamp into range. The
@@ -188,6 +213,7 @@ class ProbePolicy:
                 "max_probes": self.num_cells,
                 "moves": dict(self.moves),
                 "last_quality_burn": round(self.last_burn, 4),
+                "brownout": self._brownout,
                 "widen_burn": self.widen_burn,
                 "narrow_burn": self.narrow_burn,
                 "cooldown_ms": self.cooldown_ms,

@@ -196,11 +196,21 @@ class Compactor:
     instead of being force-parked behind the fold point
     (``FleetReplica.retention_floor`` wires this; a non-replicated serve
     passes nothing and prunes exactly as before).
+
+    ``defer`` — optional zero-arg callable (the control plane's
+    ``BrownoutController.defer_background``): while it returns True,
+    interval/threshold-triggered folds WAIT — headroom is negative, and
+    a fold's warmup + swap stealing cycles from overload traffic is the
+    LSM anti-pattern the scheduler exists to avoid. The explicit
+    ``/admin/compact`` path (``run_once``) is NOT gated: an operator's
+    direct order outranks the scheduler. Pressure keeps accruing while
+    deferred (delta-full inserts still 429), so the first post-recovery
+    tick folds immediately.
     """
 
     def __init__(self, engine, *, swap, warm,
                  threshold: int = 1024, interval_s: float = 30.0,
-                 retention_floor=None):
+                 retention_floor=None, defer=None):
         if threshold < 1:
             raise ValueError(f"compact threshold must be >= 1, got "
                              f"{threshold}")
@@ -213,6 +223,7 @@ class Compactor:
         self._swap = swap
         self._warm = warm
         self._retention_floor = retention_floor
+        self._defer = defer
         self._lock = threading.Lock()
         self._kick = threading.Event()
         self._stop = threading.Event()
@@ -242,6 +253,11 @@ class Compactor:
         if pressure < self.threshold:
             return
         self._kick.set()
+        if self._defer is not None and self._defer():
+            # Headroom-negative deferral: remember the kick, fold later.
+            # Pressure persists, so the next mutation (zero-thread mode)
+            # or interval tick re-attempts once headroom returns.
+            return
         if self._thread is None and not self._stop.is_set():
             # Zero-thread mode (interval_s == 0) has no interval worker to
             # consume the kick — the CLI promise ("threshold kicks still
@@ -265,6 +281,14 @@ class Compactor:
             self._kick.wait(self.interval_s)
             if self._stop.is_set():
                 return
+            if self._defer is not None and self._defer():
+                # Negative headroom: leave the kick set and re-check —
+                # deferred pressure must fold on the FIRST healthy tick,
+                # not wait for a fresh trigger. The bounded sleep (the
+                # kick keeps `wait` from sleeping) stops the loop from
+                # spinning while deferred.
+                self._stop.wait(min(1.0, self.interval_s))
+                continue
             kicked = self._kick.is_set()
             self._kick.clear()
             if (self.engine.pressure() >= self.threshold

@@ -231,6 +231,23 @@ class ShadowScorer:
     def shed(self) -> int:
         return self._sq.shed
 
+    def set_rate(self, rate: float) -> None:
+        """Move the live sampling rate (the control plane's brownout
+        knob — :mod:`knn_tpu.control.brownout`). 0 is legal HERE (a
+        temporary full brownout of scoring), unlike the constructor:
+        a scorer built to sample nothing would be dead weight, a scorer
+        told to pause is a reversible operating point."""
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"shadow rate must be in [0, 1], got {rate}")
+        self.rate = float(rate)
+        self._sq.rate = float(rate)
+
+    def set_defer(self, defer) -> None:
+        """Install (or clear, with None) the brownout's headroom gate:
+        while it returns True, offers are counted shed instead of queued
+        — scoring work waits for measured headroom."""
+        self._sq.defer = defer
+
     # -- producer side (the batcher worker thread) -------------------------
 
     def offer(self, *, features, kind: str, dists, idx, preds, rung: str,

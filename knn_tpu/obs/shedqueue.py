@@ -44,6 +44,12 @@ class ShedQueue:
         if queue_cap < 1:
             raise ValueError(f"queue_cap must be >= 1, got {queue_cap}")
         self.rate = float(rate)
+        # Optional zero-arg gate the control plane's brownout installs
+        # (knn_tpu/control/brownout.py): while it returns True, offers
+        # are deferred — counted as shed, never enqueued — so background
+        # scoring work schedules into measured headroom. None (the
+        # default, and always without a control plane) costs nothing.
+        self.defer: Optional[Callable[[], bool]] = None
         self.queue_cap = int(queue_cap)
         self.thread_name = thread_name
         self._consume = consume
@@ -73,6 +79,14 @@ class ShedQueue:
         queued."""
         with self._lock:
             if self._closed or self._rng.random() >= self.rate:
+                return False
+            if self.defer is not None and self.defer():
+                # Headroom-negative deferral: the draw stays ahead of the
+                # RNG stream (a deferred offer consumes its draw exactly
+                # like an admitted one), the sample is counted shed.
+                self.shed += 1
+                if self._on_shed is not None:
+                    self._on_shed()
                 return False
             if len(self._queue) >= self.queue_cap:
                 self.shed += 1

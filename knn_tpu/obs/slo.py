@@ -165,6 +165,14 @@ class SLOTracker:
         # request scored seconds after it was served must not perturb the
         # per-HTTP-outcome counters above. Fields: [total, good].
         self._qring = SecondRing(2, ws[-1])
+        # Policy sheds ride their own ring too: a DELIBERATE 429 of a
+        # non-protected class (knn_tpu/control/admission.py) is the
+        # control plane working, not an availability incident — it must
+        # be visible (exported per window) without spending any
+        # objective's budget. Protected classes are never shed by
+        # policy, so their overload 429s still land in `record` and
+        # still burn. Fields: [sheds].
+        self._shed_ring = SecondRing(1, ws[-1])
 
     # -- recording (O(1)) --------------------------------------------------
 
@@ -185,6 +193,14 @@ class SLOTracker:
         served answer matched the oracle rung (recall 1.0 and vote
         agreement). Only sampled requests move this SLI."""
         self._qring.add(1, 1 if good else 0)
+
+    def record_shed(self) -> None:
+        """One policy shed of a non-protected class: counted for the
+        export (an operator must see shed volume next to the burn it was
+        spent to avoid), excluded from every objective's denominator —
+        the availability-exclusion half of the shed-by-policy contract
+        (docs/RESILIENCE.md §Degradation order)."""
+        self._shed_ring.add(1)
 
     # -- aggregation (O(window), scrape-time only) -------------------------
 
@@ -243,9 +259,14 @@ class SLOTracker:
             "knn_slo_latency_target_ms", self.latency_target_ms,
             help="latency SLO threshold (ms)",
         )
+        policy_sheds = {
+            window_label(w): int(self._shed_ring.window_sums(w)[0])
+            for w in self.windows_s
+        }
         return {
             "targets": dict(self.targets),
             "latency_target_ms": self.latency_target_ms,
             "windows": [window_label(w) for w in self.windows_s],
             "burn_rates": burns,
+            "policy_sheds": policy_sheds,
         }

@@ -186,3 +186,28 @@ def frontier(arrivals: Sequence, policies: Sequence[dict], *, a_ms: float,
                                ("max_batch", "max_wait_ms", "buckets")},
                     **sim})
     return out
+
+
+def default_policy_candidates(max_batch: int, max_wait_ms: float,
+                              buckets: Optional[Sequence[int]] = None
+                              ) -> "list[dict]":
+    """The autotuner's candidate grid around the LIVE policy: the current
+    ``max_wait_ms`` plus halvings/doublings of it (and 0 — pure
+    anti-coalescing — when the current wait is small), same ``max_batch``
+    and bucket ladder throughout. Only the coalescing window varies:
+    ``max_batch``/``buckets`` change compiled shapes, which the
+    replay-verification contract treats as an operator decision, not a
+    cadence re-tune (:mod:`knn_tpu.control.autotune`)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if max_wait_ms < 0:
+        raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+    waits = {round(float(max_wait_ms), 4)}
+    base = max(float(max_wait_ms), 0.25)
+    for factor in (0.25, 0.5, 2.0, 4.0):
+        waits.add(round(base * factor, 4))
+    if base <= 1.0:
+        waits.add(0.0)
+    return [{"max_batch": int(max_batch), "max_wait_ms": w,
+             "buckets": list(buckets) if buckets else None}
+            for w in sorted(waits)]

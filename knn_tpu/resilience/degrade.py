@@ -57,6 +57,28 @@ from knn_tpu.resilience.errors import DataError, DeviceError, ResilienceError
 #: instrumentation bug.
 SERVING_RUNGS: Tuple[str, ...] = ("ivf", "fast", "xla", "oracle")
 
+#: The OVERLOAD degradation order (docs/RESILIENCE.md §Degradation
+#: order) — the contract the control plane (knn_tpu/control/) enforces
+#: when a replica is past its knee, strictly in this sequence:
+#:
+#: 1. ``scale``              — the fleet grows (router autoscaler boots
+#:                             a replica through snapshot bootstrap)
+#:                             before any single replica degrades;
+#: 2. ``shed_low_priority``  — the lowest-priority request classes 429
+#:                             (typed ShedByPolicy, Retry-After from
+#:                             headroom) while protected classes admit;
+#: 3. ``brownout_quality``   — reversible quality/cost knobs walk down
+#:                             (sampling rates, nprobe to base, deadline
+#:                             tightening), audited and reverted;
+#: 4. ``availability``       — the queue-full OverloadError backstop:
+#:                             the LAST resort, and the only stage that
+#:                             spends protected classes' error budget.
+#:
+#: Shared as data so the controllers, their tests, and the overload soak
+#: assert the same sequence instead of each encoding its own.
+DEGRADATION_ORDER: Tuple[str, ...] = (
+    "scale", "shed_low_priority", "brownout_quality", "availability")
+
 #: backend -> fallback rungs, most-capable first.
 LADDER: Dict[str, Tuple[str, ...]] = {
     "tpu-sharded": ("tpu", "tpu-pallas", "native", "oracle"),

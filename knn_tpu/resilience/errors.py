@@ -123,6 +123,26 @@ class OverloadError(ResilienceError):
     what the serving front-end's HTTP 429 tells it."""
 
 
+class ShedByPolicy(OverloadError):
+    """Admission DELIBERATELY refused this request because its priority
+    class is below the control plane's current admission cutoff
+    (:mod:`knn_tpu.control.admission`) — overload pressure, not a full
+    queue. Distinct from the base :class:`OverloadError` so the serving
+    layer can label the outcome ``shed`` (not ``rejected``) and the SLO
+    availability SLI can exclude policy sheds of non-protected classes: a
+    planned ``bulk`` shed is the control plane working, not an incident.
+    ``retry_after_s`` is the headroom-derived client backoff the 429's
+    ``Retry-After`` header carries; ``request_class`` names the shed
+    class."""
+
+    def __init__(self, message: str, *, request_class: str,
+                 retry_after_s: float,
+                 fault_point: "str | None" = None):
+        super().__init__(message, fault_point=fault_point)
+        self.request_class = request_class
+        self.retry_after_s = float(retry_after_s)
+
+
 # Substrings that mark an XLA runtime failure as resource exhaustion. XLA
 # surfaces OOM as XlaRuntimeError("RESOURCE_EXHAUSTED: ..."); host-side
 # allocation failure is MemoryError.

@@ -101,6 +101,7 @@ from knn_tpu.resilience.errors import (
     DeviceError,
     OverloadError,
     ResilienceError,
+    ShedByPolicy,
 )
 
 KINDS = ("predict", "kneighbors")
@@ -443,7 +444,7 @@ class MicroBatcher:
                  recorder: "Optional[reqtrace.FlightRecorder]" = None,
                  quality=None, drift=None, accounting=None, capacity=None,
                  ivf=None, mutable=None, workload=None, buckets=None,
-                 result_cache_rows: int = 0):
+                 result_cache_rows: int = 0, admission=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -520,6 +521,13 @@ class MicroBatcher:
         # thread, no per-request work; one `is None` predicate per
         # terminal outcome (scripts/check_disabled_overhead.py pins it).
         self.workload = workload
+        # Priority admission (knn_tpu/control/admission.py): an optional
+        # PriorityAdmission. None (the default, and always without
+        # --priority) constructs NOTHING — no cutoff evaluation, no
+        # priority re-ordering, FIFO semantics byte-identical to
+        # pre-control serving; one `is None` predicate per call site
+        # (scripts/check_disabled_overhead.py pins it).
+        self.admission = admission
         self._mutations: deque = deque()
         # TEST-ONLY corruption hook (scripts/quality_soak.py): when armed
         # (the serve process installs a SIGUSR2 handler only under
@@ -619,6 +627,16 @@ class MicroBatcher:
             if self.accounting is not None:
                 trace.annotate(request_class=request_class)
         try:
+            if self.admission is not None:
+                # Priority admission BEFORE the queue bound: a shed is a
+                # policy decision about WHO queues, the row bound below
+                # is physics about HOW MUCH — and the typed ShedByPolicy
+                # (vs plain OverloadError) is what lets the outcome
+                # labeling below and the SLO layer tell them apart.
+                shed = self.admission.admit(request_class)
+                if shed is not None:
+                    instrument.record_serve_rejected("shed")
+                    raise shed
             with self._cond:
                 if self._closed:
                     instrument.record_serve_rejected("closed")
@@ -648,19 +666,24 @@ class MicroBatcher:
             # survives the 429 path the same way, and the arrival still
             # counts: the capacity rings track OFFERED load, so the
             # headroom ratio keeps falling past the knee instead of
-            # saturating at the admitted (≈ service) rate.
+            # saturating at the admitted (≈ service) rate. A policy shed
+            # gets its own outcome label end to end — accounting,
+            # workload capture, trace — so a deliberate `bulk` shed
+            # never reads as the same event as a queue-full rejection.
+            outcome = ("shed" if isinstance(e, ShedByPolicy)
+                       else "rejected")
             if self.accounting is not None:
-                self.accounting.note_outcome(request_class, "rejected")
+                self.accounting.note_outcome(request_class, outcome)
             if self.capacity is not None:
                 self.capacity.note_arrival(req.rows)
             if self.workload is not None:
                 # A refused admission is still workload: an incident
                 # capture without its 429s would replay as lighter load
                 # than the incident actually offered.
-                self.workload.note_request(req, "rejected")
+                self.workload.note_request(req, outcome)
             if trace is not None:
-                trace.annotate(error=f"OverloadError: {e}")
-                trace.finish("rejected")
+                trace.annotate(error=f"{type(e).__name__}: {e}")
+                trace.finish(outcome)
             raise
         instrument.record_serve_request(kind, req.rows)
         if self.capacity is not None:
@@ -899,6 +922,19 @@ class MicroBatcher:
                         if wait_s <= 0:
                             break
                         self._cond.wait(wait_s)
+                if self.admission is not None and len(self._queue) > 1:
+                    # Priority-aware pickup: the batch fills highest
+                    # priority first (stable — FIFO within a class), so
+                    # a forming batch never strands `interactive` behind
+                    # queued `bulk`. AFTER the coalescing window (whose
+                    # deadline anchors to the oldest arrival regardless
+                    # of class) and only with an admission policy: the
+                    # flagless path keeps the deque untouched, FIFO.
+                    self._queue = deque(sorted(
+                        self._queue,
+                        key=lambda r: (
+                            self.admission.priority_of(r.request_class),
+                            r.enqueued_ns)))
                 batch, rows = [], 0
                 while self._queue:
                     nxt = self._queue[0]
@@ -1400,6 +1436,14 @@ class MicroBatcher:
         if rows >= boundary:
             return
         with self._cond:
+            if self.admission is not None and len(self._queue) > 1:
+                # Same priority-aware pickup as _collect: free top-up
+                # rows go to the highest-priority waiters first.
+                self._queue = deque(sorted(
+                    self._queue,
+                    key=lambda r: (
+                        self.admission.priority_of(r.request_class),
+                        r.enqueued_ns)))
             while self._queue and rows + self._queue[0].rows <= boundary:
                 nxt = self._queue.popleft()
                 self._queued_rows -= nxt.rows

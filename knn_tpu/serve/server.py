@@ -92,6 +92,7 @@ import contextlib
 import json
 import math
 import os
+import random
 import sys
 import threading
 import time
@@ -109,6 +110,7 @@ from knn_tpu.resilience.errors import (
     DataError,
     DeadlineExceededError,
     OverloadError,
+    ShedByPolicy,
 )
 from knn_tpu.serve import artifact
 from knn_tpu.serve.batcher import MicroBatcher
@@ -188,7 +190,10 @@ class ServeApp:
                  follower_of: Optional[str] = None,
                  replicate_to=None, replicate_ack: str = "any",
                  replicate_ack_timeout_s: float = 5.0,
-                 shards: Optional[int] = None):
+                 shards: Optional[int] = None,
+                 priority_map: Optional[dict] = None,
+                 brownout: bool = False,
+                 autotune_interval_s: Optional[float] = None):
         self._previous_buckets = None
         self._installed_buckets = False
         if batch_buckets is not None:
@@ -390,6 +395,86 @@ class ServeApp:
             )
         else:
             self.workload = None
+        # Overload control plane (knn_tpu/control/, docs/RESILIENCE.md
+        # §Degradation order). --priority installs priority admission:
+        # under sustained pressure the LOWEST-priority request classes
+        # shed first (typed ShedByPolicy 429 with a headroom-derived
+        # Retry-After) while protected classes keep admitting. No flag
+        # (the default) constructs NOTHING — no control import, no
+        # knn_control_* instruments, no controller threads; the batcher
+        # pays one `is None` predicate per submit
+        # (scripts/check_disabled_overhead.py pins it).
+        if priority_map:
+            if self.accounting is None:
+                raise DataError(
+                    "--priority sheds by request class, and classes are "
+                    "only parsed while cost accounting runs; boot with "
+                    "--cost-accounting"
+                )
+            from knn_tpu.control.admission import PriorityAdmission
+
+            self.admission = PriorityAdmission(
+                priority_map, slo=self.slo, capacity=self.capacity)
+        else:
+            self.admission = None
+        # --brownout builds the reversible-degradation ladder from
+        # whichever quality/cost knobs are actually wired on this serve:
+        # sampling rates down, nprobe clamped to base, deadline
+        # tightened — applied one per cooldown under pressure, every
+        # step audited and walked back on recovery. Its headroom gate
+        # (defer_background) also defers shadow/drift sampling and
+        # compaction while offered load exceeds sustainable.
+        if brownout:
+            from knn_tpu.control.brownout import (
+                BrownoutController,
+                BrownoutStep,
+            )
+
+            steps = []
+            if self.quality is not None:
+                q, q_rate = self.quality, float(shadow_rate)
+                steps.append(BrownoutStep(
+                    "shadow_rate",
+                    lambda q=q, r=q_rate: q.set_rate(r * 0.1),
+                    lambda q=q, r=q_rate: q.set_rate(r),
+                ))
+            if self.drift is not None:
+                d, d_rate = self.drift, float(drift_rate)
+                steps.append(BrownoutStep(
+                    "drift_rate",
+                    lambda d=d, r=d_rate: d.set_rate(r * 0.1),
+                    lambda d=d, r=d_rate: d.set_rate(r),
+                ))
+            if self.ivf is not None:
+                pol = self.ivf.policy
+                steps.append(BrownoutStep(
+                    "ivf_probes_to_base",
+                    lambda p=pol: p.set_brownout(True),
+                    lambda p=pol: p.set_brownout(False),
+                ))
+            if self.deadline_ms is not None:
+                base_deadline = float(self.deadline_ms)
+                steps.append(BrownoutStep(
+                    "deadline_tighten",
+                    lambda d=base_deadline: setattr(
+                        self, "deadline_ms", d * 0.5),
+                    lambda d=base_deadline: setattr(
+                        self, "deadline_ms", d),
+                ))
+            if not steps:
+                raise DataError(
+                    "--brownout needs at least one reversible knob on "
+                    "this serve; enable --shadow-rate, --drift-rate, "
+                    "--ivf-probes, or --deadline-ms"
+                )
+            self.brownout = BrownoutController(
+                steps, slo=self.slo, capacity=self.capacity)
+            if self.quality is not None:
+                self.quality.set_defer(self.brownout.defer_background)
+            if self.drift is not None:
+                self.drift.set_defer(self.brownout.defer_background)
+        else:
+            self.brownout = None
         self.batcher = MicroBatcher(
             model, max_batch=max_batch, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, index_version=index_version,
@@ -397,6 +482,7 @@ class ServeApp:
             accounting=self.accounting, capacity=self.capacity,
             ivf=self.ivf, mutable=self.mutable, workload=self.workload,
             buckets=batch_buckets, result_cache_rows=result_cache_rows,
+            admission=self.admission,
         )
         if mutable:
             from knn_tpu.mutable.compact import Compactor
@@ -411,9 +497,36 @@ class ServeApp:
                 # nothing and prunes exactly as before.
                 retention_floor=(self.fleet.retention_floor
                                  if self.fleet is not None else None),
+                # Brownout's headroom gate: compaction waits for
+                # measured headroom instead of competing with overload
+                # traffic (explicit /admin/compact still overrides).
+                defer=(self.brownout.defer_background
+                       if self.brownout is not None else None),
             )
         else:
             self.compactor = None
+        # --autotune-interval-s re-tunes the batcher's max_wait_ms on a
+        # cadence from the what-if frontier over LIVE captured arrivals,
+        # applying a candidate only after captured-workload replay
+        # verifies bit-identity (knn_tpu/control/autotune.py). Needs the
+        # dispatch model (--cost-accounting) and the capture layer
+        # (--capture-dir); unset constructs NOTHING.
+        if autotune_interval_s is not None:
+            if self.workload is None or self.capacity is None:
+                raise DataError(
+                    "--autotune-interval-s tunes max_wait_ms from "
+                    "captured arrivals against the fitted dispatch "
+                    "model; boot with --capture-dir and "
+                    "--cost-accounting"
+                )
+            from knn_tpu.control.autotune import BatchAutotuner
+
+            self.autotune = BatchAutotuner(
+                self.batcher, self.capacity, self.workload,
+                interval_s=float(autotune_interval_s),
+            )
+        else:
+            self.autotune = None
         self._bootstrap_lock = threading.Lock()
         self.ready = False
         self.draining = False
@@ -805,6 +918,12 @@ class ServeApp:
 
     def close(self) -> None:
         self.ready = False
+        if self.autotune is not None:
+            # Before the batcher: a mid-cycle capture/replay must not
+            # race the worker teardown.
+            self.autotune.close()
+        if self.brownout is not None:
+            self.brownout.close()
         if self.compactor is not None:
             self.compactor.stop()
         self.batcher.close()
@@ -892,10 +1011,43 @@ class ServeApp:
             # "fleet: absent" state — for a plain single-process serve.
             "fleet": (self.fleet.export()
                       if self.fleet is not None else None),
+            # The overload control plane (knn_tpu/control/): admission
+            # shed tiers, brownout ladder level, autotune cycle history.
+            # None — the distinct "control: absent" state — while no
+            # control flag is set.
+            "control": self.control_block(),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
         return h
+
+    def control_block(self) -> "Optional[dict]":
+        """The control-plane summary for ``/healthz`` and
+        ``/debug/control``: admission (shed tiers, priority map, audit),
+        brownout (ladder level, applied steps, audit), autotune (cycle
+        outcomes, live max_wait_ms). None when no control layer exists —
+        never an empty dict that looks like a healthy controller."""
+        if (self.admission is None and self.brownout is None
+                and self.autotune is None):
+            return None
+        return {
+            "admission": (self.admission.export()
+                          if self.admission is not None else None),
+            "brownout": (self.brownout.export()
+                         if self.brownout is not None else None),
+            "autotune": (self.autotune.export()
+                         if self.autotune is not None else None),
+        }
+
+    def overload_retry_after_s(self) -> float:
+        """The Retry-After value for overload (429) and draining (503)
+        responses: headroom-derived with jitter when admission runs (the
+        deeper past the knee, the longer clients should back off), a
+        jittered ~1-2 s otherwise — never 0, so a thundering herd's
+        retries spread instead of re-arriving in lockstep."""
+        if self.admission is not None:
+            return self.admission.retry_after_s()
+        return 1.0 + random.random()
 
     def shard_block(self) -> "Optional[dict]":
         """The sharded-serving summary for ``/healthz`` and
@@ -977,13 +1129,20 @@ class _Handler(BaseHTTPRequestHandler):
         return True
 
     def _send(self, status: int, payload: dict,
-              content_type="application/json", tag_request_id=True):
+              content_type="application/json", tag_request_id=True,
+              retry_after: "Optional[float]" = None):
         rid = getattr(self, "_rid", None)
         if tag_request_id and rid is not None and "request_id" not in payload:
             payload = {**payload, "request_id": rid}
         body = (json.dumps(payload) + "\n").encode()
         self.send_response(status)
         self.send_header("Content-Type", content_type)
+        if retry_after is not None:
+            # Whole seconds (RFC 9110 delay-seconds), floor 1: the jitter
+            # already rode in on the float, and "Retry-After: 0" invites
+            # the herd right back.
+            self.send_header("Retry-After",
+                             str(max(1, int(round(retry_after)))))
         if rid is not None:
             self.send_header("x-request-id", rid)
         self.send_header("Content-Length", str(len(body)))
@@ -1065,6 +1224,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_capacity()
         elif route == "/debug/capture":
             self._do_capture_status()
+        elif route == "/debug/control":
+            self._do_control()
         elif route == "/debug/profile":
             self._do_profile()
         elif route == "/admin/wal-since":
@@ -1150,6 +1311,32 @@ class _Handler(BaseHTTPRequestHandler):
         payload = {"enabled": w is not None,
                    **(w.export() if w is not None else {}),
                    "index_version": self.app.index_version}
+        # No request_id stamped into a payload about OTHER requests (the
+        # /debug/requests rule; the response header still carries it).
+        self._send(200, payload, tag_request_id=False)
+
+    def _do_control(self):
+        """The overload-control status page: admission shed tiers +
+        audit, brownout ladder level + audit, autotune cycle history,
+        and the degradation-order contract the controllers enforce
+        (docs/RESILIENCE.md). Always 200 — disabled layers report
+        ``null`` rather than 404, so dashboards can hard-code the route
+        (the /debug/quality rule)."""
+        from knn_tpu.resilience.degrade import DEGRADATION_ORDER
+
+        app = self.app
+        block = app.control_block() or {
+            "admission": None, "brownout": None, "autotune": None}
+        payload = {
+            "enabled": {
+                "admission": app.admission is not None,
+                "brownout": app.brownout is not None,
+                "autotune": app.autotune is not None,
+            },
+            **block,
+            "degradation_order": list(DEGRADATION_ORDER),
+            "index_version": app.index_version,
+        }
         # No request_id stamped into a payload about OTHER requests (the
         # /debug/requests rule; the response header still carries it).
         self._send(200, payload, tag_request_id=False)
@@ -1360,7 +1547,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         except OverloadError as e:
             st = 503 if self.app.draining else 429
-            self._send(st, {"error": str(e)})
+            self._send(st, {"error": str(e)},
+                       retry_after=self.app.overload_retry_after_s())
             return
         except DeadlineExceededError as e:
             self._send(504, {"error": str(e)})
@@ -1437,7 +1625,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(409, {"error": str(e), "diverged": True})
             return
         except OverloadError as e:
-            self._send(503, {"error": str(e)})
+            self._send(503, {"error": str(e)},
+                       retry_after=self.app.overload_retry_after_s())
             return
         except (ValueError, TypeError) as e:
             self._send(400, {"error": f"bad wal-append body: {e}"})
@@ -1756,7 +1945,17 @@ class _Handler(BaseHTTPRequestHandler):
         trace's HTTP status annotation (+ finish, for requests the batcher
         never admitted), and the structured access-log line."""
         ms = (time.monotonic() - t0) * 1e3
-        if status != 400:
+        if outcome == "shed":
+            # A policy shed of a non-protected class spends NO
+            # objective's budget: it is counted in the SLO export's
+            # policy_sheds (the operator must see the volume) but
+            # excluded from every denominator — the availability-
+            # exclusion half of the shed-by-policy contract
+            # (docs/RESILIENCE.md §Degradation order). Protected
+            # classes are never shed by policy, so their overload 429s
+            # still arrive as "rejected" and still burn.
+            self.app.slo.record_shed()
+        elif status != 400:
             # degraded = not the rung a healthy request is expected to
             # ride: "fast" normally, "ivf" when approximate serving is on
             # (an ivf answer is the designed operating point there, and a
@@ -1886,10 +2085,17 @@ class _Handler(BaseHTTPRequestHandler):
         except OverloadError as e:
             # While draining, 503 (not 429): the load balancer should take
             # this replica out of rotation, not have the client retry here.
+            # A ShedByPolicy carries its own headroom-derived Retry-After
+            # and a distinct outcome: a deliberate shed of a
+            # non-protected class is the control plane working, not an
+            # availability incident (_account routes it to record_shed).
             st = 503 if self.app.draining else 429
-            self._send(st, {"error": str(e)})
-            self._account(kind, st, "rejected", t0, trace=trace, rows=rows,
-                          req_class=req_class)
+            shed = isinstance(e, ShedByPolicy)
+            self._send(st, {"error": str(e)},
+                       retry_after=(e.retry_after_s if shed else
+                                    self.app.overload_retry_after_s()))
+            self._account(kind, st, "shed" if shed else "rejected", t0,
+                          trace=trace, rows=rows, req_class=req_class)
             return
         except ValueError as e:  # shape/kind rejection
             self._send(400, {"error": str(e)})

@@ -184,6 +184,28 @@ def main() -> int:
             return fail("ServeApp built a workload capture layer with no "
                         "capture_dir — the layer must not exist while "
                         "disabled")
+        # Overload control plane (PR 19): the defaults (no --priority,
+        # --brownout off, no --autotune-interval-s) must construct
+        # NOTHING — no admission map, no brownout controller thread, no
+        # autotuner thread, no knn_control_* instruments; the whole
+        # knn_tpu.control package is a lazy import only the opted-in
+        # paths pull in.
+        if (app.admission is not None or app.brownout is not None
+                or app.autotune is not None):
+            return fail("ServeApp built overload-control machinery with "
+                        "no --priority/--brownout/--autotune-interval-s "
+                        "— the control plane must not exist while "
+                        "disabled")
+        if app.batcher.admission is not None:
+            return fail("the batcher holds an admission tap while "
+                        "disabled")
+        for mod in ("knn_tpu.control", "knn_tpu.control.admission",
+                    "knn_tpu.control.brownout", "knn_tpu.control.autotune",
+                    "knn_tpu.control.autoscale"):
+            if mod in sys.modules:
+                return fail(f"{mod} imported during flagless serving — "
+                            f"control-plane machinery must not construct "
+                            f"while disabled")
         if any("_merged_rung" in fn.__qualname__
                for _name, fn in app.batcher._rungs(app.batcher._model)):
             return fail("the serving ladder wrapped a rung with the "
@@ -229,7 +251,7 @@ def main() -> int:
     bad_threads = [t.name for t in threading.enumerate()
                    if t.name.startswith(("knn-quality", "knn-drift",
                                          "knn-compactor", "knn-workload",
-                                         "knn-fleet"))]
+                                         "knn-fleet", "knn-control"))]
     if bad_threads:
         return fail(f"quality/drift/compactor/workload worker thread(s) "
                     f"alive while disabled: {bad_threads}")
@@ -238,7 +260,8 @@ def main() -> int:
                                     "knn_cost_", "knn_capacity_",
                                     "knn_ivf_", "knn_mutable_",
                                     "knn_workload_", "knn_cache_",
-                                    "knn_fleet_", "knn_shard_"))]
+                                    "knn_fleet_", "knn_shard_",
+                                    "knn_control_"))]
     if leaked:
         return fail(f"quality/drift/cost/capacity/ivf/mutable/workload "
                     f"instrument(s) recorded while disabled: {leaked}")
@@ -319,6 +342,22 @@ def main() -> int:
         if boot_threads:
             return fail(f"bootstrap driver thread(s) alive on a "
                         f"flagless router: {boot_threads}")
+        # Fleet autoscaler (PR 19): no --scale-cmd must construct ZERO
+        # autoscale machinery — no policy, no offered-load ring, no
+        # control import, and the poll hook must bail immediately.
+        if router.autoscale is not None or router._offered is not None:
+            return fail("RouterApp built autoscale machinery with no "
+                        "--scale-cmd — the layer must not exist while "
+                        "disabled")
+        if "knn_tpu.control.autoscale" in sys.modules:
+            return fail("knn_tpu.control.autoscale imported on a "
+                        "flagless router")
+        router._maybe_autoscale()  # must be a no-op without the flag
+        scale_threads = [t.name for t in threading.enumerate()
+                         if t.name.startswith("knn-control-autoscale")]
+        if scale_threads:
+            return fail(f"autoscale driver thread(s) alive on a "
+                        f"flagless router: {scale_threads}")
     finally:
         router.close()
     leaked = [i.name for i in obs.registry().instruments()

@@ -1,0 +1,572 @@
+"""Overload-soak gate (`make overload-soak`): the control plane under fire —
+the degradation-order acceptance run (docs/RESILIENCE.md §Degradation order,
+docs/SERVING.md §Surviving an overload).
+
+Two phases prove the two halves of the overload story:
+
+**Phase 1 — one replica past its knee.** Boot `knn_tpu serve` with a
+priority map (``interactive=0,bulk=2``), the brownout ladder armed, and a
+deliberately tight queue bound, then hammer it with mixed-class closed-loop
+clients until the queue-full 429s burn the availability budget. The gate
+asserts the whole serve-side ladder engages IN ORDER and reverses:
+
+- ``bulk`` requests shed with the typed policy 429 (body names the
+  admission cutoff) while ``interactive`` is NEVER policy-shed — its only
+  429s are the queue-full backstop;
+- EVERY 429 carries an actionable ``Retry-After`` (>= 1 s);
+- the brownout ladder applies at least one reversible step during the
+  burst — and after the burst, under a light trickle, the cutoff restores
+  fully and every applied brownout step is reverted (apply count ==
+  revert count; level back to 0): the post-incident operating point is
+  exactly the configured one;
+- the SLO layer counted the policy sheds in ``policy_sheds`` — the
+  deliberate-shed ledger that availability burn excludes.
+
+**Phase 2 — the fleet grows before anyone sheds.** Boot two replicas plus
+a router with ``--scale-cmd`` pointing at a logging stub and a third
+registered-but-down replica slot. Under read load (with the hysteresis
+bands narrowed via env so the drill fits a CI window) the router must
+drive the operator's command ``up <slot-C-url>`` — the first rung of the
+degradation order — and audit ``scale-up-begin``/``-complete`` in the
+fleet event log; when the load stops, it must drive ``down`` against a
+non-primary live replica, never below ``--scale-min``.
+
+Exit 0 when every invariant holds; 1 with a diagnosis. stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import procgroup  # noqa: E402 — scripts-dir sibling (process-group
+# spawn + atexit kill sweep: a failed assertion can never strand a server)
+
+READY_RE = re.compile(r"ready on (http://[\d.]+:\d+)")
+BOOT_TIMEOUT_S = 120
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--short", action="store_true",
+                   help="CI preset: ~8 s overload burst")
+    p.add_argument("--window-s", type=float, default=None)
+    p.add_argument("--bulk-clients", type=int, default=6)
+    p.add_argument("--interactive-clients", type=int, default=2)
+    p.add_argument("--rows", type=int, default=16,
+                   help="rows per request (vs the tight queue bound)")
+    p.add_argument("--json-out", default=None, metavar="FILE")
+    args = p.parse_args()
+    if args.window_s is None:
+        args.window_s = 8.0 if args.short else 20.0
+    return args
+
+
+def fail(msg: str) -> int:
+    print(f"overload-soak: FAIL: {msg}", file=sys.stderr)
+    return 1  # procgroup's atexit sweep reaps every spawned group
+
+
+def free_ports(n: int) -> "list[int]":
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def http(base: str, path: str, payload=None, timeout=30, headers=None):
+    """Returns ``(status, body, response_headers)``."""
+    hdrs = {"Content-Type": "application/json"} if payload is not None else {}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers=hdrs,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+def spawn(cmd, env):
+    proc = procgroup.popen_group(
+        [sys.executable, "-m", "knn_tpu.cli", *cmd],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO,
+    )
+    import queue
+
+    lines: "queue.Queue[str]" = queue.Queue()
+    threading.Thread(
+        target=lambda: [lines.put(ln) for ln in proc.stdout], daemon=True,
+    ).start()
+    return proc, lines
+
+
+def wait_ready(proc, lines, what: str):
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=min(1.0, max(
+                0.01, deadline - time.monotonic())))
+        except Exception:  # noqa: BLE001 — queue.Empty
+            if proc.poll() is not None:
+                return None
+            continue
+        m = READY_RE.search(line)
+        if m:
+            print(f"overload-soak: {what}: {line.rstrip()}")
+            return m.group(1)
+    return None
+
+
+def wait_until(pred, timeout_s: float, every_s: float = 0.2):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            v = pred()
+        except Exception:  # noqa: BLE001 — target mid-transition
+            v = None
+        if v:
+            return v
+        time.sleep(every_s)
+    return None
+
+
+def control_doc(base) -> dict:
+    st, body, _h = http(base, "/debug/control", timeout=10)
+    if st != 200:
+        raise RuntimeError(f"/debug/control: status {st}: {body[:200]}")
+    return json.loads(body)
+
+
+class ClassStats:
+    """Per-class outcome ledger one client cohort fills under the lock."""
+
+    def __init__(self):
+        self.ok = 0
+        self.policy_shed = 0
+        self.other_429 = 0
+        self.missing_retry_after = 0
+        self.errors: "list[str]" = []
+
+
+def run_class_clients(base, rows, n_clients, cls, stop, stats, lock):
+    def loop(cid):
+        i = cid
+        while not stop.is_set():
+            lo = (7 * i) % max(1, len(rows) - len(rows) // 4)
+            i += 1
+            batch = rows[lo:lo + stats_rows].tolist()
+            try:
+                st, body, hdrs = http(base, "/predict",
+                                      {"instances": batch}, timeout=30,
+                                      headers={"x-knn-class": cls})
+            except Exception as e:  # noqa: BLE001 — recorded
+                with lock:
+                    stats.errors.append(f"{cls} client {cid}: {e}")
+                continue
+            with lock:
+                if st == 200:
+                    stats.ok += 1
+                elif st in (429, 503):
+                    try:
+                        retry = float(hdrs.get("Retry-After"))
+                    except (TypeError, ValueError):
+                        retry = None
+                    if retry is None or retry < 1:
+                        stats.missing_retry_after += 1
+                    if "shed by admission policy" in body:
+                        stats.policy_shed += 1
+                    else:
+                        stats.other_429 += 1
+                elif st == 500:
+                    stats.errors.append(f"{cls} client {cid}: 500: "
+                                        f"{body[:200]}")
+
+    threads = [threading.Thread(target=loop, args=(c,), daemon=True)
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+stats_rows = 16  # set from args in main() — rows per client request
+
+
+def phase1(args, index, test_rows, report) -> "int | None":
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        KNN_TPU_RETRY_BASE_MS="0",
+        # Fast control cadence so the hysteresis walks inside a CI
+        # window: evaluate every 50 ms, one tier/step per 300 ms.
+        KNN_TPU_CONTROL_EVAL_MS="50",
+        KNN_TPU_CONTROL_COOLDOWN_MS="300",
+    )
+    proc, lines = spawn(
+        ["serve", index, "--port", "0",
+         "--max-batch", "8", "--max-wait-ms", "1",
+         # Tight queue bound: the closed-loop cohort overflows it, the
+         # queue-full 429s burn availability, the burn engages the
+         # control plane. 5 s SLO window = fast engage AND fast release.
+         "--max-queue-rows", "48",
+         "--slo-windows", "5,60",
+         "--shadow-rate", "0.5", "--drift-rate", "0.2",
+         "--priority", "interactive=0,bulk=2",
+         "--brownout", "on"],
+        env)
+    base = wait_ready(proc, lines, "serve")
+    if base is None:
+        return fail(f"phase-1 serve: no ready banner (rc={proc.poll()})")
+
+    doc = control_doc(base)
+    if not (doc["enabled"]["admission"] and doc["enabled"]["brownout"]):
+        return fail(f"control plane not armed at boot: {doc['enabled']}")
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    bulk, inter = ClassStats(), ClassStats()
+    threads = run_class_clients(base, test_rows, args.bulk_clients,
+                                "bulk", stop, bulk, lock)
+    threads += run_class_clients(base, test_rows, args.interactive_clients,
+                                 "interactive", stop, inter, lock)
+    shed_tiers_max = 0
+    brownout_max = 0
+    t_end = time.monotonic() + args.window_s
+    while time.monotonic() < t_end:
+        try:
+            doc = control_doc(base)
+            shed_tiers_max = max(shed_tiers_max,
+                                 doc["admission"]["shed_tiers"])
+            brownout_max = max(brownout_max, doc["brownout"]["level"])
+        except Exception:  # noqa: BLE001 — keep polling under load
+            pass
+        time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=35)
+        if t.is_alive():
+            return fail("a phase-1 client thread hung")
+
+    if bulk.errors or inter.errors:
+        for v in (bulk.errors + inter.errors)[:10]:
+            print(f"overload-soak: VIOLATION: {v}", file=sys.stderr)
+        return fail(f"{len(bulk.errors) + len(inter.errors)} serving "
+                    f"violation(s) in phase 1")
+    if bulk.policy_shed == 0:
+        return fail(f"no bulk request was policy-shed across the burst "
+                    f"(bulk: {bulk.ok} ok, {bulk.other_429} backstop "
+                    f"429s; shed_tiers peak {shed_tiers_max}) — the "
+                    f"admission cutoff never engaged")
+    if inter.policy_shed > 0:
+        return fail(f"{inter.policy_shed} INTERACTIVE request(s) were "
+                    f"policy-shed — the protected tier must never shed "
+                    f"by policy")
+    missing = bulk.missing_retry_after + inter.missing_retry_after
+    if missing:
+        return fail(f"{missing} overload response(s) lacked an "
+                    f"actionable Retry-After (>= 1 s)")
+    if shed_tiers_max < 1:
+        return fail("admission shed_tiers never rose during the burst")
+    if brownout_max < 1:
+        return fail("the brownout ladder never applied a step during "
+                    "the burst")
+    print(f"overload-soak: phase 1 burst ok — bulk {bulk.ok} ok / "
+          f"{bulk.policy_shed} policy-shed / {bulk.other_429} backstop; "
+          f"interactive {inter.ok} ok / {inter.other_429} backstop / "
+          f"0 policy-shed; shed_tiers peak {shed_tiers_max}, brownout "
+          f"peak {brownout_max}")
+
+    # -- recovery: trickle load, everything must walk back -----------------
+    def trickle_and_check():
+        st, _b, _h = http(base, "/predict",
+                          {"instances": test_rows[:2].tolist()},
+                          timeout=10, headers={"x-knn-class": "interactive"})
+        doc = control_doc(base)
+        if (doc["admission"]["shed_tiers"] == 0
+                and doc["brownout"]["level"] == 0):
+            return doc
+        return None
+
+    doc = wait_until(trickle_and_check, timeout_s=40.0, every_s=0.1)
+    if doc is None:
+        last = control_doc(base)
+        return fail(f"control plane did not fully recover within 40 s: "
+                    f"shed_tiers={last['admission']['shed_tiers']}, "
+                    f"brownout level={last['brownout']['level']}")
+    adm, bro = doc["admission"], doc["brownout"]
+    if adm["moves"]["restore"] < 1:
+        return fail(f"cutoff reopened without a restore move: "
+                    f"{adm['moves']}")
+    if bro["moves"]["apply"] != bro["moves"]["revert"]:
+        return fail(f"brownout applied {bro['moves']['apply']} step(s) "
+                    f"but reverted {bro['moves']['revert']} — the "
+                    f"operating point did not return to configured")
+    if not any(e["action"] == "revert" for e in bro["audit"]):
+        return fail("no revert entry in the brownout audit ring")
+    if doc["degradation_order"] != ["scale", "shed_low_priority",
+                                    "brownout_quality", "availability"]:
+        return fail(f"degradation-order contract drifted: "
+                    f"{doc['degradation_order']}")
+    st, body, _h = http(base, "/healthz", timeout=10)
+    sheds_1m = json.loads(body)["slo"]["policy_sheds"]["1m"]
+    if sheds_1m < bulk.policy_shed:
+        return fail(f"SLO policy_sheds (1m: {sheds_1m}) undercounts the "
+                    f"{bulk.policy_shed} observed policy 429s")
+    print(f"overload-soak: phase 1 recovery ok — cutoff restored "
+          f"(moves {adm['moves']}), brownout reverted "
+          f"(moves {bro['moves']}), slo policy_sheds 1m={sheds_1m}")
+
+    proc.send_signal(signal.SIGINT)
+    try:
+        rc = proc.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        return fail("phase-1 server did not exit after SIGINT")
+    if rc != 0:
+        return fail(f"phase-1 server exited rc={rc} after SIGINT")
+
+    report["phase1"] = {
+        "window_s": args.window_s,
+        "bulk": {"ok": bulk.ok, "policy_shed": bulk.policy_shed,
+                 "backstop_429": bulk.other_429},
+        "interactive": {"ok": inter.ok, "policy_shed": 0,
+                        "backstop_429": inter.other_429},
+        "shed_tiers_peak": shed_tiers_max,
+        "brownout_level_peak": brownout_max,
+        "admission_moves": adm["moves"],
+        "brownout_moves": bro["moves"],
+        "slo_policy_sheds_1m": sheds_1m,
+    }
+    return None
+
+
+def phase2(args, index, test_rows, report, tmp) -> "int | None":
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KNN_TPU_RETRY_BASE_MS="0")
+    scale_log = os.path.join(tmp, "scale.log")
+    scale_sh = os.path.join(tmp, "scale.sh")
+    Path(scale_sh).write_text(
+        f"#!/bin/sh\necho \"$1 $2\" >> {scale_log}\n")
+    os.chmod(scale_sh, 0o755)
+
+    port_c = free_ports(1)[0]
+    url_c = f"http://127.0.0.1:{port_c}"
+    procs = []
+    urls = []
+    for name in ("a", "b"):
+        proc, lines = spawn(
+            ["serve", index, "--port", "0",
+             "--max-batch", "8", "--max-wait-ms", "1"], env)
+        base = wait_ready(proc, lines, f"replica-{name}")
+        if base is None:
+            return fail(f"phase-2 replica {name}: no ready banner "
+                        f"(rc={proc.poll()})")
+        procs.append(proc)
+        urls.append(base)
+
+    router_env = dict(
+        env,
+        # Narrow hysteresis bands so both directions of the drill fire
+        # inside a CI window: any sustained load is "past the knee",
+        # near-idle is "fits on fewer replicas".
+        KNN_TPU_SCALE_UP_FRACTION="0.02",
+        KNN_TPU_SCALE_DOWN_FRACTION="0.01",
+    )
+    router, rlines = spawn(
+        ["route", urls[0], urls[1], url_c, "--port", "0",
+         "--health-interval-s", "0.2",
+         "--scale-cmd", scale_sh, "--scale-min", "1", "--scale-max", "3",
+         "--scale-cooldown-s", "1",
+         "--event-log", os.path.join(tmp, "fleet-events.jsonl")],
+        router_env)
+    rbase = wait_ready(router, rlines, "router")
+    if rbase is None:
+        return fail(f"phase-2 router: no ready banner (rc={router.poll()})")
+
+    def two_usable():
+        _st, body, _h = http(rbase, "/healthz", timeout=5)
+        return json.loads(body)["usable"] == 2 or None
+
+    if wait_until(two_usable, 20.0) is None:
+        return fail("router never saw the 2 live replicas usable")
+
+    # -- load until the autoscaler boots the empty slot --------------------
+    stop = threading.Event()
+    errors: "list[str]" = []
+
+    def loop(cid):
+        i = cid
+        while not stop.is_set():
+            lo = (3 * i) % max(1, len(test_rows) - 4)
+            i += 1
+            try:
+                st, body, _h = http(rbase, "/predict",
+                                    {"instances": test_rows[lo:lo + 2]
+                                     .tolist()}, timeout=30)
+                if st == 500:
+                    errors.append(f"client {cid}: 500: {body[:200]}")
+            except Exception as e:  # noqa: BLE001 — recorded
+                errors.append(f"client {cid}: {e}")
+
+    clients = [threading.Thread(target=loop, args=(c,), daemon=True)
+               for c in range(4)]
+    for t in clients:
+        t.start()
+
+    def scaled_up():
+        if not os.path.exists(scale_log):
+            return None
+        return ("up " + url_c) in Path(scale_log).read_text() or None
+
+    up_ok = wait_until(scaled_up, timeout_s=45.0)
+    if up_ok is None:
+        _st, body, _h = http(rbase, "/healthz", timeout=5)
+        stop.set()
+        return fail(f"autoscaler never drove 'up {url_c}' under load; "
+                    f"autoscale={json.loads(body).get('autoscale')}")
+    _st, body, _h = http(rbase, "/healthz", timeout=5)
+    auto = json.loads(body)["autoscale"]
+    print(f"overload-soak: phase 2 scale-up ok — scale command drove "
+          f"the empty slot (offered {auto['offered_qps']} qps vs "
+          f"sustainable {auto['sustainable_qps']}, "
+          f"decisions {auto['decisions']})")
+
+    # -- idle until it drains one live, non-primary replica ----------------
+    stop.set()
+    for t in clients:
+        t.join(timeout=35)
+        if t.is_alive():
+            return fail("a phase-2 client thread hung")
+    if errors:
+        for v in errors[:10]:
+            print(f"overload-soak: VIOLATION: {v}", file=sys.stderr)
+        return fail(f"{len(errors)} routed-read violation(s) in phase 2")
+
+    def scaled_down():
+        text = Path(scale_log).read_text()
+        downs = [ln for ln in text.splitlines() if ln.startswith("down ")]
+        return downs or None
+
+    # The offered-load ring is a 30 s trailing window: the down decision
+    # fires once the burst has rolled out of it.
+    downs = wait_until(scaled_down, timeout_s=60.0, every_s=0.5)
+    if downs is None:
+        _st, body, _h = http(rbase, "/healthz", timeout=5)
+        return fail(f"autoscaler never drove a drain after the load "
+                    f"stopped; autoscale="
+                    f"{json.loads(body).get('autoscale')}")
+    down_targets = {ln.split(" ", 1)[1] for ln in downs}
+    if not down_targets <= set(urls):
+        return fail(f"drain targeted a non-live slot: {down_targets} "
+                    f"(live: {urls})")
+
+    # -- the audit trail ---------------------------------------------------
+    _st, body, _h = http(rbase, "/debug/events?n=200", timeout=10)
+    events = [e["event"] for e in json.loads(body)["events"]]
+    for want in ("scale-up-begin", "scale-up-complete",
+                 "scale-down-begin", "scale-down-complete"):
+        if want not in events:
+            return fail(f"fleet event log missing {want!r} "
+                        f"(saw: {sorted(set(events))})")
+    _st, body, _h = http(rbase, "/healthz", timeout=5)
+    auto = json.loads(body)["autoscale"]
+    if auto["scales"] < 2:
+        return fail(f"router counted {auto['scales']} scale op(s); "
+                    f"expected >= 2 (one up, one down)")
+
+    for proc in (router, *procs):
+        proc.send_signal(signal.SIGINT)
+    for what, proc in (("router", router), ("replica-a", procs[0]),
+                       ("replica-b", procs[1])):
+        try:
+            rc = proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            return fail(f"phase-2 {what} did not exit after SIGINT")
+        if rc != 0:
+            return fail(f"phase-2 {what} exited rc={rc} after SIGINT")
+
+    report["phase2"] = {
+        "scale_up_target": url_c,
+        "scale_down_targets": sorted(down_targets),
+        "decisions": auto["decisions"],
+        "scales": auto["scales"],
+        "offered_qps_at_up": auto["offered_qps"],
+    }
+    print(f"overload-soak: phase 2 scale-down ok — drained "
+          f"{sorted(down_targets)}, audit complete "
+          f"({auto['scales']} scale ops)")
+    return None
+
+
+def main() -> int:
+    args = parse_args()
+    global stats_rows
+    stats_rows = args.rows
+    from tests import fixtures  # noqa: E402 — repo-root import
+
+    d = fixtures.datasets_dir()
+    train_arff = str(d / "small-train.arff")
+    test_arff = str(d / "small-test.arff")
+
+    from knn_tpu.data.arff import load_arff
+
+    test_rows = load_arff(test_arff).features
+
+    with tempfile.TemporaryDirectory() as tmp:
+        index = os.path.join(tmp, "index")
+        build = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "save-index", train_arff,
+             index, "--k", "3"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, cwd=REPO,
+        )
+        if build.returncode != 0:
+            return fail(f"save-index rc={build.returncode}: {build.stderr}")
+        print(f"overload-soak: {build.stdout.strip()}")
+
+        report: dict = {"overload_soak": {
+            "window_s": args.window_s,
+            "bulk_clients": args.bulk_clients,
+            "interactive_clients": args.interactive_clients,
+            "rows_per_request": args.rows,
+        }}
+        rc = phase1(args, index, test_rows, report)
+        if rc is not None:
+            return rc
+        rc = phase2(args, index, test_rows, report, tmp)
+        if rc is not None:
+            return rc
+
+        out = json.dumps(report, indent=2)
+        print(out)
+        if args.json_out:
+            Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+            Path(args.json_out).write_text(out + "\n")
+        print("overload-soak: PASS")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

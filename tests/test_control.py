@@ -1,0 +1,536 @@
+"""Overload control plane contract tests (docs/RESILIENCE.md §Degradation
+order).
+
+The load-bearing claims: priority admission sheds the LOWEST-priority
+class first — and never the protected top tier — when headroom collapses;
+every shed is a typed :class:`ShedByPolicy` with an actionable,
+bounded ``Retry-After``; a policy shed never spends the availability
+budget; the brownout ladder applies steps in order under pressure and
+reverts every one of them (LIFO) on recovery, on a fake clock with zero
+sleeps; the batch autotuner refuses ANY candidate the replay pass cannot
+prove bit-identical, restoring the live window; and the autoscale policy
+is a pure hysteresis over (offered, sustainable, usable) that never acts
+without a fitted capacity model.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.control.admission import (
+    RETRY_AFTER_MAX_S,
+    RETRY_AFTER_MIN_S,
+    PriorityAdmission,
+    parse_priority_map,
+)
+from knn_tpu.control.autoscale import AutoscalePolicy, run_scale_cmd
+from knn_tpu.control.autotune import BatchAutotuner
+from knn_tpu.control.brownout import BrownoutController, BrownoutStep
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.obs.slo import SLOTracker
+from knn_tpu.resilience.degrade import DEGRADATION_ORDER
+from knn_tpu.resilience.errors import DataError, ShedByPolicy
+from knn_tpu.serve.batcher import MicroBatcher
+
+
+class FakeCapacity:
+    """A capacity tracker stub: exports exactly the fields the control
+    plane reads, with an operator-settable headroom."""
+
+    def __init__(self, headroom=None, dispatch_model=None):
+        self.headroom = headroom
+        self.dispatch_model = dispatch_model
+
+    def export(self):
+        return {"headroom_ratio": self.headroom,
+                "dispatch_model": self.dispatch_model}
+
+
+def fresh_admission(priority_map, capacity, **kw):
+    """An admission cutoff with the lazy-evaluation caches disabled so
+    every admit() re-reads the (fake) signal and may move immediately."""
+    kw.setdefault("eval_ms", 0.0)
+    kw.setdefault("cooldown_ms", 0.0)
+    return PriorityAdmission(priority_map, capacity=capacity, **kw)
+
+
+class TestParsePriorityMap:
+    def test_parses_classes_and_levels(self):
+        assert parse_priority_map("interactive=0,bulk=2") == {
+            "interactive": 0, "bulk": 2}
+
+    def test_whitespace_and_trailing_comma_tolerated(self):
+        assert parse_priority_map(" a=1 , b=0 ,") == {"a": 1, "b": 0}
+
+    @pytest.mark.parametrize("spec", [
+        "",                       # empty map
+        "interactive",            # no '='
+        "interactive=fast",       # non-integer priority
+        "interactive=-1",         # negative priority
+        "a=1,a=2",                # duplicate class
+        "BAD CLASS=1",            # label grammar violation
+    ])
+    def test_bad_specs_raise_with_context(self, spec):
+        with pytest.raises(ValueError):
+            parse_priority_map(spec)
+
+
+class TestPriorityShedOrdering:
+    def test_no_pressure_admits_everything(self):
+        adm = fresh_admission({"interactive": 0, "bulk": 2},
+                              FakeCapacity(headroom=3.0))
+        assert adm.admit("bulk") is None
+        assert adm.admit("interactive") is None
+        assert adm.export()["shed_tiers"] == 0
+
+    def test_negative_headroom_sheds_lowest_tier_first(self):
+        cap = FakeCapacity(headroom=0.4)  # offered 2.5x sustainable
+        # A long cooldown freezes the cutoff after its FIRST move, so
+        # the one-tier-at-a-time ordering is observable: bulk sheds,
+        # batch and interactive still admit.
+        adm = fresh_admission(
+            {"interactive": 0, "batch": 1, "bulk": 2}, cap,
+            cooldown_ms=3600_000.0)
+        shed = adm.admit("bulk")
+        assert isinstance(shed, ShedByPolicy)
+        assert shed.request_class == "bulk"
+        assert adm.admit("batch") is None
+        assert adm.admit("interactive") is None
+        assert adm.export()["shed_tiers"] == 1
+
+    def test_sustained_pressure_walks_to_the_protected_cap(self):
+        cap = FakeCapacity(headroom=0.4)
+        adm = fresh_admission(
+            {"interactive": 0, "batch": 1, "bulk": 2}, cap)
+        # Cooldown 0: every decision may walk a tier. Pressure that
+        # never lifts sheds batch too — but the top tier NEVER sheds
+        # by policy, however long pressure holds.
+        assert isinstance(adm.admit("bulk"), ShedByPolicy)
+        assert isinstance(adm.admit("batch"), ShedByPolicy)
+        for _ in range(8):
+            assert adm.admit("interactive") is None
+        assert adm.export()["shed_tiers"] == 2  # capped at len(levels)-1
+
+    def test_unmapped_class_defaults_to_protected(self):
+        adm = fresh_admission({"interactive": 0, "bulk": 2},
+                              FakeCapacity(headroom=0.2))
+        assert isinstance(adm.admit("bulk"), ShedByPolicy)
+        # Mapping interactive=0,bulk=2 says "everything else is
+        # important": an unmapped class (and None) rides the protected
+        # tier.
+        assert adm.admit("web") is None
+        assert adm.admit(None) is None
+        assert adm.protected("web") and not adm.protected("bulk")
+
+    def test_single_tier_map_never_sheds(self):
+        # One mapped level means one tier — the top tier, which policy
+        # never sheds: a priority map needs a sheddable tier to act.
+        adm = fresh_admission({"bulk": 2}, FakeCapacity(headroom=0.1))
+        for _ in range(4):
+            assert adm.admit("bulk") is None
+
+    def test_recovery_restores_tiers(self):
+        cap = FakeCapacity(headroom=0.4)
+        adm = fresh_admission({"interactive": 0, "bulk": 2}, cap)
+        assert isinstance(adm.admit("bulk"), ShedByPolicy)
+        cap.headroom = 2.0  # well past release_headroom
+        assert adm.admit("bulk") is None
+        moves = adm.export()["moves"]
+        assert moves == {"shed": 1, "restore": 1}
+
+    def test_cooldown_freezes_the_cutoff(self):
+        adm = fresh_admission({"interactive": 0, "batch": 1, "bulk": 2},
+                              FakeCapacity(headroom=0.1),
+                              cooldown_ms=3600_000.0)
+        assert isinstance(adm.admit("bulk"), ShedByPolicy)
+        # A second tier would need another move, frozen for an hour.
+        assert adm.admit("batch") is None
+
+    def test_audit_and_export_describe_the_cutoff(self):
+        adm = fresh_admission({"interactive": 0, "bulk": 2},
+                              FakeCapacity(headroom=0.5))
+        adm.admit("bulk")
+        ex = adm.export()
+        assert ex["cutoff_priority"] == 2
+        assert ex["protected_priority"] == 0
+        assert ex["audit"][-1]["action"] == "shed"
+        assert ex["audit"][-1]["headroom_ratio"] == 0.5
+
+    def test_retry_after_is_bounded_and_headroom_priced(self):
+        adm = fresh_admission({"interactive": 0, "bulk": 2},
+                              FakeCapacity(headroom=0.1))
+        adm.admit("bulk")
+        for _ in range(32):
+            assert (RETRY_AFTER_MIN_S <= adm.retry_after_s()
+                    <= RETRY_AFTER_MAX_S)
+        shed = adm.admit("bulk")
+        assert RETRY_AFTER_MIN_S <= shed.retry_after_s <= RETRY_AFTER_MAX_S
+
+    def test_no_signals_means_fully_open_forever(self):
+        adm = fresh_admission({"interactive": 0, "bulk": 2}, None)
+        for _ in range(4):
+            assert adm.admit("bulk") is None
+
+
+class TestBatcherShedIntegration:
+    @pytest.fixture
+    def model(self):
+        rng = np.random.default_rng(7)
+        x = rng.normal(0, 1, (64, 4)).astype(np.float32)
+        y = rng.integers(0, 3, 64).astype(np.int32)
+        return KNNClassifier(k=3).fit(Dataset(x, y))
+
+    def test_shed_is_typed_and_ordered(self, model):
+        cap = FakeCapacity(headroom=0.3)
+        adm = fresh_admission({"interactive": 0, "bulk": 2}, cap)
+        with MicroBatcher(model, max_batch=8, max_wait_ms=0.0,
+                          admission=adm) as b:
+            q = np.zeros(4, np.float32)
+            with pytest.raises(ShedByPolicy) as ei:
+                b.submit(q, "predict", request_class="bulk")
+            assert ei.value.retry_after_s >= RETRY_AFTER_MIN_S
+            # The protected class still serves THROUGH the same batcher.
+            r = b.submit(q, "predict", request_class="interactive")
+            assert r.result(timeout=30) is not None
+            # Recovery reopens the shed tier end to end.
+            cap.headroom = 2.0
+            r = b.submit(q, "predict", request_class="bulk")
+            assert r.result(timeout=30) is not None
+
+
+class TestBrownoutLadder:
+    def make(self, cap, clock, **kw):
+        calls = []
+        steps = [
+            BrownoutStep("shadow_rate",
+                         lambda: calls.append("shadow-"),
+                         lambda: calls.append("shadow+")),
+            BrownoutStep("probes",
+                         lambda: calls.append("probes-"),
+                         lambda: calls.append("probes+")),
+        ]
+        kw.setdefault("cooldown_ms", 1000.0)
+        ctl = BrownoutController(steps, capacity=cap, autostart=False,
+                                 clock=lambda: clock[0], **kw)
+        return ctl, calls
+
+    def test_applies_in_order_and_reverts_lifo(self):
+        cap = FakeCapacity(headroom=0.5)
+        clock = [0.0]
+        ctl, calls = self.make(cap, clock)
+        ctl.tick()
+        assert calls == ["shadow-"] and ctl.level == 1
+        clock[0] += 2.0  # past cooldown; pressure persists
+        ctl.tick()
+        assert calls == ["shadow-", "probes-"] and ctl.level == 2
+        # Recovery reverts the LAST-applied step first.
+        cap.headroom = 2.0
+        clock[0] += 2.0
+        ctl.tick()
+        assert calls[-1] == "probes+" and ctl.level == 1
+        clock[0] += 2.0
+        ctl.tick()
+        assert calls[-1] == "shadow+" and ctl.level == 0
+        assert ctl.moves == {"apply": 2, "revert": 2}
+        assert ctl.export()["applied"] == []
+
+    def test_cooldown_bounds_walk_rate(self):
+        cap = FakeCapacity(headroom=0.5)
+        clock = [0.0]
+        ctl, calls = self.make(cap, clock)
+        ctl.tick()
+        ctl.tick()  # same instant: frozen
+        clock[0] += 0.5  # still inside the 1s cooldown
+        ctl.tick()
+        assert calls == ["shadow-"] and ctl.level == 1
+
+    def test_failed_knob_is_audited_and_does_not_kill_the_walk(self):
+        cap = FakeCapacity(headroom=0.5)
+        clock = [0.0]
+        boom = BrownoutStep("boom",
+                            lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                            lambda: None)
+        ok_calls = []
+        ctl = BrownoutController(
+            [boom, BrownoutStep("ok", lambda: ok_calls.append("-"),
+                                lambda: ok_calls.append("+"))],
+            capacity=cap, autostart=False, cooldown_ms=1000.0,
+            clock=lambda: clock[0])
+        ctl.tick()
+        assert ctl.export()["audit"][-1]["action"] == "apply-failed"
+        clock[0] += 2.0
+        ctl.tick()
+        assert ok_calls == ["-"] and ctl.level == 2
+
+    def test_defer_background_tracks_negative_headroom(self):
+        cap = FakeCapacity(headroom=0.8)
+        clock = [0.0]
+        ctl, _calls = self.make(cap, clock)
+        assert not ctl.defer_background()  # no signal read yet
+        ctl.tick()
+        assert ctl.defer_background()
+        cap.headroom = 1.5
+        clock[0] += 2.0
+        ctl.tick()
+        assert not ctl.defer_background()
+
+    def test_no_signal_rests_fully_healthy(self):
+        clock = [0.0]
+        ctl, calls = self.make(None, clock)
+        for _ in range(3):
+            ctl.tick()
+            clock[0] += 2.0
+        assert calls == [] and ctl.level == 0
+
+
+class FakeWorkloadCapture:
+    """The three calls the autotuner makes against the capture layer."""
+
+    def start(self, reason=None, window_s=None):
+        pass
+
+    def stop(self):
+        return {"path": "fake-window"}
+
+
+class FakeWorkload:
+    def __init__(self, n=64, spacing_ms=4.0):
+        self._arrivals = [(i * spacing_ms, 1) for i in range(n)]
+
+    def arrivals(self):
+        return list(self._arrivals)
+
+
+class FakeTunableBatcher:
+    max_batch = 8
+    buckets = None
+
+    def __init__(self, max_wait_ms=4.0):
+        self.max_wait_ms = max_wait_ms
+
+
+@pytest.fixture
+def tuner_parts(monkeypatch):
+    import knn_tpu.obs.workload as workload_mod
+
+    monkeypatch.setattr(workload_mod, "load_workload",
+                        lambda path: FakeWorkload())
+    batcher = FakeTunableBatcher(max_wait_ms=4.0)
+    cap = FakeCapacity(dispatch_model={"a_ms": 1.0, "b_ms_per_row": 0.05})
+
+    def make(replay_fn):
+        t = BatchAutotuner(batcher, cap, FakeWorkloadCapture(),
+                           interval_s=30.0, replay_fn=replay_fn,
+                           autostart=False)
+        t._stop.set()  # capture window returns instantly in tests
+        return t
+
+    return batcher, make
+
+
+class TestAutotuneReplayGate:
+    def test_refuses_divergent_replay_and_restores(self, tuner_parts):
+        batcher, make = tuner_parts
+        applied = []
+
+        def replay(wl, batcher=None, speed=None, replay_mutations=None):
+            applied.append(batcher.max_wait_ms)
+            return {"verify": {"divergences": 3, "verified": 61}}
+
+        entry = make(replay).run_cycle()
+        assert entry["outcome"] == "refused"
+        assert entry["replay_divergences"] == 3
+        # The candidate WAS live during verification…
+        assert applied and applied[0] != 4.0
+        # …and was rolled back the moment replay disproved it.
+        assert batcher.max_wait_ms == 4.0
+
+    def test_refuses_unverifiable_replay_and_restores(self, tuner_parts):
+        batcher, make = tuner_parts
+
+        def replay(wl, **kw):
+            raise RuntimeError("replay harness fell over")
+
+        entry = make(replay).run_cycle()
+        assert entry["outcome"] == "refused"
+        assert "replay harness" in entry["error"]
+        assert batcher.max_wait_ms == 4.0
+
+    def test_applies_only_a_proven_candidate(self, tuner_parts):
+        batcher, make = tuner_parts
+
+        def replay(wl, **kw):
+            return {"verify": {"divergences": 0, "verified": 64}}
+
+        t = make(replay)
+        entry = t.run_cycle()
+        assert entry["outcome"] == "applied"
+        assert batcher.max_wait_ms == entry["candidate_max_wait_ms"] != 4.0
+        assert t.export()["outcomes"]["applied"] == 1
+
+    def test_skips_thin_captures(self, tuner_parts, monkeypatch):
+        import knn_tpu.obs.workload as workload_mod
+
+        monkeypatch.setattr(workload_mod, "load_workload",
+                            lambda path: FakeWorkload(n=5))
+        batcher, make = tuner_parts
+        entry = make(lambda wl, **kw: None).run_cycle()
+        assert entry["outcome"] == "skipped"
+        assert entry["reason"] == "too_few_requests"
+        assert batcher.max_wait_ms == 4.0
+
+
+class TestAutoscalePolicy:
+    def make(self, clock, **kw):
+        kw.setdefault("cooldown_s", 10.0)
+        return AutoscalePolicy(1, 4, clock=lambda: clock[0], **kw)
+
+    def test_no_model_no_action(self):
+        clock = [100.0]
+        pol = self.make(clock)
+        assert pol.decide(1000.0, None, 2) is None
+        assert pol.decide(1000.0, 0.0, 2) is None
+
+    def test_up_past_the_up_fraction(self):
+        clock = [100.0]
+        pol = self.make(clock)
+        assert pol.decide(79.0, 100.0, 2) is None
+        assert pol.decide(81.0, 100.0, 2) == "up"
+
+    def test_never_up_past_scale_max(self):
+        clock = [100.0]
+        pol = self.make(clock)
+        assert pol.decide(999.0, 100.0, 4) is None
+
+    def test_down_only_when_remaining_fleet_fits_it(self):
+        clock = [100.0]
+        pol = self.make(clock)
+        # 3 replicas at ~33 qps each; offered 10 < 0.4 * 66 remaining.
+        assert pol.decide(10.0, 100.0, 3) == "down"
+        clock[0] += 20.0
+        # Offered 30 does NOT fit under 0.4 * 66: hold.
+        assert pol.decide(30.0, 100.0, 3) is None
+
+    def test_never_down_below_scale_min(self):
+        clock = [100.0]
+        pol = self.make(clock)
+        assert pol.decide(0.0, 50.0, 1) is None
+
+    def test_cooldown_separates_any_two_actions(self):
+        clock = [100.0]
+        pol = self.make(clock)
+        assert pol.decide(81.0, 100.0, 2) == "up"
+        assert pol.decide(81.0, 100.0, 2) is None  # frozen
+        clock[0] += 11.0
+        assert pol.decide(81.0, 100.0, 2) == "up"
+        assert pol.decisions == {"up": 2, "down": 0}
+
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(0, 2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(3, 2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(1, 2, up_fraction=0.3, down_fraction=0.5)
+
+    def test_run_scale_cmd_passes_direction_and_url(self, tmp_path):
+        out = tmp_path / "scale.log"
+        script = tmp_path / "scale.sh"
+        script.write_text(f"#!/bin/sh\necho \"$1 $2\" >> {out}\n")
+        script.chmod(0o755)
+        run_scale_cmd(str(script), "up", "http://r3:8000", timeout_s=30)
+        assert out.read_text().strip() == "up http://r3:8000"
+
+    def test_run_scale_cmd_raises_on_failure(self):
+        import subprocess
+
+        with pytest.raises(subprocess.CalledProcessError):
+            run_scale_cmd("false", "down", "http://r1:8000", timeout_s=30)
+
+
+class TestShedSLOExclusion:
+    def test_policy_sheds_spend_no_availability_budget(self):
+        slo = SLOTracker(windows_s=(60,))
+        for _ in range(20):
+            slo.record(ok=True, latency_ms=1.0)
+        for _ in range(50):
+            slo.record_shed()
+        burns = slo.burn_rates()
+        assert burns["availability"]["1m"] == 0.0
+        ex = slo.export()
+        assert ex["policy_sheds"]["1m"] == 50
+
+    def test_protected_429s_still_burn(self):
+        # The contrast case: a non-shed overload rejection IS recorded
+        # as a failed request and burns availability.
+        slo = SLOTracker(windows_s=(60,))
+        for _ in range(10):
+            slo.record(ok=False, latency_ms=1.0)
+        assert slo.burn_rates()["availability"]["1m"] > 0.0
+
+
+class TestDegradationOrderContract:
+    def test_order_is_scale_shed_brownout_availability(self):
+        assert DEGRADATION_ORDER == (
+            "scale", "shed_low_priority", "brownout_quality",
+            "availability")
+
+
+class TestServeAppWiring:
+    @pytest.fixture
+    def model(self):
+        rng = np.random.default_rng(11)
+        x = rng.normal(0, 1, (64, 4)).astype(np.float32)
+        y = rng.integers(0, 3, 64).astype(np.int32)
+        return KNNClassifier(k=3).fit(Dataset(x, y))
+
+    def test_priority_requires_cost_accounting(self, model):
+        from knn_tpu.serve.server import ServeApp
+
+        with pytest.raises(DataError, match="cost-accounting"):
+            ServeApp(model, max_batch=8, max_wait_ms=0.0,
+                     priority_map={"bulk": 2})
+
+    def test_brownout_requires_a_knob(self, model):
+        from knn_tpu.serve.server import ServeApp
+
+        # Flagless serve has no reversible knob wired: shadow/drift off,
+        # no ivf policy, no deadline — --brownout must refuse, not spin
+        # an empty ladder.
+        with pytest.raises(DataError, match="reversible knob"):
+            ServeApp(model, max_batch=8, max_wait_ms=0.0, brownout=True)
+
+    def test_autotune_requires_capture_and_accounting(self, model):
+        from knn_tpu.serve.server import ServeApp
+
+        with pytest.raises(DataError):
+            ServeApp(model, max_batch=8, max_wait_ms=0.0,
+                     autotune_interval_s=30.0)
+
+    def test_control_block_and_threads_wired_when_flagged(self, model,
+                                                          tmp_path):
+        from knn_tpu.serve.server import ServeApp
+
+        app = ServeApp(model, max_batch=8, max_wait_ms=0.0,
+                       cost_accounting=True, shadow_rate=0.1,
+                       capture_dir=str(tmp_path),
+                       priority_map={"interactive": 0, "bulk": 2},
+                       brownout=True, autotune_interval_s=3600.0)
+        try:
+            block = app.control_block()
+            assert block["admission"]["priority_map"] == {
+                "interactive": 0, "bulk": 2}
+            assert "shadow_rate" in block["brownout"]["steps"]
+            assert block["autotune"]["interval_s"] == 3600.0
+            names = {t.name for t in threading.enumerate()}
+            assert "knn-control-brownout" in names
+            assert "knn-control-autotune" in names
+            assert app.batcher.admission is app.admission
+        finally:
+            app.close()
+        alive = {t.name for t in threading.enumerate()
+                 if t.is_alive() and t.name.startswith("knn-control")}
+        assert not alive
