@@ -1722,6 +1722,18 @@ def bench_gate_config(serving_trials=3, predict_reps=7):
             "ivf_device_gdist_s": {"trials": ivf_dev_gdist,
                                    "direction": "higher",
                                    "unit": "Gdist/s"},
+            # PR 20 arms the exact-scan efficiency column (ROADMAP item
+            # 4's cheap first move): the full-bench Gdist/s convention
+            # (candidate rows x d / wall) over the SAME kneighbors walls
+            # gated above — the exact path scans every train row per
+            # test row.
+            "device_gdist_s": {
+                "trials": [
+                    round(test.num_instances * train.num_instances
+                          * d_feat / (w / 1e3) / 1e9, 6)
+                    for w in kn_trials
+                ],
+                "direction": "higher", "unit": "Gdist/s"},
         },
     }
 

@@ -55,7 +55,8 @@ EXIT_RUNTIME = 1
 
 # Subcommands (`classify` is implied when argv starts with anything else,
 # keeping the reference's positional invocation byte-compatible).
-_SUBCOMMANDS = ("classify", "serve", "save-index", "replay", "route")
+_SUBCOMMANDS = ("classify", "serve", "save-index", "replay", "route",
+                "history", "report")
 
 # persona -> (default backend, usage string modeled on the reference's)
 _PERSONAS = {
@@ -81,7 +82,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = p.add_subparsers(dest="command",
                            metavar="{classify,serve,save-index,replay,"
-                                   "route}")
+                                   "route,history,report}")
     _add_classify_args(sub.add_parser(
         "classify",
         help="one-shot classify (default; bare positional argv implies it)",
@@ -127,7 +128,60 @@ def build_parser() -> argparse.ArgumentParser:
                     "emit a verdict JSON (p50/p99/QPS, divergence "
                     "counts, captured-vs-replayed comparison).",
     ))
+    _add_history_cmd_args(sub.add_parser(
+        "history",
+        help="query a durable metrics-history directory post-mortem "
+             "(docs/OBSERVABILITY.md §History & alerting)",
+        description="Decode the segment ring a serve/route process wrote "
+                    "under --history-dir — the process may be long dead; "
+                    "a torn final segment (crash mid-append) is repaired, "
+                    "corruption anywhere else refused typed — and print "
+                    "the selected series.",
+    ))
+    _add_report_args(sub.add_parser(
+        "report",
+        help="stitch history, alerts, captures, and logs into one "
+             "incident report (docs/SERVING.md runbook)",
+        description="Build a deterministic markdown+JSON incident report "
+                    "from a --history-dir: metrics history, alert "
+                    "fire/resolve pairs and action outcomes, alert-armed "
+                    "workload captures, frozen slowest-K forensics, and "
+                    "access-log errors on ONE merged timeline.",
+    ))
     return p
+
+
+def _add_history_cmd_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("dir", help="the --history-dir a serve/route wrote")
+    p.add_argument("--metric", default=None, metavar="NAME",
+                   help="filter to one instrument (default: all)")
+    p.add_argument("--label", action="append", default=[], metavar="K=V",
+                   help="label subset filter (repeatable)")
+    p.add_argument("--window", default=None, metavar="W",
+                   help="trailing window back from the newest snapshot "
+                   "(e.g. 300, 300s, 5m, 1h; default: everything)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full query document as JSON instead "
+                   "of the human summary")
+
+
+def _add_report_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--history", required=True, metavar="DIR",
+                   help="the --history-dir the incident's process wrote")
+    p.add_argument("--window", default=None, metavar="W",
+                   help="trailing window back from the newest artifact "
+                   "timestamp (e.g. 15m, 1h; default: everything)")
+    p.add_argument("--access-log", default=None, metavar="FILE",
+                   help="the serve/route --access-log file; its error "
+                   "lines join the timeline")
+    p.add_argument("--captures", default=None, metavar="DIR",
+                   help="the serve --capture-dir; workload manifests "
+                   "(alert-armed ones included) join the timeline")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="write the markdown report to FILE (default: "
+                   "stdout)")
+    p.add_argument("--json-out", default=None, metavar="FILE",
+                   help="also write the JSON document to FILE")
 
 
 def _add_route_args(p: argparse.ArgumentParser) -> None:
@@ -205,6 +259,7 @@ def _add_route_args(p: argparse.ArgumentParser) -> None:
                    help="freeze between autoscale actions (a booted "
                    "replica needs time to bootstrap, warm, and show up "
                    "in the capacity sum before the next decision)")
+    _add_history_args(p)
 
 
 def _add_replay_args(p: argparse.ArgumentParser) -> None:
@@ -512,6 +567,37 @@ def _add_serve_args(p: argparse.ArgumentParser) -> None:
                    "answers (refusals audited). Needs --capture-dir and "
                    "--cost-accounting on. Unset (default): max_wait_ms "
                    "stays the operator's static setting")
+    _add_history_args(p)
+
+
+def _add_history_args(p: argparse.ArgumentParser) -> None:
+    """The history/alerting flags serve and route share
+    (docs/OBSERVABILITY.md §History & alerting)."""
+    p.add_argument("--history-dir", default=None, metavar="DIR",
+                   help="durable metrics history (knn_tpu/obs/history.py)"
+                   ": append delta-encoded registry snapshots to an "
+                   "on-disk segment ring under DIR, queryable live at "
+                   "GET /debug/history and post-mortem via `knn_tpu "
+                   "history DIR` — the record survives the process. "
+                   "Unset (default): zero history machinery")
+    p.add_argument("--history-interval-s", type=float, default=5.0,
+                   metavar="S",
+                   help="snapshot cadence for --history-dir (and the "
+                   "alert-rule evaluation cadence); default 5")
+    p.add_argument("--history-retention-s", type=float, default=3600.0,
+                   metavar="S",
+                   help="on-disk retention: whole segments older than "
+                   "this are pruned (default 3600)")
+    p.add_argument("--alert-rules", default=None, metavar="RULES.json",
+                   help="declarative alerting (knn_tpu/obs/alerts.py): "
+                   "threshold / burn-rate / absence / derivative rules "
+                   "with for: durations and hysteretic fire->resolve, "
+                   "evaluated each --history-interval-s; transitions "
+                   "land in alerts.jsonl under --history-dir, "
+                   "knn_alerts_firing{alert}, and GET /debug/alerts; "
+                   "optional actions arm a workload capture, grab a "
+                   "device profile, or run an audited operator command. "
+                   "Unset (default): zero alerting machinery")
 
 
 def _add_save_index_args(p: argparse.ArgumentParser) -> None:
@@ -817,7 +903,107 @@ def _run(argv: Optional[Sequence[str]], stdout) -> int:
         return _run_replay(args, stdout)
     if args.command == "route":
         return _run_route(args, stdout)
+    if args.command == "history":
+        return _run_history(args, stdout)
+    if args.command == "report":
+        return _run_report(args, stdout)
     return _run_classify(args, stdout)
+
+
+def _run_history(args, stdout) -> int:
+    """``knn_tpu history DIR``: the post-mortem contract — decode a dead
+    (possibly SIGKILLed) process's segment ring, repairing a torn final
+    segment exactly like the mutable WAL tail, and answer a range query.
+    Unreadable/corrupt history and bad filters exit 2."""
+    import json
+
+    from knn_tpu.obs.history import load_history, parse_window
+    from knn_tpu.resilience.errors import DataError
+
+    labels = {}
+    for item in args.label:
+        k, sep, v = item.partition("=")
+        if not sep or not k:
+            print(f"error: --label {item!r}: want K=V", file=sys.stderr)
+            return EXIT_USAGE
+        labels[k] = v
+    window_s = None
+    if args.window is not None:
+        try:
+            window_s = parse_window(args.window)
+        except ValueError as e:
+            print(f"error: --window: {e}", file=sys.stderr)
+            return EXIT_USAGE
+    try:
+        hist = load_history(args.dir)
+    except (DataError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    doc = hist.query(metric=args.metric, labels=labels, window_s=window_s)
+    doc["segments"] = len(hist.segments)
+    doc["samples"] = len(hist.samples)
+    doc["repaired_torn_tail"] = hist.repaired
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True), file=stdout)
+        return 0
+    w = doc["window"]
+    print(f"knn-tpu history: {args.dir}: {doc['samples']} snapshot(s) in "
+          f"{doc['segments']} segment(s), window {w['from']}..{w['to']}"
+          + (" (torn tail repaired)" if hist.repaired else ""),
+          file=stdout)
+    for s in doc["series"]:
+        labels_txt = ",".join(f"{k}={v}"
+                              for k, v in sorted(s["labels"].items()))
+        pts = s["points"]
+        if not pts:
+            continue
+        first, last = pts[0], pts[-1]
+        print(f"  {s['name']}{{{labels_txt}}} [{s['kind']}] "
+              f"{len(pts)} point(s): {first[1]} @ {first[0]} -> "
+              f"{last[1]} @ {last[0]}", file=stdout)
+    if not doc["series"]:
+        print("  (no matching series)", file=stdout)
+    return 0
+
+
+def _run_report(args, stdout) -> int:
+    """``knn_tpu report --history DIR``: one-command incident report.
+    Missing/corrupt inputs exit 2; generation is deterministic (every
+    timestamp comes from the artifacts)."""
+    import json
+
+    from knn_tpu.obs.history import parse_window
+    from knn_tpu.obs.report import build_report, render_markdown
+    from knn_tpu.resilience.errors import DataError
+
+    window_s = None
+    if args.window is not None:
+        try:
+            window_s = parse_window(args.window)
+        except ValueError as e:
+            print(f"error: --window: {e}", file=sys.stderr)
+            return EXIT_USAGE
+    try:
+        doc = build_report(args.history, window=window_s,
+                           access_log=args.access_log,
+                           captures=args.captures)
+    except (DataError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    md = render_markdown(doc)
+    if args.json_out is not None:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if args.out is not None:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(md)
+        print(f"knn-tpu report: wrote {args.out}"
+              + (f" and {args.json_out}" if args.json_out else ""),
+              file=stdout)
+    else:
+        print(md, file=stdout)
+    return 0
 
 
 def _run_save_index(args, stdout) -> int:
@@ -898,6 +1084,48 @@ def _run_save_index(args, stdout) -> int:
         file=stdout,
     )
     return 0
+
+
+def _history_flag_rows(args):
+    """The serve/route-shared validation rows for the history/alerting
+    flags (each a ``(bad, msg)`` pair for the exit-2 tables)."""
+    return (
+        (args.history_interval_s <= 0,
+         f"--history-interval-s must be > 0, got "
+         f"{args.history_interval_s}"),
+        (args.history_retention_s < args.history_interval_s,
+         f"--history-retention-s ({args.history_retention_s}) must be >= "
+         f"--history-interval-s ({args.history_interval_s})"),
+    )
+
+
+def _load_alert_rules(args):
+    """Parse ``--alert-rules`` (None when unset). Returns
+    ``(rules_or_None, error_or_None)`` — every failure is a pre-boot
+    usage error (exit 2), including actions whose machinery the other
+    flags did not enable."""
+    if args.alert_rules is None:
+        return None, None
+    from knn_tpu.obs.alerts import load_rules
+    from knn_tpu.resilience.errors import DataError
+
+    try:
+        rules = load_rules(args.alert_rules)
+    except DataError as e:
+        return None, str(e)
+    if args.history_dir is None and any(
+            a["do"] == "profile" for r in rules for a in r["actions"]):
+        return None, ("--alert-rules: profile actions write under "
+                      "--history-dir; set it")
+    if getattr(args, "capture_dir", None) is None and any(
+            a["do"] == "capture" for r in rules for a in r["actions"]):
+        return None, ("--alert-rules: capture actions arm the workload "
+                      "recorder; set --capture-dir"
+                      if hasattr(args, "capture_dir") else
+                      "--alert-rules: capture actions need a serve "
+                      "process with --capture-dir (routers have no "
+                      "workload recorder)")
+    return rules, None
 
 
 def _run_serve(args, stdout) -> int:
@@ -1004,10 +1232,15 @@ def _run_serve(args, stdout) -> int:
          "--autotune-interval-s tunes from captured arrivals against "
          "the fitted dispatch model; it needs --capture-dir and "
          "--cost-accounting on"),
+        *_history_flag_rows(args),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
             return EXIT_USAGE
+    alert_rules, err = _load_alert_rules(args)
+    if err is not None:
+        print(f"error: {err}", file=sys.stderr)
+        return EXIT_USAGE
     priority_map = None
     if args.priority is not None:
         from knn_tpu.control.admission import parse_priority_map
@@ -1225,6 +1458,10 @@ def _run_serve(args, stdout) -> int:
             priority_map=priority_map,
             brownout=(args.brownout == "on"),
             autotune_interval_s=args.autotune_interval_s,
+            history_dir=args.history_dir,
+            history_interval_s=args.history_interval_s,
+            history_retention_s=args.history_retention_s,
+            alert_rules=alert_rules,
         )
     except OSError as e:  # an unwritable --access-log / --capture-dir path
         print(f"error: {e}", file=sys.stderr)
@@ -1339,10 +1576,15 @@ def _run_route(args, stdout) -> int:
          and (args.scale_min != 1 or args.scale_max is not None),
          "--scale-min/--scale-max bound the autoscaler; they need "
          "--scale-cmd"),
+        *_history_flag_rows(args),
     ):
         if bad:
             print(f"error: {msg}", file=sys.stderr)
             return EXIT_USAGE
+    alert_rules, rules_err = _load_alert_rules(args)
+    if rules_err is not None:
+        print(f"error: {rules_err}", file=sys.stderr)
+        return EXIT_USAGE
     for spec in args.replicas:
         members = [u for u in spec.split("+") if u]
         if not members:
@@ -1358,6 +1600,7 @@ def _run_route(args, stdout) -> int:
         make_router_server,
         router_forever,
     )
+    from knn_tpu.resilience.errors import DataError
 
     # The /metrics endpoint is the router's observability artifact
     # (the serve rule).
@@ -1380,11 +1623,18 @@ def _run_route(args, stdout) -> int:
             scale_min=args.scale_min,
             scale_max=args.scale_max,
             scale_cooldown_s=args.scale_cooldown_s,
+            history_dir=args.history_dir,
+            history_interval_s=args.history_interval_s,
+            history_retention_s=args.history_retention_s,
+            alert_rules=alert_rules,
         )
     except ValueError as e:  # bad --hedge-ms / duplicate replica URLs
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
     except OSError as e:  # an unwritable --access-log / --event-log path
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    except DataError as e:  # burn_rate rules need serve's SLO tracker
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
     try:

@@ -115,7 +115,11 @@ class RouterApp:
                  scale_cmd: Optional[str] = None,
                  scale_min: int = 1,
                  scale_max: Optional[int] = None,
-                 scale_cooldown_s: float = 60.0):
+                 scale_cooldown_s: float = 60.0,
+                 history_dir: Optional[str] = None,
+                 history_interval_s: float = 5.0,
+                 history_retention_s: float = 3600.0,
+                 alert_rules=None):
         # The fleet event audit log: None unless asked for — a router
         # booted without --event-log constructs no writer, no ring
         # (the zero-cost-when-off contract the overhead check pins).
@@ -196,9 +200,58 @@ class RouterApp:
         else:
             self.autoscale = None
             self._offered = None
+        # Durable fleet history + alerting (obs/history.py, obs/alerts.py):
+        # the router's recorder scrapes every usable member's /metrics
+        # into its OWN segment ring with a {replica} label, so fleet-wide
+        # history survives member death. Neither flag (the default)
+        # constructs NOTHING — no obs.history/alerts import, no
+        # knn_history_*/knn_alerts_* instruments, no knn-history/
+        # knn-alerts thread (scripts/check_disabled_overhead.py pins it).
+        if history_dir is not None or alert_rules:
+            from knn_tpu.obs.alerts import AlertEngine
+            from knn_tpu.obs.history import HistoryRecorder
+
+            # slo=None: a router has no request-SLO tracker, so
+            # burn_rate rules are a typed boot error here.
+            self.alerts = (AlertEngine(
+                alert_rules, slo=None, workload=None,
+                recorder=self.recorder, events=self.events,
+                history_dir=history_dir,
+            ) if alert_rules else None)
+            self.history = HistoryRecorder(
+                history_dir, interval_s=history_interval_s,
+                retention_s=history_retention_s, source="route",
+                sample_fn=self._history_sample,
+                on_sample=(
+                    (lambda ts, view: self.alerts.evaluate(ts, view))
+                    if self.alerts is not None else None),
+            )
+        else:
+            self.history = None
+            self.alerts = None
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="knn-fleet-hedge")
         self.set.start()
+
+    def _history_sample(self) -> list:
+        """One history snapshot: the router's own registry plus every
+        usable member's scraped snapshot, each member record tagged with
+        its ``{replica}`` label (the federated_metrics convention — raw
+        per-replica values, never a lossy pre-sum). A member that fails
+        its scrape is simply absent from this snapshot; an absence rule
+        can page on exactly that."""
+        records = list(aggregate.snapshot_registry(obs.registry()))
+        for url in self.set.usable_urls():
+            st, doc, _err = self._admin_call(
+                "GET", url + "/metrics?format=json", None,
+                timeout=self.set.poll_timeout_s)
+            if st != 200 or not isinstance(doc.get("snapshot"), list):
+                continue
+            for rec in doc["snapshot"]:
+                records.append(
+                    {**rec, "labels": {**(rec.get("labels") or {}),
+                                       "replica": url}})
+        return records
 
     @staticmethod
     def _parse_hedge(hedge) -> Optional[float]:
@@ -213,6 +266,12 @@ class RouterApp:
         return ms
 
     def close(self) -> None:
+        if self.history is not None:
+            # First, while the pool + replica set still answer: close()
+            # takes a final snapshot for the post-mortem record.
+            self.history.close()
+        if self.alerts is not None:
+            self.alerts.close()
         self.set.close()
         self._pool.shutdown(wait=False)
         if self.access_log is not None:
@@ -1164,6 +1223,13 @@ class RouterApp:
             # The autoscaler's operating point; None (the DISTINCT
             # "no autoscaler" state) while --scale-cmd is unset.
             "autoscale": self._autoscale_block(),
+            # Durable metrics history + alert engine; None while
+            # --history-dir/--alert-rules are unset.
+            "history": (self.history.status()
+                        if self.history is not None else None),
+            "alerts": ({"firing": self.alerts.export()["firing"],
+                        "rules": len(self.alerts.rules)}
+                       if self.alerts is not None else None),
         }
 
     def _autoscale_block(self) -> Optional[dict]:
@@ -1355,11 +1421,53 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._do_debug_requests()
         elif route == "/debug/events":
             self._do_debug_events()
+        elif route == "/debug/history":
+            self._do_history()
+        elif route == "/debug/alerts":
+            self._do_alerts()
         elif route == "/metrics":
             self._send_raw(200, self.app.federated_metrics().encode(),
                            "text/plain; version=0.0.4")
         else:
             self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def _do_history(self) -> None:
+        """The fleet history window (serve's /debug/history contract:
+        always 200, ``enabled: false`` while the layer is off). Series
+        scraped from members carry their ``{replica}`` label."""
+        app = self.app
+        if app.history is None:
+            self._send(200, {"enabled": False, "series": []})
+            return
+        from knn_tpu.obs.history import parse_window
+
+        q = parse_qs(urlparse(self.path).query)
+        metric = q.get("metric", [None])[0]
+        labels = {}
+        for item in q.get("label", []):
+            k, sep, v = item.partition("=")
+            if not sep or not k:
+                self._send(400, {"error": f"bad label={item!r}: want k=v"})
+                return
+            labels[k] = v
+        window_s = None
+        if q.get("window", [None])[0] is not None:
+            try:
+                window_s = parse_window(q["window"][0])
+            except ValueError as exc:
+                self._send(400, {"error": str(exc)})
+                return
+        self._send(200, {"enabled": True, "status": app.history.status(),
+                         **app.history.query(metric=metric, labels=labels,
+                                             window_s=window_s)})
+
+    def _do_alerts(self) -> None:
+        app = self.app
+        if app.alerts is None:
+            self._send(200, {"enabled": False, "rules": [], "firing": [],
+                             "recent": []})
+            return
+        self._send(200, {"enabled": True, **app.alerts.export()})
 
     def _do_debug_requests(self) -> None:
         """The router tier of per-request debugging: no ``id`` lists the

@@ -193,7 +193,11 @@ class ServeApp:
                  shards: Optional[int] = None,
                  priority_map: Optional[dict] = None,
                  brownout: bool = False,
-                 autotune_interval_s: Optional[float] = None):
+                 autotune_interval_s: Optional[float] = None,
+                 history_dir: Optional[str] = None,
+                 history_interval_s: float = 5.0,
+                 history_retention_s: float = 3600.0,
+                 alert_rules=None):
         self._previous_buckets = None
         self._installed_buckets = False
         if batch_buckets is not None:
@@ -527,6 +531,50 @@ class ServeApp:
             )
         else:
             self.autotune = None
+        # Durable metrics history + declarative alerting (obs/history.py,
+        # obs/alerts.py, docs/OBSERVABILITY.md §History & alerting):
+        # --history-dir appends delta-encoded registry snapshots to an
+        # on-disk segment ring (queryable live at /debug/history and
+        # post-mortem via `knn_tpu history DIR`); --alert-rules evaluates
+        # declarative rules on the same cadence. Neither flag (the
+        # default) constructs NOTHING — no obs.history/alerts import, no
+        # knn_history_*/knn_alerts_* instruments, no knn-history/
+        # knn-alerts thread (scripts/check_disabled_overhead.py pins it).
+        if history_dir is not None or alert_rules:
+            from knn_tpu import obs as obs_mod
+            from knn_tpu.obs import aggregate
+            from knn_tpu.obs.alerts import AlertEngine
+            from knn_tpu.obs.history import HistoryRecorder
+
+            self.alerts = (AlertEngine(
+                alert_rules, slo=self.slo, workload=self.workload,
+                recorder=self.recorder, history_dir=history_dir,
+            ) if alert_rules else None)
+
+            def _history_sample():
+                # slo.export refreshes the knn_slo_* gauges (so burn
+                # lands in history) and workload.export finalizes any
+                # pending timed capture window — an alert-armed window
+                # completes within one snapshot interval even at zero
+                # traffic.
+                self.slo.export()
+                if self.workload is not None:
+                    self.workload.export()
+                if not obs_mod.enabled():
+                    return []
+                return aggregate.snapshot_registry()
+
+            self.history = HistoryRecorder(
+                history_dir, interval_s=history_interval_s,
+                retention_s=history_retention_s, source="serve",
+                sample_fn=_history_sample,
+                on_sample=(
+                    (lambda ts, view: self.alerts.evaluate(ts, view))
+                    if self.alerts is not None else None),
+            )
+        else:
+            self.history = None
+            self.alerts = None
         self._bootstrap_lock = threading.Lock()
         self.ready = False
         self.draining = False
@@ -918,6 +966,13 @@ class ServeApp:
 
     def close(self) -> None:
         self.ready = False
+        if self.history is not None:
+            # FIRST, while every layer is still live: close() takes one
+            # final snapshot so the durable record extends to shutdown
+            # (the post-mortem contract `knn_tpu history` relies on).
+            self.history.close()
+        if self.alerts is not None:
+            self.alerts.close()
         if self.autotune is not None:
             # Before the batcher: a mid-cycle capture/replay must not
             # race the worker teardown.
@@ -1016,6 +1071,14 @@ class ServeApp:
             # None — the distinct "control: absent" state — while no
             # control flag is set.
             "control": self.control_block(),
+            # Durable metrics history + alert engine. None — the
+            # distinct "absent" state — while --history-dir/--alert-rules
+            # are unset.
+            "history": (self.history.status()
+                        if self.history is not None else None),
+            "alerts": ({"firing": self.alerts.export()["firing"],
+                        "rules": len(self.alerts.rules)}
+                       if self.alerts is not None else None),
         }
         if self.recorder is not None:
             h["flight_recorder"] = self.recorder.stats()
@@ -1226,6 +1289,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_capture_status()
         elif route == "/debug/control":
             self._do_control()
+        elif route == "/debug/history":
+            self._do_history()
+        elif route == "/debug/alerts":
+            self._do_alerts()
         elif route == "/debug/profile":
             self._do_profile()
         elif route == "/admin/wal-since":
@@ -1339,6 +1406,59 @@ class _Handler(BaseHTTPRequestHandler):
         }
         # No request_id stamped into a payload about OTHER requests (the
         # /debug/requests rule; the response header still carries it).
+        self._send(200, payload, tag_request_id=False)
+
+    def _do_history(self):
+        """The live metrics-history window: ``?metric=NAME`` filters to
+        one instrument, ``&label=k=v`` (repeatable) subset-matches
+        labels, ``&window=5m`` trails back from the newest snapshot.
+        Always 200 — while --history-dir/--alert-rules are off the
+        payload says ``enabled: false`` rather than 404, so dashboards
+        can hard-code the route (the /debug/quality rule)."""
+        app = self.app
+        if app.history is None:
+            self._send(200, {"enabled": False, "series": [],
+                             "index_version": app.index_version},
+                       tag_request_id=False)
+            return
+        from knn_tpu.obs.history import parse_window
+
+        q = parse_qs(urlparse(self.path).query)
+        metric = q.get("metric", [None])[0]
+        labels = {}
+        for item in q.get("label", []):
+            k, sep, v = item.partition("=")
+            if not sep or not k:
+                self._send(400, {"error": f"bad label={item!r}: want k=v"})
+                return
+            labels[k] = v
+        window_s = None
+        if q.get("window", [None])[0] is not None:
+            try:
+                window_s = parse_window(q["window"][0])
+            except ValueError as exc:
+                self._send(400, {"error": str(exc)})
+                return
+        payload = {"enabled": True, "status": app.history.status(),
+                   **app.history.query(metric=metric, labels=labels,
+                                       window_s=window_s),
+                   "index_version": app.index_version}
+        self._send(200, payload, tag_request_id=False)
+
+    def _do_alerts(self):
+        """The alert-engine status page: every rule's hysteresis state,
+        the currently-firing set, and the recent audit tail. Always 200
+        — no --alert-rules reports ``enabled: false`` with empty,
+        well-formed collections (the /debug/quality rule)."""
+        app = self.app
+        if app.alerts is None:
+            self._send(200, {"enabled": False, "rules": [], "firing": [],
+                             "recent": [],
+                             "index_version": app.index_version},
+                       tag_request_id=False)
+            return
+        payload = {"enabled": True, **app.alerts.export(),
+                   "index_version": app.index_version}
         self._send(200, payload, tag_request_id=False)
 
     def _do_profile(self):

@@ -29,9 +29,21 @@ What it does:
    - the breaker OPENS under the burst and RE-CLOSES after it clears,
      with a steady probe of sequential requests all answering 200
      (availability back to 100%);
+   - the declarative alert loop closes end-to-end: a ``burn_rate`` rule
+     on the ``fast_rung`` objective FIRES during the burst, its
+     ``capture`` action self-arms a workload window (artifact reason
+     ``alert:fast-rung-burn``), and the alert RESOLVES after the breaker
+     re-closes — fire + resolve both land as an audit pair in
+     ``alerts.jsonl`` under the history dir;
    - a final SIGTERM under load drains cleanly: exit code 0 within
      ``--drain-timeout-s`` + grace, in-flight requests answered;
-5. emit a BENCH-style availability / error-budget JSON on stdout, and
+5. after the drain, run the post-mortem path against the dead server's
+   history dir: ``knn_tpu report`` must stitch the metrics history,
+   the alert pair, and the alert-armed capture into one incident
+   report (``build/chaos-soak-incident.{md,json}``); the alert audit
+   trail and capture artifact are copied to ``build/`` too — CI
+   uploads all of them as workflow artifacts;
+6. emit a BENCH-style availability / error-budget JSON on stdout, and
    (``--perfetto-out``) save the per-request Perfetto trace of the soak's
    recorded timelines — CI uploads it as a workflow artifact.
 
@@ -263,6 +275,26 @@ def main() -> int:
         print(f"chaos-soak: fault plan {fault_plan} (seed {args.seed}), "
               f"{args.clients} clients, {args.window_s:.0f} s window")
 
+        # The declarative alert under test: the fast_rung burn already
+        # asserted by phase 3.5, restated as a rules.json the operator
+        # would actually ship. Its capture action must self-arm a
+        # workload window at fire time — the closed forensics loop.
+        history_dir = os.path.join(tmp, "history")
+        capture_dir = os.path.join(tmp, "captures")
+        access_log = os.path.join(tmp, "access.jsonl")
+        rules_path = os.path.join(tmp, "rules.json")
+        Path(rules_path).write_text(json.dumps([{
+            "name": "fast-rung-burn",
+            "type": "burn_rate",
+            "objective": "fast_rung",
+            "windows": ["5s"],
+            "threshold": 0.5,
+            "for_s": 0.5,
+            "resolve_for_s": 1.0,
+            "severity": "page",
+            "actions": [{"do": "capture", "window_s": 4.0}],
+        }], indent=1) + "\n")
+
         proc = procgroup.popen_group(
             [sys.executable, "-m", "knn_tpu.cli", "serve", index,
              "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
@@ -271,7 +303,15 @@ def main() -> int:
              # soak request (so all request_ids resolve), and SLO windows
              # short enough that burn both rises during the burst and
              # visibly recovers within the soak.
-             "--flight-recorder-size", "16384", "--slo-windows", "5,60"],
+             "--flight-recorder-size", "16384", "--slo-windows", "5,60",
+             # Observability-history invariants: a snapshot cadence fast
+             # enough that the alert engine sees the burst, plus the
+             # capture + access-log machinery the incident report stitches.
+             "--history-dir", history_dir, "--history-interval-s", "0.5",
+             "--history-retention-s", "600",
+             "--alert-rules", rules_path,
+             "--capture-dir", capture_dir,
+             "--access-log", access_log],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
@@ -392,6 +432,64 @@ def main() -> int:
         print(f"chaos-soak: SLO burn cycle observed (fast_rung peak "
               f"{round(max_burn, 2)} -> {final_burn} after recovery)")
 
+        # -- phase 3.55: the alert loop closes — fire during the burst,
+        # capture self-armed, resolve after the breaker re-closes -------
+        alert_rule = None
+        alert_deadline = time.monotonic() + 30
+        while time.monotonic() < alert_deadline:
+            try:
+                st, body = http(base, "/debug/alerts", timeout=5)
+                doc = json.loads(body)
+                alert_rule = next(
+                    (r for r in doc.get("rules", ())
+                     if r["name"] == "fast-rung-burn"), None)
+                if (alert_rule and alert_rule["fires"] >= 1
+                        and alert_rule["state"] == "ok"
+                        and alert_rule["last_resolve"] is not None):
+                    break
+            except Exception:  # noqa: BLE001 — keep polling
+                pass
+            time.sleep(0.25)
+        if alert_rule is None:
+            soak.stop.set()
+            return fail("/debug/alerts never listed the fast-rung-burn "
+                        "rule", proc)
+        if alert_rule["fires"] < 1:
+            soak.stop.set()
+            return fail("alert fast-rung-burn never FIRED during the "
+                        f"fault burst (state: {alert_rule['state']})", proc)
+        if alert_rule["state"] != "ok" or alert_rule["last_resolve"] is None:
+            soak.stop.set()
+            return fail(f"alert fast-rung-burn did not RESOLVE after the "
+                        f"breaker re-closed (state: {alert_rule['state']})",
+                        proc)
+        print(f"chaos-soak: alert cycle observed: fast-rung-burn fired "
+              f"x{alert_rule['fires']} and resolved")
+
+        # The capture action armed a 4 s window at fire time; by resolve
+        # (+history-cadence finalization at worst) its artifact must be
+        # on disk with the alert's reason in the manifest.
+        capture_manifest = None
+        capture_deadline = time.monotonic() + 20
+        while time.monotonic() < capture_deadline:
+            for mf in sorted(Path(capture_dir).glob("workload-*/manifest.json")):
+                man = json.loads(mf.read_text())
+                if man.get("reason") == "alert:fast-rung-burn":
+                    capture_manifest = mf
+                    break
+            if capture_manifest is not None:
+                break
+            time.sleep(0.25)
+        if capture_manifest is None:
+            soak.stop.set()
+            return fail("the alert's capture action never produced a "
+                        "workload artifact with reason "
+                        "alert:fast-rung-burn under --capture-dir", proc)
+        print(f"chaos-soak: alert-armed capture artifact: "
+              f"{capture_manifest.parent.name} "
+              f"({json.loads(capture_manifest.read_text()).get('records')} "
+              f"records)")
+
         # -- phase 3.6: every request_id resolves to a consistent timeline -
         with soak.lock:
             seen_ids = set(soak.request_ids)
@@ -476,6 +574,63 @@ def main() -> int:
             return fail(f"server exited rc={rc} after SIGTERM (graceful "
                         f"drain must exit 0)")
 
+        # -- phase 5: post-mortem — the incident report path against the
+        # DEAD server's history dir (the 3am answer, docs/SERVING.md) ----
+        build_dir = REPO / "build"
+        build_dir.mkdir(exist_ok=True)
+        audit_src = Path(history_dir) / "alerts.jsonl"
+        if not audit_src.exists():
+            return fail("alerts.jsonl missing under the history dir after "
+                        "shutdown")
+        audit = [json.loads(ln) for ln in
+                 audit_src.read_text().splitlines() if ln.strip()]
+        fires = [e for e in audit if e.get("event") == "fire"
+                 and e.get("alert") == "fast-rung-burn"]
+        resolves = [e for e in audit if e.get("event") == "resolve"
+                    and e.get("alert") == "fast-rung-burn"]
+        if not fires or not resolves:
+            return fail(f"alerts.jsonl lacks the fire/resolve audit pair "
+                        f"({len(fires)} fires, {len(resolves)} resolves)")
+        if not any(e.get("event") == "action" and e.get("action") == "capture"
+                   and e.get("outcome") == "ok" for e in audit):
+            return fail("alerts.jsonl has no successful capture-action "
+                        "audit entry")
+        import shutil
+        shutil.copy(audit_src, build_dir / "chaos-soak-alerts.jsonl")
+        cap_dst = build_dir / "chaos-soak-capture"
+        if cap_dst.exists():
+            shutil.rmtree(cap_dst)
+        shutil.copytree(capture_manifest.parent, cap_dst)
+
+        report_cmd = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "report",
+             "--history", history_dir,
+             "--access-log", access_log,
+             "--captures", capture_dir,
+             "--out", str(build_dir / "chaos-soak-incident.md"),
+             "--json-out", str(build_dir / "chaos-soak-incident.json")],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, cwd=REPO,
+        )
+        if report_cmd.returncode != 0:
+            return fail(f"knn_tpu report rc={report_cmd.returncode}: "
+                        f"{report_cmd.stderr[:400]}")
+        incident = json.loads(
+            (build_dir / "chaos-soak-incident.json").read_text())
+        kinds = {e["kind"] for e in incident.get("timeline", ())}
+        if not {"alert-fire", "alert-resolve"} <= kinds:
+            return fail(f"incident timeline lacks the alert fire/resolve "
+                        f"pair (kinds: {sorted(kinds)})")
+        if not any(e["kind"] == "capture"
+                   and e.get("reason") == "alert:fast-rung-burn"
+                   for e in incident.get("timeline", ())):
+            return fail("incident timeline does not reference the "
+                        "alert-armed capture")
+        print(f"chaos-soak: incident report stitched "
+              f"({len(incident['timeline'])} timeline entries, "
+              f"{incident['history']['samples']} history samples) -> "
+              f"{build_dir / 'chaos-soak-incident.md'}")
+
         # -- verdict -------------------------------------------------------
         if soak.violations:
             for v in soak.violations:
@@ -510,6 +665,12 @@ def main() -> int:
             "slo": {
                 "fast_rung_burn_peak": round(max_burn, 3),
                 "fast_rung_burn_recovered": final_burn,
+            },
+            "alerts": {
+                "fires": len(fires),
+                "resolves": len(resolves),
+                "capture_artifact": capture_manifest.parent.name,
+                "incident_timeline_entries": len(incident["timeline"]),
             },
             "tracing": {
                 "request_ids_resolved": len(seen_ids),

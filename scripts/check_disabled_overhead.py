@@ -210,6 +210,20 @@ def main() -> int:
                for _name, fn in app.batcher._rungs(app.batcher._model)):
             return fail("the serving ladder wrapped a rung with the "
                         "mutable merge while disabled")
+        # Durable history + alerting (PR 20): the defaults (no
+        # --history-dir, no --alert-rules) must construct NOTHING — no
+        # recorder, no sampling thread, no alert engine, no
+        # knn_history_*/knn_alerts_* instruments; obs.history/alerts are
+        # lazy imports only the opted-in path pulls in.
+        if app.history is not None or app.alerts is not None:
+            return fail("ServeApp built history/alerting machinery with "
+                        "no --history-dir/--alert-rules — the layer must "
+                        "not exist while disabled")
+        for mod in ("knn_tpu.obs.history", "knn_tpu.obs.alerts"):
+            if mod in sys.modules:
+                return fail(f"{mod} imported during flagless serving — "
+                            f"history/alerting machinery must not "
+                            f"construct while disabled")
         # Shape buckets + result cache (PR 12): the embedded defaults
         # (buckets=None, result_cache_rows=0) must construct NOTHING —
         # no bucket ladder state, no upload stager, no ResultCache, no
@@ -251,7 +265,8 @@ def main() -> int:
     bad_threads = [t.name for t in threading.enumerate()
                    if t.name.startswith(("knn-quality", "knn-drift",
                                          "knn-compactor", "knn-workload",
-                                         "knn-fleet", "knn-control"))]
+                                         "knn-fleet", "knn-control",
+                                         "knn-history", "knn-alerts"))]
     if bad_threads:
         return fail(f"quality/drift/compactor/workload worker thread(s) "
                     f"alive while disabled: {bad_threads}")
@@ -261,7 +276,8 @@ def main() -> int:
                                     "knn_ivf_", "knn_mutable_",
                                     "knn_workload_", "knn_cache_",
                                     "knn_fleet_", "knn_shard_",
-                                    "knn_control_"))]
+                                    "knn_control_", "knn_history_",
+                                    "knn_alerts_"))]
     if leaked:
         return fail(f"quality/drift/cost/capacity/ivf/mutable/workload "
                     f"instrument(s) recorded while disabled: {leaked}")
@@ -358,6 +374,23 @@ def main() -> int:
         if scale_threads:
             return fail(f"autoscale driver thread(s) alive on a "
                         f"flagless router: {scale_threads}")
+        # Durable history + alerting (PR 20): a flagless router must
+        # construct ZERO history/alerting machinery — no recorder, no
+        # scraping thread, no alert engine.
+        if router.history is not None or router.alerts is not None:
+            return fail("RouterApp built history/alerting machinery "
+                        "with no --history-dir/--alert-rules — the "
+                        "layer must not exist while disabled")
+        for mod in ("knn_tpu.obs.history", "knn_tpu.obs.alerts"):
+            if mod in sys.modules:
+                return fail(f"{mod} imported on a flagless router — "
+                            f"history/alerting machinery must not "
+                            f"construct while disabled")
+        hist_threads = [t.name for t in threading.enumerate()
+                        if t.name.startswith(("knn-history", "knn-alerts"))]
+        if hist_threads:
+            return fail(f"history/alert thread(s) alive on a flagless "
+                        f"router: {hist_threads}")
     finally:
         router.close()
     leaked = [i.name for i in obs.registry().instruments()

@@ -13,10 +13,14 @@ Steps:
    /metrics (knn_serve_* counters present; the OpenMetrics exposition
    negotiated via Accept carries trace_id exemplars and ends `# EOF`),
    /debug/requests + /debug/slowest (the predict's request_id resolves
-   to a finished timeline with closed phases; Perfetto export balanced);
+   to a finished timeline with closed phases; Perfetto export balanced),
+   /debug/history (non-empty after two snapshot intervals) and
+   /debug/alerts (no rules loaded: empty but well-formed);
 4. rebuild the index and SIGHUP: the hot reload must swap index_version
    while the process keeps serving bit-identical predictions;
-5. SIGINT and require a clean exit within the grace period.
+5. SIGINT and require a clean exit within the grace period;
+6. post-mortem: `knn_tpu history` answers a range query from the dead
+   server's --history-dir (the 3am path, docs/SERVING.md).
 
 Exit 0 on success; any failure prints a diagnosis and exits 1.
 stdlib-only (urllib, not curl: the gate must not depend on host tools).
@@ -89,6 +93,7 @@ def main() -> int:
         print(f"serve-smoke: {build.stdout.strip()}")
 
         captures_dir = os.path.join(tmp, "captures")
+        history_dir = os.path.join(tmp, "history")
         proc = procgroup.popen_group(
             [sys.executable, "-m", "knn_tpu.cli", "serve", index,
              "--port", "0", "--max-batch", "16", "--max-wait-ms", "1",
@@ -99,7 +104,11 @@ def main() -> int:
              # Workload capture (PR 11): /admin/capture + /debug/capture
              # probed below; the finalized smoke workload is saved to
              # build/ as a CI artifact.
-             "--capture-dir", captures_dir],
+             "--capture-dir", captures_dir,
+             # Metrics history (PR 20): a fast snapshot cadence so
+             # /debug/history fills within the smoke, and the post-mortem
+             # `knn_tpu history` query below has segments to read.
+             "--history-dir", history_dir, "--history-interval-s", "0.5"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
@@ -431,6 +440,43 @@ def main() -> int:
                             proc)
             print("serve-smoke: malformed x-request-id rejected 400")
 
+            # Metrics history (PR 20): /debug/history must answer a range
+            # query with >= 2 points once two snapshot intervals have
+            # elapsed, and /debug/alerts (no rules loaded) must be empty
+            # but well-formed.
+            hist_points = None
+            deadline_h = time.monotonic() + 30
+            while time.monotonic() < deadline_h:
+                st, body, _ = request(
+                    base, "/debug/history?metric=knn_serve_requests_total")
+                if st != 200:
+                    return fail(f"/debug/history {st}: {body[:200]}", proc)
+                hdoc = json.loads(body)
+                if hdoc.get("enabled") is not True:
+                    return fail(f"/debug/history reports disabled with "
+                                f"--history-dir set: {body[:200]}", proc)
+                series = hdoc.get("series") or []
+                if series and len(series[0].get("points", ())) >= 2:
+                    hist_points = series[0]["points"]
+                    break
+                time.sleep(0.2)
+            if hist_points is None:
+                return fail("/debug/history never accumulated 2 points for "
+                            "knn_serve_requests_total (two snapshot "
+                            "intervals)", proc)
+            if hist_points[-1][1] <= 0:
+                return fail(f"history counter value not positive: "
+                            f"{hist_points[-1]}", proc)
+            st, body, _ = request(base, "/debug/alerts")
+            adoc = json.loads(body)
+            if st != 200 or adoc.get("rules") != [] \
+                    or adoc.get("firing") != []:
+                return fail(f"/debug/alerts (no rules) not empty/well-"
+                            f"formed: {st} {body[:200]}", proc)
+            print(f"serve-smoke: /debug/history ok ({len(hist_points)} "
+                  f"points, latest {hist_points[-1]}), /debug/alerts ok "
+                  f"(no rules loaded)")
+
             # Hot reload: rebuild the index (new created_unix -> new
             # version), SIGHUP, and require the swap while serving stays
             # bit-identical.
@@ -476,6 +522,28 @@ def main() -> int:
             return fail("server did not exit after SIGINT", proc)
         if rc != 0:
             return fail(f"server exited rc={rc} after SIGINT")
+
+        # Post-mortem: the history CLI must answer a range query from the
+        # dead server's --history-dir (no server process anywhere).
+        hist = subprocess.run(
+            [sys.executable, "-m", "knn_tpu.cli", "history", history_dir,
+             "--metric", "knn_serve_requests_total", "--json"],
+            env=env, capture_output=True, text=True, cwd=REPO,
+        )
+        if hist.returncode != 0:
+            return fail(f"knn_tpu history rc={hist.returncode}: "
+                        f"{hist.stderr[:300]}")
+        hdoc = json.loads(hist.stdout)
+        series = hdoc.get("series") or []
+        if not series or not series[0].get("points"):
+            return fail(f"post-mortem history query returned no points: "
+                        f"{hist.stdout[:300]}")
+        last = series[0]["points"][-1]
+        if last[1] <= 0:
+            return fail(f"post-mortem history counter not positive: {last}")
+        print(f"serve-smoke: post-mortem `knn_tpu history` ok "
+              f"({hdoc.get('samples')} samples, "
+              f"knn_serve_requests_total={last[1]})")
         print("serve-smoke: clean shutdown, PASS")
         return 0
 

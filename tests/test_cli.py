@@ -2,6 +2,7 @@
 (main.cpp:146), error handling (SURVEY.md §5.5-5.6)."""
 
 import io
+import json
 import re
 
 import pytest
@@ -336,6 +337,77 @@ class TestServeExitCodes:
 
     def test_serve_missing_positional_exits_2(self, capsys):
         assert run(["serve"]) == 2
+
+    # -- PR 20: history / alerting flag contracts (all pre-boot) ---------
+
+    def test_serve_bad_history_flags_exit_2(self, capsys):
+        for extra in (["--history-interval-s", "0"],
+                      ["--history-interval-s", "-1"],
+                      # retention below the sampling interval is unusable
+                      ["--history-dir", "/tmp/h",
+                       "--history-interval-s", "10",
+                       "--history-retention-s", "5"],
+                      ["--history-retention-s", "0"]):
+            assert run(["serve", "/irrelevant/index", *extra]) == 2, extra
+            assert "error:" in self._err(capsys)
+
+    def test_serve_bad_alert_rules_exit_2(self, tmp_path, capsys):
+        assert run(["serve", "/irrelevant/index",
+                    "--alert-rules", "/no/such/rules.json"]) == 2
+        assert "error:" in self._err(capsys)
+        bad = tmp_path / "rules.json"
+        bad.write_text("{not json")
+        assert run(["serve", "/irrelevant/index",
+                    "--alert-rules", str(bad)]) == 2
+        assert "error:" in self._err(capsys)
+        # A capture action needs the workload recorder armed.
+        bad.write_text(json.dumps([
+            {"name": "x", "type": "threshold", "metric": "m", "value": 1,
+             "actions": [{"do": "capture"}]}]))
+        assert run(["serve", "/irrelevant/index",
+                    "--alert-rules", str(bad)]) == 2
+        assert "--capture-dir" in self._err(capsys)
+        # A profile action writes under the history dir.
+        bad.write_text(json.dumps([
+            {"name": "x", "type": "threshold", "metric": "m", "value": 1,
+             "actions": [{"do": "profile"}]}]))
+        assert run(["serve", "/irrelevant/index",
+                    "--alert-rules", str(bad)]) == 2
+        assert "--history-dir" in self._err(capsys)
+
+    def test_route_alert_rules_contracts_exit_2(self, tmp_path, capsys):
+        rules = tmp_path / "rules.json"
+        # Routers have no request SLOs: burn_rate rules are a serve thing.
+        rules.write_text(json.dumps([
+            {"name": "b", "type": "burn_rate", "threshold": 1.0}]))
+        assert run(["route", "http://127.0.0.1:1",
+                    "--alert-rules", str(rules)]) == 2
+        assert "error:" in self._err(capsys)
+        # ...and no workload recorder for capture actions.
+        rules.write_text(json.dumps([
+            {"name": "x", "type": "threshold", "metric": "m", "value": 1,
+             "actions": [{"do": "capture"}]}]))
+        assert run(["route", "http://127.0.0.1:1",
+                    "--alert-rules", str(rules)]) == 2
+        assert "workload recorder" in self._err(capsys)
+
+    def test_route_bad_history_flags_exit_2(self, capsys):
+        assert run(["route", "http://127.0.0.1:1",
+                    "--history-interval-s", "0"]) == 2
+        assert "error:" in self._err(capsys)
+
+    def test_history_usage_errors_exit_2(self, tmp_path, capsys):
+        assert run(["history", "/no/such/dir"]) == 2
+        assert "error:" in self._err(capsys)
+        empty = tmp_path / "h"
+        empty.mkdir()
+        assert run(["history", str(empty), "--window", "bogus"]) == 2
+        assert "error:" in self._err(capsys)
+
+    def test_report_usage_errors_exit_2(self, capsys):
+        assert run(["report", "--history", "/no/such/dir"]) == 2
+        assert "error:" in self._err(capsys)
+        assert "Traceback" not in capsys.readouterr().err
 
 
 class TestDumpPredictions:

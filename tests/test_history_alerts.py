@@ -1,0 +1,898 @@
+"""Durable metrics history + declarative alerting contract tests
+(docs/OBSERVABILITY.md §History & alerting).
+
+The load-bearing claims:
+
+* the on-disk segment ring round-trips the registry EXACTLY — counters
+  reconstruct to absolute values from deltas, histograms through
+  ``Histogram.merge_counts`` with raw bucket counts, never a lossy
+  pre-sum — and every segment decodes independently so retention can
+  drop whole segments;
+* crash-safety mirrors the mutable WAL tail: a torn final line of the
+  last segment is tolerated and repaired in place, damage anywhere else
+  is a typed ``DataError``;
+* every rule type's hysteresis machine (ok → pending → firing →
+  resolving → ok) emits exactly ONE fire/resolve audit pair per
+  incident, with flaps while resolving snapping back silently;
+* actions dispatch off-thread, are audited including raises, and a
+  broken action never takes the engine down;
+* the post-mortem CLI answers a range query from a dead process's dir,
+  and ``build_report`` is deterministic — byte-identical on re-run.
+
+Everything runs on an injectable fake clock; no sleeps, no wall time.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.data.dataset import Dataset
+from knn_tpu.models.knn import KNNClassifier
+from knn_tpu.obs.alerts import AlertEngine, load_rules, parse_rules
+from knn_tpu.obs.history import (
+    SCHEMA_HASH, HistoryRecorder, load_history, parse_window, query_samples,
+)
+from knn_tpu.obs.report import build_report, render_markdown
+from knn_tpu.resilience.errors import DataError
+
+
+@pytest.fixture
+def obs_on():
+    """Enabled + isolated observability for metric assertions."""
+    was = obs.enabled()
+    obs.enable()
+    obs.reset()
+    yield obs.registry()
+    obs.reset()
+    if not was:
+        obs.disable()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def snap(counter=None, gauge=None, hist=None):
+    """A fake ``aggregate.snapshot_registry()`` listing: one counter
+    (labelled), one gauge, one 3-bound histogram (counts length 4 with
+    the +Inf overflow slot)."""
+    recs = []
+    if counter is not None:
+        recs.append({"name": "t_requests_total", "kind": "counter",
+                     "labels": {"kind": "predict"}, "help": "",
+                     "value": float(counter)})
+    if gauge is not None:
+        recs.append({"name": "t_depth", "kind": "gauge", "labels": {},
+                     "help": "", "value": float(gauge)})
+    if hist is not None:
+        counts, s, c = hist
+        recs.append({"name": "t_ms", "kind": "histogram", "labels": {},
+                     "help": "", "buckets": [1.0, 5.0, 25.0],
+                     "counts": list(counts), "sum": float(s),
+                     "count": int(c)})
+    return recs
+
+
+def make_recorder(feed, clock, history_dir=None, **kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("retention_s", 60.0)
+    return HistoryRecorder(history_dir, sample_fn=lambda: feed[0],
+                           clock=clock, autostart=False, **kw)
+
+
+class TestParseWindow:
+    def test_units(self):
+        assert parse_window("300") == 300.0
+        assert parse_window("300s") == 300.0
+        assert parse_window("5m") == 300.0
+        assert parse_window("1h") == 3600.0
+        assert parse_window(45) == 45.0
+
+    def test_bad_values(self):
+        for raw in ("abc", "5x", "", "0", "-3", "0s"):
+            with pytest.raises(ValueError):
+                parse_window(raw)
+
+
+class TestRoundTrip:
+    def test_counter_gauge_histogram_reconstruct_exactly(self, tmp_path):
+        clock = FakeClock()
+        feed = [snap(counter=1, gauge=4, hist=([1, 0, 0, 0], 0.5, 1))]
+        rec = make_recorder(feed, clock, str(tmp_path / "h"))
+        rec.sample_once()
+        clock.advance(1)
+        feed[0] = snap(counter=3, gauge=2, hist=([1, 2, 0, 1], 40.5, 4))
+        rec.sample_once()
+        clock.advance(1)
+        feed[0] = snap(counter=6, gauge=2, hist=([1, 2, 3, 1], 70.5, 7))
+        rec.sample_once()
+
+        hist = load_history(str(tmp_path / "h"))
+        assert not hist.repaired
+        assert len(hist.samples) == 3
+        # Counters come back ABSOLUTE even though the wire is deltas.
+        got = hist.query(metric="t_requests_total")["series"][0]
+        assert got["kind"] == "counter"
+        assert got["labels"] == {"kind": "predict"}
+        assert [p[1] for p in got["points"]] == [1.0, 3.0, 6.0]
+        # Gauges: absolute, present at every sample they held a value.
+        got = hist.query(metric="t_depth")["series"][0]
+        assert [p[1] for p in got["points"]] == [4.0, 2.0, 2.0]
+        # Histograms: raw bucket counts through merge_counts — count,
+        # sum, AND the per-bucket distribution all exact.
+        got = hist.query(metric="t_ms")["series"][0]
+        assert got["kind"] == "histogram"
+        assert [p[1] for p in got["points"]] == [1, 4, 7]  # count
+        assert got["points"][-1][2] == 70.5  # sum
+        assert got["counts"] == [1, 2, 3, 1]  # final raw buckets
+        assert got["buckets"] == [1.0, 5.0, 25.0]
+
+    def test_wire_is_delta_encoded(self, tmp_path):
+        clock = FakeClock()
+        feed = [snap(counter=5, gauge=1)]
+        rec = make_recorder(feed, clock, str(tmp_path / "h"))
+        rec.sample_once()
+        clock.advance(1)
+        feed[0] = snap(counter=9, gauge=1)  # counter +4, gauge unchanged
+        rec.sample_once()
+        clock.advance(1)
+        rec.sample_once()  # nothing changed at all
+
+        seg = tmp_path / "h" / "seg-1.jsonl"
+        lines = [json.loads(ln) for ln in
+                 seg.read_text().splitlines()]
+        header, base, d1, d2 = lines
+        assert header["schema_hash"] == SCHEMA_HASH
+        assert base["d"] == 0
+        counter_base = next(e for e in base["m"] if e["n"] == "t_requests_total")
+        assert counter_base["v"] == 5.0
+        assert d1["d"] == 1
+        # Delta record: the counter increment only — the unchanged gauge
+        # is omitted entirely.
+        assert [e["n"] for e in d1["m"]] == ["t_requests_total"]
+        assert d1["m"][0]["v"] == 4.0
+        assert d2["m"] == []  # quiet process: bytes ~ nothing
+
+    def test_segments_decode_independently(self, tmp_path):
+        # rotate_s = max(1, 16/8) = 2 -> a new segment every 2 samples.
+        clock = FakeClock()
+        feed = [snap(counter=0)]
+        rec = make_recorder(feed, clock, str(tmp_path / "h"),
+                            retention_s=16.0)
+        for i in range(6):
+            feed[0] = snap(counter=10 * (i + 1))
+            rec.sample_once()
+            clock.advance(1)
+        segs = sorted(p.name for p in (tmp_path / "h").glob("seg-*.jsonl"))
+        assert len(segs) >= 2
+        # Drop the FIRST segment: later ones must still decode to the
+        # correct absolute values (each opens with a base record).
+        (tmp_path / "h" / segs[0]).unlink()
+        hist = load_history(str(tmp_path / "h"))
+        pts = hist.query(metric="t_requests_total")["series"][0]["points"]
+        assert pts[-1][1] == 60.0
+
+
+class TestRotationRetention:
+    def test_rotation_and_retention_prune_whole_segments(self, tmp_path):
+        clock = FakeClock()
+        feed = [snap(counter=0)]
+        rec = make_recorder(feed, clock, str(tmp_path / "h"),
+                            retention_s=8.0)  # rotate_s = 1s
+        for i in range(20):
+            feed[0] = snap(counter=i)
+            rec.sample_once()
+            clock.advance(1)
+        status = rec.status()
+        assert status["pruned_segments"] >= 1
+        live = sorted(int(p.stem.split("-")[1])
+                      for p in (tmp_path / "h").glob("seg-*.jsonl"))
+        # Only segments inside the retention window survive on disk.
+        assert live[0] > 1
+        hist = load_history(str(tmp_path / "h"))
+        span = hist.samples[-1][0] - hist.samples[0][0]
+        assert span <= 8.0 + 1.0
+        # The live ring answers the same trailing window.
+        live_q = rec.query(metric="t_requests_total", window_s=5)
+        assert live_q["series"][0]["points"]
+
+    def test_flag_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            make_recorder([[]], FakeClock(), interval_s=0)
+        with pytest.raises(ValueError):
+            make_recorder([[]], FakeClock(), interval_s=5, retention_s=1)
+
+
+class TestTornTail:
+    def _write_history(self, tmp_path, n=3):
+        clock = FakeClock()
+        feed = [snap(counter=0)]
+        rec = make_recorder(feed, clock, str(tmp_path / "h"))
+        for i in range(n):
+            feed[0] = snap(counter=i + 1)
+            rec.sample_once()
+            clock.advance(1)
+        return tmp_path / "h"
+
+    def test_torn_final_line_tolerated_and_repaired(self, tmp_path):
+        h = self._write_history(tmp_path)
+        seg = h / "seg-1.jsonl"
+        with open(seg, "a", encoding="utf-8") as f:
+            f.write('{"t": 1003.0, "d": 1, "m"')  # crash mid-append
+        hist = load_history(str(h))
+        assert hist.repaired
+        assert len(hist.samples) == 3
+        # The repair is durable: the torn line is GONE from disk.
+        assert all(json.loads(ln) for ln in seg.read_text().splitlines())
+        assert not load_history(str(h)).repaired
+
+    def test_mid_file_corruption_refused(self, tmp_path):
+        h = self._write_history(tmp_path)
+        seg = h / "seg-1.jsonl"
+        lines = seg.read_text().splitlines()
+        lines[2] = '{"t": broken'
+        seg.write_text("\n".join(lines) + "\n")
+        with pytest.raises(DataError):
+            load_history(str(h))
+
+    def test_torn_tail_of_non_last_segment_refused(self, tmp_path):
+        clock = FakeClock()
+        feed = [snap(counter=0)]
+        rec = make_recorder(feed, clock, str(tmp_path / "h"),
+                            retention_s=16.0)  # rotate every 2 samples
+        for i in range(5):
+            feed[0] = snap(counter=i)
+            rec.sample_once()
+            clock.advance(1)
+        segs = sorted((tmp_path / "h").glob("seg-*.jsonl"))
+        assert len(segs) >= 2
+        with open(segs[0], "a", encoding="utf-8") as f:
+            f.write('{"torn')
+        with pytest.raises(DataError):
+            load_history(str(tmp_path / "h"))
+
+    def test_schema_hash_pin_refuses_foreign_segments(self, tmp_path):
+        h = self._write_history(tmp_path)
+        seg = h / "seg-1.jsonl"
+        lines = seg.read_text().splitlines()
+        header = json.loads(lines[0])
+        header["schema_hash"] = "0" * 32
+        lines[0] = json.dumps(header)
+        seg.write_text("\n".join(lines) + "\n")
+        with pytest.raises(DataError, match="incompatible"):
+            load_history(str(h))
+
+    def test_boot_scan_repairs_and_opens_fresh_segment(self, tmp_path):
+        h = self._write_history(tmp_path)
+        with open(h / "seg-1.jsonl", "a", encoding="utf-8") as f:
+            f.write('{"t": 99')  # the predecessor was SIGKILLed
+        clock = FakeClock(1010.0)  # restart inside the retention window
+        feed = [snap(counter=100)]
+        rec = make_recorder(feed, clock, str(h))
+        rec.sample_once()
+        assert rec.status()["segment"] == 2  # never appends to the tail
+        hist = load_history(str(h))
+        assert not hist.repaired  # boot already repaired it
+        assert [int(n) for n in hist.segments] == [1, 2]
+        assert hist.samples[-1][0] == 1010.0
+
+
+class TestQuerySamples:
+    def test_window_and_label_filters(self):
+        state = lambda v, extra=None: {  # noqa: E731 — tiny local builder
+            ("m", (("az", "a"),)): ("c", "m", {"az": "a"}, v),
+            **(extra or {})}
+        samples = [(1000.0, state(1.0)), (1001.0, state(2.0)),
+                   (1002.0, state(3.0,
+                    {("m", (("az", "b"),)): ("c", "m", {"az": "b"}, 9.0)}))]
+        doc = query_samples(samples, metric="m", labels={"az": "a"},
+                            window_s=1.0)
+        assert doc["window"] == {"from": 1001.0, "to": 1002.0}
+        assert len(doc["series"]) == 1
+        assert [p[1] for p in doc["series"][0]["points"]] == [2.0, 3.0]
+        # No filters: both labelled series come back, sorted.
+        assert len(query_samples(samples)["series"]) == 2
+
+
+class TestRuleParsing:
+    def test_normalization_defaults(self):
+        rules = parse_rules({"rules": [
+            {"name": "a", "type": "threshold", "metric": "m", "value": 3,
+             "for_s": 2},
+            {"name": "b", "type": "burn_rate", "threshold": 1.5,
+             "actions": [{"do": "capture"}]},
+        ]})
+        assert rules[0]["op"] == ">"
+        assert rules[0]["resolve_for_s"] == 2.0  # defaults to for_s
+        assert rules[1]["objective"] == "availability"
+        assert rules[1]["windows"] is None
+        # A capture action with neither bound gets the default window.
+        assert rules[1]["actions"] == [{"do": "capture", "window_s": 10.0}]
+
+    def test_shape_errors_are_typed(self):
+        bad = [
+            {},  # not a list
+            [],  # empty
+            [{"type": "threshold"}],  # no name
+            [{"name": "x", "type": "nope"}],
+            [{"name": "x", "type": "threshold", "metric": "m", "value": 1},
+             {"name": "x", "type": "threshold", "metric": "m", "value": 1}],
+            [{"name": "x", "type": "threshold", "metric": "m",
+              "value": 1, "op": "!="}],
+            [{"name": "x", "type": "threshold", "value": 1}],  # no metric
+            [{"name": "x", "type": "threshold", "metric": "m",
+              "value": "high"}],
+            [{"name": "x", "type": "burn_rate", "threshold": 0}],
+            [{"name": "x", "type": "burn_rate", "threshold": 1,
+              "windows": []}],
+            [{"name": "x", "type": "derivative", "metric": "m", "value": 1}],
+            [{"name": "x", "type": "absence"}],
+            [{"name": "x", "type": "threshold", "metric": "m", "value": 1,
+              "for_s": -1}],
+            [{"name": "x", "type": "threshold", "metric": "m", "value": 1,
+              "actions": [{"do": "explode"}]}],
+            [{"name": "x", "type": "threshold", "metric": "m", "value": 1,
+              "actions": [{"do": "command", "cmd": "  "}]}],
+            [{"name": "x", "type": "threshold", "metric": "m", "value": 1,
+              "actions": [{"do": "capture", "max_requests": 0}]}],
+        ]
+        for doc in bad:
+            with pytest.raises(DataError):
+                parse_rules(doc)
+
+    def test_load_rules_file_errors(self, tmp_path):
+        with pytest.raises(DataError):
+            load_rules(str(tmp_path / "missing.json"))
+        p = tmp_path / "rules.json"
+        p.write_text("{not json")
+        with pytest.raises(DataError):
+            load_rules(str(p))
+
+
+class _StubSLO:
+    def __init__(self):
+        self.burns = {"availability": {"5s": 0.0, "1m": 0.0}}
+
+    def burn_rates(self):
+        return {k: dict(v) for k, v in self.burns.items()}
+
+
+def _engine(rules, clock, **kw):
+    return AlertEngine(parse_rules(rules), clock=clock, **kw)
+
+
+def _step(feed, rec, engine, clock, dt=1.0, **snap_kw):
+    if snap_kw:
+        feed[0] = snap(**snap_kw)
+    ts = rec.sample_once()
+    engine.evaluate(ts, rec)
+    clock.advance(dt)
+    return ts
+
+
+def _events(engine, kind):
+    return [e for e in engine.export()["recent"] if e.get("event") == kind]
+
+
+class TestAlertHysteresis:
+    def test_threshold_for_flap_resolve_single_pair(self, obs_on):
+        clock = FakeClock()
+        feed = [snap(gauge=10)]
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "op": ">", "value": 5,
+                        "for_s": 2, "resolve_for_s": 2}], clock)
+        _step(feed, rec, eng, clock)  # t=1000: pending
+        assert eng.export()["rules"][0]["state"] == "pending"
+        assert not _events(eng, "fire")
+        _step(feed, rec, eng, clock)  # t=1001: held 1s < for_s
+        _step(feed, rec, eng, clock)  # t=1002: held 2s -> FIRE
+        assert len(_events(eng, "fire")) == 1
+        assert eng.export()["firing"] == ["hot"]
+        assert obs_on.gauge("knn_alerts_firing", alert="hot").value == 1
+        _step(feed, rec, eng, clock, gauge=1)  # t=1003: resolving
+        assert eng.export()["rules"][0]["state"] == "resolving"
+        assert "hot" in eng.export()["firing"]  # resolving still pages
+        _step(feed, rec, eng, clock, gauge=10)  # t=1004: FLAP back
+        assert eng.export()["rules"][0]["state"] == "firing"
+        assert len(_events(eng, "fire")) == 1  # NO second fire event
+        _step(feed, rec, eng, clock, gauge=1)  # t=1005: resolving again
+        _step(feed, rec, eng, clock)  # t=1006: held 1s
+        assert not _events(eng, "resolve")
+        _step(feed, rec, eng, clock)  # t=1007: held 2s -> RESOLVE
+        assert len(_events(eng, "resolve")) == 1
+        assert eng.export()["rules"][0]["state"] == "ok"
+        assert eng.export()["rules"][0]["fires"] == 1
+        assert obs_on.gauge("knn_alerts_firing", alert="hot").value == 0
+        fire, = _events(eng, "fire")
+        assert fire["alert"] == "hot" and fire["value"] == 10.0
+        assert fire["severity"] == "page" and fire["type"] == "threshold"
+
+    def test_condition_blip_shorter_than_for_never_fires(self, obs_on):
+        clock = FakeClock()
+        feed = [snap(gauge=10)]
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5, "for_s": 2}], clock)
+        _step(feed, rec, eng, clock)  # pending
+        _step(feed, rec, eng, clock, gauge=1)  # back to ok before for_s
+        _step(feed, rec, eng, clock, gauge=10)  # pending restarts from 0
+        _step(feed, rec, eng, clock, gauge=1)
+        assert not _events(eng, "fire")
+
+    def test_for_zero_fires_immediately(self, obs_on):
+        clock = FakeClock()
+        feed = [snap(gauge=10)]
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5}], clock)
+        _step(feed, rec, eng, clock)
+        assert len(_events(eng, "fire")) == 1
+
+    def test_absence_rule(self, obs_on):
+        clock = FakeClock()
+        feed = [snap(gauge=1)]  # the counter is absent
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "silent", "type": "absence",
+                        "metric": "t_requests_total"}], clock)
+        _step(feed, rec, eng, clock)
+        assert len(_events(eng, "fire")) == 1
+        _step(feed, rec, eng, clock, counter=1, gauge=1)  # it's back
+        assert len(_events(eng, "resolve")) == 1
+
+    def test_derivative_rule(self, obs_on):
+        clock = FakeClock()
+        feed = [snap(counter=0)]
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "spike", "type": "derivative",
+                        "metric": "t_requests_total", "op": ">",
+                        "value": 2.0, "window_s": 2.0}], clock)
+        _step(feed, rec, eng, clock)  # t=1000: no lookback yet
+        _step(feed, rec, eng, clock, counter=5)  # t=1001: still short
+        assert not _events(eng, "fire")
+        _step(feed, rec, eng, clock, counter=10)  # t=1002: 10/2s = 5 > 2
+        assert len(_events(eng, "fire")) == 1
+        assert _events(eng, "fire")[0]["value"] == 5.0
+        # Rate back under the line -> resolve.
+        _step(feed, rec, eng, clock, counter=10)
+        _step(feed, rec, eng, clock, counter=10)
+        assert len(_events(eng, "resolve")) == 1
+
+    def test_burn_rate_multi_window_and(self, obs_on):
+        clock = FakeClock()
+        slo = _StubSLO()
+        feed = [snap(counter=1)]
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "burn", "type": "burn_rate",
+                        "objective": "availability",
+                        "windows": ["5s", "1m"], "threshold": 1.0}],
+                      clock, slo=slo)
+        slo.burns["availability"] = {"5s": 3.0, "1m": 0.5}
+        _step(feed, rec, eng, clock)  # only ONE window burns: no fire
+        assert not _events(eng, "fire")
+        slo.burns["availability"] = {"5s": 3.0, "1m": 2.0}
+        _step(feed, rec, eng, clock)  # both windows -> fire, value = max
+        fire, = _events(eng, "fire")
+        assert fire["value"] == 3.0
+        slo.burns["availability"] = {"5s": 0.0, "1m": 0.0}
+        _step(feed, rec, eng, clock)
+        assert len(_events(eng, "resolve")) == 1
+
+    def test_burn_rate_needs_slo_at_boot(self):
+        with pytest.raises(DataError, match="burn_rate"):
+            _engine([{"name": "b", "type": "burn_rate", "threshold": 1}],
+                    FakeClock())
+
+    def test_unknown_window_audited_not_raised(self, obs_on):
+        clock = FakeClock()
+        slo = _StubSLO()
+        feed = [snap(counter=1)]
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "b", "type": "burn_rate",
+                        "windows": ["7d"], "threshold": 1}], clock, slo=slo)
+        _step(feed, rec, eng, clock)
+        errs = _events(eng, "eval-error")
+        assert errs and errs[0]["alert"] == "b"
+        assert eng.export()["rules"][0]["state"] == "ok"
+
+
+class _StubWorkload:
+    def __init__(self, raise_on_start=False):
+        self.started = []
+        self.raise_on_start = raise_on_start
+
+    def start(self, reason="manual", max_requests=None, window_s=None):
+        if self.raise_on_start:
+            raise RuntimeError("capture already armed")
+        self.started.append((reason, window_s, max_requests))
+
+
+class _StubRecorder:
+    def slowest(self):
+        return [{"request_id": "r-1", "request_ms": 99.0}]
+
+
+class TestAlertActions:
+    def _fire(self, eng, clock, feed=None, rec=None):
+        feed = feed if feed is not None else [snap(gauge=10)]
+        rec = rec or make_recorder(feed, clock)
+        _step(feed, rec, eng, clock)
+        eng.drain_actions()
+        return feed, rec
+
+    def test_capture_action_arms_workload(self, obs_on, tmp_path):
+        clock = FakeClock()
+        wl = _StubWorkload()
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5,
+                        "actions": [{"do": "capture", "window_s": 8}]}],
+                      clock, workload=wl)
+        self._fire(eng, clock)
+        assert wl.started == [("alert:hot", 8.0, None)]
+        acts = [e for e in _events(eng, "action")
+                if e["action"] == "capture"]
+        assert acts and acts[0]["outcome"] == "ok" and acts[0]["on"] == "fire"
+
+    def test_capture_requires_workload_at_boot(self):
+        with pytest.raises(DataError, match="capture"):
+            _engine([{"name": "h", "type": "threshold", "metric": "m",
+                      "value": 1, "actions": [{"do": "capture"}]}],
+                    FakeClock())
+
+    def test_profile_requires_history_dir_at_boot(self):
+        with pytest.raises(DataError, match="profile"):
+            _engine([{"name": "h", "type": "threshold", "metric": "m",
+                      "value": 1, "actions": [{"do": "profile"}]}],
+                    FakeClock())
+
+    def test_profile_action_writes_trace(self, obs_on, tmp_path,
+                                         monkeypatch):
+        from knn_tpu.obs import devprof
+
+        monkeypatch.setattr(devprof, "capture_for",
+                            lambda ms, **kw: {"traceEvents": [],
+                                              "otherData": {"ms": ms}})
+        clock = FakeClock()
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5,
+                        "actions": [{"do": "profile", "ms": 50}]}],
+                      clock, history_dir=str(tmp_path / "h"))
+        self._fire(eng, clock)
+        profiles = list((tmp_path / "h" / "profiles").glob("profile-hot-*.json"))
+        assert len(profiles) == 1
+        assert json.loads(profiles[0].read_text())["otherData"]["ms"] == 50
+
+    def test_command_action_runs_on_fire_and_resolve(self, obs_on):
+        clock = FakeClock()
+        feed = [snap(gauge=10)]
+        rec = make_recorder(feed, clock)
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5,
+                        "actions": [{"do": "command",
+                                     "cmd": f"{sys.executable} -c pass"}]}],
+                      clock)
+        _step(feed, rec, eng, clock)  # fire
+        _step(feed, rec, eng, clock, gauge=1)  # resolve
+        eng.drain_actions()
+        acts = [e for e in _events(eng, "action")
+                if e["action"] == "command"]
+        assert [a["on"] for a in acts] == ["fire", "resolve"]
+        assert all(a["outcome"] == "ok" for a in acts)
+        # The contract appends event + alert name to the argv.
+        assert acts[0]["detail"].endswith("fire hot")
+
+    def test_failing_command_audited_as_error(self, obs_on):
+        clock = FakeClock()
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5,
+                        "actions": [{"do": "command", "cmd": "false"}]}],
+                      clock)
+        self._fire(eng, clock)
+        acts = [e for e in _events(eng, "action")
+                if e["action"] == "command"]
+        assert acts and acts[0]["outcome"].startswith("error")
+
+    def test_raising_action_audited_engine_survives(self, obs_on):
+        clock = FakeClock()
+        wl = _StubWorkload(raise_on_start=True)
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5,
+                        "actions": [{"do": "capture"}]}],
+                      clock, workload=wl)
+        feed, rec = self._fire(eng, clock)
+        acts = [e for e in _events(eng, "action")
+                if e["action"] == "capture"]
+        assert acts and acts[0]["outcome"].startswith("error")
+        # The engine keeps evaluating: resolve still lands.
+        _step(feed, rec, eng, clock, gauge=1)
+        assert len(_events(eng, "resolve")) == 1
+
+    def test_forensics_frozen_at_fire_time(self, obs_on, tmp_path):
+        clock = FakeClock()
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5}],
+                      clock, recorder=_StubRecorder(),
+                      history_dir=str(tmp_path / "h"))
+        self._fire(eng, clock)
+        dumps = list((tmp_path / "h" / "forensics").glob("slowest-hot-*.json"))
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["alert"] == "hot"
+        assert doc["slowest"][0]["request_id"] == "r-1"
+
+    def test_audit_file_written_line_buffered(self, obs_on, tmp_path):
+        clock = FakeClock()
+        eng = _engine([{"name": "hot", "type": "threshold",
+                        "metric": "t_depth", "value": 5}],
+                      clock, history_dir=str(tmp_path / "h"))
+        feed, rec = self._fire(eng, clock)
+        _step(feed, rec, eng, clock, gauge=1)
+        eng.drain_actions()
+        entries = [json.loads(ln) for ln in
+                   (tmp_path / "h" / "alerts.jsonl")
+                   .read_text().splitlines()]
+        events = [e["event"] for e in entries]
+        assert "fire" in events and "resolve" in events
+        eng.close()
+
+
+class TestRouterReplicaMerge:
+    def test_scrape_tags_replica_label_and_merges(self, obs_on, tmp_path,
+                                                  monkeypatch):
+        from knn_tpu.fleet.router import RouterApp
+
+        app = RouterApp(["http://127.0.0.1:9/"], health_interval_s=30.0,
+                        history_dir=str(tmp_path / "rh"),
+                        history_interval_s=5.0)
+        try:
+            monkeypatch.setattr(app.set, "usable_urls",
+                                lambda: ["http://r1", "http://r2"])
+
+            def fake_admin(method, url, payload, timeout=None):
+                if url.startswith("http://r2"):
+                    return None, None, "connection refused"
+                assert url == "http://r1/metrics?format=json"
+                return 200, {"snapshot": [
+                    {"name": "knn_serve_requests_total", "kind": "counter",
+                     "labels": {"kind": "predict"}, "help": "",
+                     "value": 7.0}]}, None
+
+            monkeypatch.setattr(app, "_admin_call", fake_admin)
+            app.history.sample_once()
+            app.history.sample_once()
+            doc = app.history.query(metric="knn_serve_requests_total")
+            series = doc["series"]
+            # The member's scraped record carries its {replica} label —
+            # raw per-replica values, never a pre-sum.
+            assert len(series) == 1
+            assert series[0]["labels"] == {"kind": "predict",
+                                           "replica": "http://r1"}
+            assert series[0]["points"][-1][1] == 7.0
+            # The failed member is simply absent from this snapshot.
+            assert not [s for s in series
+                        if s["labels"].get("replica") == "http://r2"]
+            # The router's OWN instruments land unlabelled-by-replica.
+            own = app.history.query(metric="knn_history_snapshots_total")
+            assert own["series"] and "replica" not in own["series"][0]["labels"]
+        finally:
+            app.close()
+
+
+def _mini_problem():
+    rng = np.random.default_rng(3)
+    train_x = rng.integers(0, 4, (60, 4)).astype(np.float32)
+    train_y = rng.integers(0, 3, 60).astype(np.int32)
+    return Dataset(train_x, train_y)
+
+
+def _http_get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestServeEndpoints:
+    def test_debug_history_and_alerts_contracts(self, tmp_path, obs_on):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        model = KNNClassifier(k=3, engine="xla").fit(_mini_problem())
+        rules = parse_rules([{"name": "hot", "type": "threshold",
+                              "metric": "t_depth", "value": 5}])
+        app = ServeApp(model, max_batch=8, max_wait_ms=0.5,
+                       history_dir=str(tmp_path / "h"),
+                       history_interval_s=60.0, alert_rules=rules)
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+        try:
+            app.history.sample_once()
+            app.history.sample_once()  # the 2nd sees the 1st's counter
+            st, doc = _http_get(base, "/debug/history"
+                                      "?metric=knn_history_snapshots_total")
+            assert st == 200 and doc["enabled"] is True
+            assert doc["status"]["snapshots"] >= 1
+            assert doc["series"][0]["name"] == "knn_history_snapshots_total"
+            assert "index_version" in doc
+            # Label + window filters, and their 400 contracts.
+            st, doc = _http_get(base, "/debug/history?label=kind")
+            assert st == 400 and "label" in doc["error"]
+            st, doc = _http_get(base, "/debug/history?window=xyz")
+            assert st == 400
+            st, doc = _http_get(base, "/debug/history?window=5m")
+            assert st == 200
+            st, doc = _http_get(base, "/debug/alerts")
+            assert st == 200 and doc["enabled"] is True
+            assert doc["firing"] == []
+            assert doc["rules"][0]["name"] == "hot"
+            assert doc["rules"][0]["state"] == "ok"
+            # /healthz carries both status blocks.
+            st, h = _http_get(base, "/healthz")
+            assert h["history"]["snapshots"] >= 1
+            assert h["alerts"] == {"firing": [], "rules": 1}
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+        # close() takes one FINAL snapshot: the dir outlives the process.
+        hist = load_history(str(tmp_path / "h"))
+        assert hist.samples
+
+    def test_disabled_is_absent_not_an_error(self, obs_on):
+        from knn_tpu.serve.server import ServeApp, make_server
+
+        model = KNNClassifier(k=3, engine="xla").fit(_mini_problem())
+        app = ServeApp(model, max_batch=8, max_wait_ms=0.5)
+        assert app.history is None and app.alerts is None
+        server = make_server(app)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+        try:
+            st, doc = _http_get(base, "/debug/history")
+            assert st == 200 and doc["enabled"] is False
+            assert doc["series"] == []
+            st, doc = _http_get(base, "/debug/alerts")
+            assert st == 200 and doc["enabled"] is False
+            assert doc["rules"] == [] and doc["firing"] == []
+            st, h = _http_get(base, "/healthz")
+            assert h["history"] is None and h["alerts"] is None
+        finally:
+            server.shutdown()
+            server.server_close()
+            app.close()
+
+
+class TestPostMortemCLI:
+    def _crashed_dir(self, tmp_path):
+        """A history dir as a SIGKILLed process leaves it: segments plus
+        a torn half-written final line."""
+        clock = FakeClock()
+        feed = [snap(counter=0, gauge=1)]
+        rec = make_recorder(feed, clock, str(tmp_path / "h"))
+        for i in range(4):
+            feed[0] = snap(counter=2 * i, gauge=1)
+            rec.sample_once()
+            clock.advance(1)
+        with open(tmp_path / "h" / "seg-1.jsonl", "a",
+                  encoding="utf-8") as f:
+            f.write('{"t": 1004.0, "d": 1,')
+        return str(tmp_path / "h")
+
+    def test_history_cli_answers_from_crashed_dir(self, tmp_path, capsys):
+        from knn_tpu.cli import run
+
+        h = self._crashed_dir(tmp_path)
+        assert run(["history", h, "--metric", "t_requests_total"]) == 0
+        out = capsys.readouterr().out
+        assert "torn tail repaired" in out
+        assert "t_requests_total" in out
+        # --json: machine-readable with the reconstruction metadata.
+        assert run(["history", h, "--metric", "t_requests_total",
+                    "--window", "2s", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["samples"] == 4
+        assert doc["repaired_torn_tail"] is False  # first run repaired it
+        assert doc["series"][0]["points"][-1][1] == 6.0
+
+    def test_history_cli_usage_errors_exit_2(self, tmp_path, capsys):
+        from knn_tpu.cli import run
+
+        h = self._crashed_dir(tmp_path)
+        assert run(["history", str(tmp_path / "nope")]) == 2
+        assert run(["history", h, "--window", "xyz"]) == 2
+        assert run(["history", h, "--label", "novalue"]) == 2
+        # Mid-file corruption is damage, not a crash signature: exit 2.
+        seg = tmp_path / "h" / "seg-1.jsonl"
+        lines = seg.read_text().splitlines()
+        lines[2] = "garbage"
+        seg.write_text("\n".join(lines) + "\n")
+        assert run(["history", h]) == 2
+        assert "Traceback" not in capsys.readouterr().err
+
+    def test_report_cli_and_determinism(self, tmp_path, capsys):
+        from knn_tpu.cli import run
+
+        h = self._crashed_dir(tmp_path)
+        (tmp_path / "h" / "alerts.jsonl").write_text(
+            json.dumps({"ts": 1001.5, "alert": "hot", "event": "fire",
+                        "severity": "page", "type": "threshold",
+                        "value": 9.0, "actions": ["capture"]}) + "\n" +
+            json.dumps({"ts": 1001.6, "alert": "hot", "event": "action",
+                        "on": "fire", "action": "capture",
+                        "outcome": "ok", "detail": "armed"}) + "\n" +
+            json.dumps({"ts": 1003.0, "alert": "hot", "event": "resolve",
+                        "severity": "page", "type": "threshold",
+                        "value": 1.0}) + "\n")
+        cap = tmp_path / "captures" / "workload-1001700"
+        cap.mkdir(parents=True)
+        (cap / "manifest.json").write_text(json.dumps(
+            {"reason": "alert:hot", "t0_unix": 1001.7, "records": 5,
+             "stop_reason": "window"}))
+        access = tmp_path / "access.jsonl"
+        access.write_text(
+            json.dumps({"ts": 1001.2, "request_id": "r-9",
+                        "kind": "predict", "status": 503,
+                        "outcome": "overload", "ms": 1.0,
+                        "rung": "fast"}) + "\n")
+
+        load_history(h)  # settle the torn-tail repair first
+        doc1 = build_report(h, access_log=str(access),
+                            captures=str(tmp_path / "captures"))
+        doc2 = build_report(h, access_log=str(access),
+                            captures=str(tmp_path / "captures"))
+        assert json.dumps(doc1, sort_keys=True) == \
+            json.dumps(doc2, sort_keys=True)
+        assert render_markdown(doc1) == render_markdown(doc2)
+
+        kinds = [e["kind"] for e in doc1["timeline"]]
+        assert {"alert-fire", "alert-resolve", "alert-action", "capture",
+                "request-error"} <= set(kinds)
+        # Chronological merge across sources.
+        ts = [e["ts"] for e in doc1["timeline"]]
+        assert ts == sorted(ts)
+        assert doc1["alerts"] == {"fires": 1, "resolves": 1, "entries": 3}
+        assert doc1["access_log"]["errors"] == 1
+        counter_row = next(r for r in doc1["metrics"]
+                           if r["name"] == "t_requests_total")
+        assert counter_row["delta"] == 6.0
+
+        out_md = tmp_path / "incident.md"
+        out_json = tmp_path / "incident.json"
+        assert run(["report", "--history", h,
+                    "--access-log", str(access),
+                    "--captures", str(tmp_path / "captures"),
+                    "--out", str(out_md),
+                    "--json-out", str(out_json)]) == 0
+        md = out_md.read_text()
+        assert "# Incident report" in md and "alert hot FIRED" in md
+        assert json.loads(out_json.read_text())["alerts"]["fires"] == 1
+        # A trailing window narrows the report.
+        windowed = build_report(h, window=0.5)
+        assert windowed["window"]["seconds"] == 0.5
+
+    def test_report_cli_usage_errors_exit_2(self, tmp_path, capsys):
+        from knn_tpu.cli import run
+
+        assert run(["report", "--history", str(tmp_path / "nope")]) == 2
+        h = self._crashed_dir(tmp_path)
+        assert run(["report", "--history", h, "--window", "junk"]) == 2
+        assert "Traceback" not in capsys.readouterr().err
